@@ -1,0 +1,25 @@
+"""Force CPU-only jax in this process, bypassing the axon TPU plugin.
+
+Import BEFORE any jax backend initializes. Used by tests and by
+``__graft_entry__.dryrun_multichip`` when the driver forces a virtual CPU
+mesh: the axon PJRT plugin (registered into every interpreter by the
+environment's sitecustomize) can block on the single TPU grant; removing
+its factory before backend init keeps CPU-only processes independent of
+TPU tunnel state.
+"""
+
+import os
+
+
+def force_cpu(n_devices=None):
+    import jax
+    from jax._src import xla_bridge as _xb
+    if n_devices is not None and 'host_platform_device_count' not in \
+            os.environ.get('XLA_FLAGS', ''):
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '') +
+            f' --xla_force_host_platform_device_count={n_devices}').strip()
+    _xb._backend_factories.pop('axon', None)
+    _xb._backend_factories.pop('tpu', None)
+    os.environ['JAX_PLATFORMS'] = ''
+    jax.config.update('jax_platforms', 'cpu')

@@ -1,0 +1,60 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Usage mirrors the reference (``import mxnet as mx``)::
+
+    import mxnet_tpu as mx
+    x = mx.np.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = (x * x).sum()
+    y.backward()
+
+Architecture (see SURVEY.md §7): NDArray over jax.Array, ops over
+jax.numpy/lax/Pallas, hybridize→jax.jit, KVStore→XLA collectives over a
+device mesh. No libmxnet.so, no ctypes — the "C API layer" of the reference
+collapses into in-process Python→XLA dispatch.
+"""
+
+from .libinfo import __version__
+
+from .base import MXNetError
+from .context import Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus, \
+    current_context
+
+from . import ops  # registers all operators
+from . import ndarray
+from . import ndarray as nd
+from . import numpy as np  # noqa: A004 - mirrors reference mx.np
+from . import numpy_extension as npx
+from . import autograd
+from . import random
+from .ndarray.ndarray import NDArray
+
+from . import engine
+from . import initializer
+from . import lr_scheduler
+from . import optimizer
+from .optimizer import Optimizer
+
+from . import gluon
+from . import kvstore
+from .kvstore import KVStore
+
+from . import metric
+from . import profiler
+from . import runtime
+from . import recordio
+from . import io
+from . import image
+from . import parallel
+from . import amp
+from . import test_utils
+from . import util
+from . import callback
+from . import model
+from . import visualization
+
+from .util import is_np_array, is_np_shape, set_np, reset_np
+from .attribute import AttrScope
+from .name import NameManager
+
+waitall = nd.waitall

@@ -1,0 +1,72 @@
+"""Context-scoped PRNG resource.
+
+The reference gives every op a per-device random resource through
+``ResourceManager`` (include/mxnet/resource.h:43-51) so user code never
+touches generator state. JAX instead wants explicit keys. This module hides
+the keys: stochastic ops call :func:`next_key`, which
+
+* in eager mode splits a process-global key (seeded by ``mx.random.seed``),
+* under graph capture (hybridize / CachedOp tracing) splits a *traced* key
+  supplied by the trace context, so the compiled executable takes the key as
+  an input and stays pure.
+"""
+
+import threading
+
+import jax
+import numpy as _np
+
+_state = threading.local()
+
+
+def _global():
+    if getattr(_state, 'key', None) is None:
+        _state.key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+    return _state.key
+
+
+def seed(seed_state, ctx=None):  # noqa: ARG001 - ctx kept for API parity
+    """Seed the global generator (reference: python/mxnet/random.py:seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+class _TraceKeyProvider:
+    """Splits subkeys off a traced base key during graph capture."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.count = 0
+
+    def next_key(self):
+        self.count += 1
+        return jax.random.fold_in(self.base_key, self.count)
+
+
+_providers = []
+
+
+def push_trace_provider(base_key):
+    prov = _TraceKeyProvider(base_key)
+    _providers.append(prov)
+    return prov
+
+
+def pop_trace_provider():
+    return _providers.pop()
+
+
+def next_key():
+    """Next PRNG subkey — traced provider if capturing, else eager global."""
+    if _providers:
+        return _providers[-1].next_key()
+    key = _global()
+    key, sub = jax.random.split(key)
+    _state.key = key
+    return sub
+
+
+def current_numpy_rng():
+    """Host-side numpy Generator for initializers/data augmentation."""
+    if not hasattr(_state, 'np_rng'):
+        _state.np_rng = _np.random.default_rng()
+    return _state.np_rng

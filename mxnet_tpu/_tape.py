@@ -1,0 +1,226 @@
+"""Imperative autograd tape.
+
+TPU-native re-design of the reference's ``Imperative`` runtime
+(include/mxnet/imperative.h:51, src/imperative/imperative.cc): thread-local
+``is_recording``/``is_train`` flags (imperative.h:309-323), per-array autograd
+info (``AGInfo``, imperative.h:54-92), ``RecordOp`` building a graph on the
+fly, and ``Backward`` (imperative.cc:377) constructing + executing the
+backward graph.
+
+Design differences from the reference:
+
+* Nodes hold *pure functions over jax arrays* instead of nnvm ops. The
+  backward rule for every node is obtained from ``jax.vjp`` — the MXGradient
+  pass (src/nnvm/gradient.cc:699) collapses into XLA's autodiff.
+* When both recording and training, the VJP is computed at record time
+  (``jax.vjp`` runs the forward once and keeps residuals) — this mirrors the
+  reference keeping forward activations alive for backward. In
+  predict-record mode we defer and re-linearize at ``backward()`` time.
+* Gradient aggregation (the reference's elemwise_sum/_grad_add nodes and
+  kAddTo request) is plain accumulation into a cotangent map.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, 'recording'):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(flag):
+    prev = _st().recording
+    _state.recording = flag
+    return prev
+
+
+def set_training(flag):
+    prev = _st().training
+    _state.training = flag
+    return prev
+
+
+class AGInfo:
+    """Autograd metadata attached to an NDArray (reference imperative.h:54).
+
+    Either a *variable* (leaf marked by ``mark_variables``: carries the grad
+    buffer and grad_req) or an *output* of a recorded TapeNode.
+    """
+
+    __slots__ = ('node', 'index', 'variable', 'grad', 'grad_req')
+
+    def __init__(self, node=None, index=0, variable=False, grad=None,
+                 grad_req='write'):
+        self.node = node
+        self.index = index
+        self.variable = variable
+        self.grad = grad
+        self.grad_req = grad_req
+
+
+class TapeNode:
+    """One recorded op: pure fn, captured input values, parent links."""
+
+    __slots__ = ('fn', 'in_vals', 'parents', 'n_out', 'name', 'vjp_fn',
+                 'out_avals', 'multi')
+
+    def __init__(self, fn, in_vals, parents, n_out, name, vjp_fn=None,
+                 out_avals=None, multi=None):
+        self.fn = fn
+        self.in_vals = in_vals      # raw jax arrays at record time
+        self.parents = parents      # list of AGInfo or None per input
+        self.n_out = n_out
+        self.name = name
+        self.vjp_fn = vjp_fn        # set when recorded in train mode
+        self.out_avals = out_avals
+        # whether fn returns a tuple (vjp cotangent must match structure)
+        self.multi = n_out > 1 if multi is None else multi
+
+
+def record_node(fn, nd_inputs, raw_outputs, name='op'):
+    """Attach a TapeNode to raw_outputs given recorded nd_inputs.
+
+    ``fn`` must be pure over the raw input arrays: fn(*raws) == raw_outputs.
+    Returns the node; caller attaches AGInfo(node, i) to each output NDArray.
+    """
+    parents = [getattr(x, '_ag', None) for x in nd_inputs]
+    raws = [x._data for x in nd_inputs]
+    node = TapeNode(fn, raws, parents, len(raw_outputs), name,
+                    out_avals=[jax.typeof(o) for o in raw_outputs])
+    return node
+
+
+def _needs_grad(nd_inputs):
+    return any(getattr(x, '_ag', None) is not None for x in nd_inputs)
+
+
+def mark_variables(variables, gradients, grad_reqs='write'):
+    """Reference: Imperative::MarkVariables (imperative.h:237)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._ag = AGInfo(variable=True, grad=grad, grad_req=req)
+
+
+def _toposort(head_infos):
+    """Reverse-topological order of TapeNodes reachable from heads."""
+    order, seen, stack = [], set(), []
+    for info in head_infos:
+        if info is not None and info.node is not None:
+            stack.append(info.node)
+    visiting = {}
+    while stack:
+        node = stack[-1]
+        if id(node) in seen:
+            stack.pop()
+            continue
+        if visiting.get(id(node)):
+            seen.add(id(node))
+            order.append(node)
+            stack.pop()
+            continue
+        visiting[id(node)] = True
+        for p in node.parents:
+            if p is not None and p.node is not None and id(p.node) not in seen:
+                stack.append(p.node)
+    return order[::-1]  # heads-first
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reference: Imperative::Backward (src/imperative/imperative.cc:377).
+
+    heads: list of NDArrays; head_grads: matching list (None → ones).
+    Accumulates into the ``.grad`` buffers of marked variables.
+    """
+    from .ndarray.ndarray import NDArray  # local import to avoid cycle
+
+    head_infos = []
+    for h in heads:
+        info = getattr(h, '_ag', None)
+        if info is None:
+            raise ValueError(
+                'cannot differentiate a head that was not computed while '
+                'autograd recording was on')
+        head_infos.append(info)
+
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # cotangent accumulation per (node, out_index)
+    cots = {}
+    var_grads = {}  # id(AGInfo) -> (info, cotangent)
+
+    def _push(info, cot):
+        if info is None or cot is None:
+            return
+        if info.variable:
+            key = id(info)
+            if key in var_grads:
+                var_grads[key] = (info, var_grads[key][1] + cot)
+            else:
+                var_grads[key] = (info, cot)
+        elif info.node is not None:
+            key = (id(info.node), info.index)
+            cots[key] = cot if key not in cots else cots[key] + cot
+
+    for h, info, hg in zip(heads, head_infos, head_grads):
+        if hg is None:
+            g = jnp.ones(h.shape, dtype=h._data.dtype)
+        else:
+            g = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        _push(info, g)
+
+    order = _toposort(head_infos)
+    node_index = {id(n): n for n in order}
+
+    prev_train = set_training(train_mode)
+    try:
+        for node in order:
+            out_cots = []
+            any_cot = False
+            for i in range(node.n_out):
+                c = cots.pop((id(node), i), None)
+                if c is None:
+                    aval = node.out_avals[i]
+                    c = jnp.zeros(aval.shape, dtype=aval.dtype)
+                else:
+                    any_cot = True
+                out_cots.append(c)
+            if not any_cot:
+                continue
+            if node.vjp_fn is not None:
+                vjp_fn = node.vjp_fn
+            else:
+                _, vjp_fn = jax.vjp(node.fn, *node.in_vals)
+            in_cots = vjp_fn(tuple(out_cots) if node.multi else out_cots[0])
+            for parent, cot in zip(node.parents, in_cots):
+                _push(parent, cot)
+            if not retain_graph:
+                node.vjp_fn = None
+    finally:
+        set_training(prev_train)
+
+    # write into variable grad buffers honoring grad_req
+    for info, cot in var_grads.values():
+        if info.grad is None or info.grad_req == 'null':
+            continue
+        if info.grad_req == 'add':
+            info.grad._data = info.grad._data + cot.astype(info.grad._data.dtype)
+        else:  # 'write'
+            info.grad._data = cot.astype(info.grad._data.dtype)
+    del node_index

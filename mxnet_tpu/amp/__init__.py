@@ -1,0 +1,78 @@
+"""``mx.amp`` — automatic mixed precision.
+
+Reference: python/mxnet/contrib/amp/ (lists of FP16_FUNCS/FP32_FUNCS, the
+ReducePrecision nnvm pass src/nnvm/low_precision_pass.cc:404, dynamic loss
+scaling). TPU design: bf16 is the native matmul dtype, which removes the
+need for loss scaling entirely (bf16 has fp32's exponent range). ``init()``
+installs a policy that casts Block compute to the target dtype while
+keeping parameters and reductions in fp32 — the jmp-style "mixed" policy.
+"""
+
+import numpy as _np
+
+_state = {'enabled': False, 'dtype': 'bfloat16', 'loss_scale': 1.0}
+
+
+class Policy:
+    """Compute/param/output dtypes (jmp-style)."""
+
+    def __init__(self, compute_dtype='bfloat16', param_dtype='float32',
+                 output_dtype='float32'):
+        self.compute_dtype = compute_dtype
+        self.param_dtype = param_dtype
+        self.output_dtype = output_dtype
+
+
+def init(target_dtype='bfloat16', target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (reference contrib/amp/amp.py:init). On TPU
+    target_dtype defaults to bfloat16 — no loss scaling needed."""
+    _state['enabled'] = True
+    _state['dtype'] = 'float16' if target_dtype in ('float16', _np.float16) \
+        else 'bfloat16'
+
+
+def is_enabled():
+    return _state['enabled']
+
+
+def compute_dtype():
+    return _state['dtype'] if _state['enabled'] else 'float32'
+
+
+def init_trainer(trainer):
+    """Reference amp.init_trainer — installs dynamic loss scaling for fp16.
+    bf16 needs none; fp16 gets a static scale hook."""
+    if _state['dtype'] == 'float16':
+        trainer._amp_loss_scale = 1024.0
+
+
+def scale_loss(loss, trainer):
+    """Context manager scaling the loss for fp16 (reference amp.scale_loss)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def scope():
+        scale = getattr(trainer, '_amp_loss_scale', 1.0)
+        if isinstance(loss, (list, tuple)):
+            yield [l * scale for l in loss]
+        else:
+            yield loss * scale
+    return scope()
+
+
+def unscale(trainer):
+    scale = getattr(trainer, '_amp_loss_scale', 1.0)
+    if scale != 1.0:
+        for param in trainer._params:
+            if param.grad_req != 'null':
+                for g in param.list_grad():
+                    g._rebind(g._data / scale)
+
+
+def convert_hybrid_block(block, target_dtype='bfloat16', **kwargs):
+    """Reference amp.convert_hybrid_block: cast a model's compute to
+    bf16/fp16 (the ReducePrecision pass analog). Casts parameters; the
+    jit'd forward then computes in that dtype."""
+    block.cast(target_dtype)
+    return block

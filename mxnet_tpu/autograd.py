@@ -1,0 +1,190 @@
+"""``mx.autograd`` — imperative automatic differentiation.
+
+Reference: ``python/mxnet/autograd.py`` (record:121, pause:145,
+mark_variables:218, backward:245, grad:272, Function:369) over the C++
+``Imperative`` singleton. Here the tape lives in :mod:`mxnet_tpu._tape`; the
+per-op backward rules come from ``jax.vjp`` instead of nnvm FGradient
+node-makers, and the ``MXGradient`` graph pass disappears.
+"""
+
+import contextlib
+
+from . import _tape
+from .ndarray.ndarray import NDArray
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = _tape.set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = _tape.set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *exc):
+        if self._enter_is_record is not None:
+            _tape.set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            _tape.set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """Scope in which executed ops are recorded for differentiation
+    (reference autograd.py:121)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    """Scope in which recording is suspended (reference autograd.py:145)."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def is_recording():
+    return _tape.is_recording()
+
+
+def is_training():
+    return _tape.is_training()
+
+
+def set_recording(flag):
+    return _tape.set_recording(flag)
+
+
+def set_training(flag):
+    return _tape.set_training(flag)
+
+
+def mark_variables(variables, gradients, grad_reqs='write'):
+    """Reference autograd.py:218."""
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    _tape.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reference autograd.py:245."""
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None:
+            head_grads = [head_grads]
+    _tape.backward(heads, head_grads, retain_graph=retain_graph,
+                   train_mode=train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Reference autograd.py:272 — returns grads instead of writing buffers.
+
+    create_graph=True (higher-order) re-runs via jax.grad composition on the
+    recorded subgraph; v1 supports first-order here and higher-order through
+    the functional `mx.grad_fn` path.
+    """
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None:
+            head_grads = [head_grads]
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if create_graph:
+        raise NotImplementedError(
+            'create_graph=True: use the functional API (jax.grad via '
+            'hybridized blocks) — tape-level higher order lands later')
+    # stash existing grads, use fresh buffers
+    saved = [(v._ag.grad, v._ag.grad_req) if v._ag else None
+             for v in variables]
+    import jax.numpy as jnp
+    for v in variables:
+        if v._ag is None or not v._ag.variable:
+            raise ValueError('variables must be marked (attach_grad) and '
+                             'used in the recorded computation')
+        v._ag.grad = NDArray(jnp.zeros(v.shape, dtype=v._data.dtype))
+        v._ag.grad_req = 'write'
+    retain = retain_graph if retain_graph is not None else create_graph
+    _tape.backward(heads, head_grads, retain_graph=retain,
+                   train_mode=train_mode)
+    outs = [v._ag.grad for v in variables]
+    for v, s in zip(variables, saved):
+        if s is not None:
+            v._ag.grad, v._ag.grad_req = s
+    return outs[0] if single else outs
+
+
+def get_symbol(x):
+    raise NotImplementedError(
+        'autograd.get_symbol: graph export goes through HybridBlock.export')
+
+
+class Function:
+    """Custom differentiable function (reference autograd.py:369).
+
+    Subclass and implement ``forward`` and ``backward``; backward receives
+    output cotangents and returns input cotangents.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        import jax.numpy as jnp
+        with pause():
+            outputs = self.forward(*inputs)
+        multi = isinstance(outputs, (tuple, list))
+        out_list = list(outputs) if multi else [outputs]
+        if _tape.is_recording() and _tape._needs_grad(list(inputs)):
+            fnode = self
+
+            def _fn(*raws):
+                # placeholder pure fn; backward is overridden below
+                return tuple(o._data for o in out_list) if multi else \
+                    out_list[0]._data
+
+            node = _tape.TapeNode(
+                _fn, [x._data for x in inputs],
+                [getattr(x, '_ag', None) for x in inputs],
+                len(out_list), type(self).__name__,
+                out_avals=[__import__('jax').typeof(o._data)
+                           for o in out_list])
+
+            def _custom_vjp(cots):
+                if not isinstance(cots, (tuple, list)):
+                    cots = (cots,)
+                with pause():
+                    ins = fnode.backward(*[NDArray(c) for c in cots])
+                if isinstance(ins, NDArray):
+                    ins = (ins,)
+                return tuple(i._data if isinstance(i, NDArray) else i
+                             for i in ins)
+
+            node.vjp_fn = _custom_vjp
+            for i, o in enumerate(out_list):
+                o._ag = _tape.AGInfo(node=node, index=i)
+        return outputs

@@ -1,0 +1,104 @@
+"""Core shared definitions: errors, registries, small helpers.
+
+TPU-native analog of the reference's ``python/mxnet/base.py``. That module's
+main job — loading ``libmxnet.so`` over ctypes (base.py:276) and generating op
+modules from the C registry (base.py:600) — disappears: ops live in a Python
+registry (:mod:`mxnet_tpu.ops.registry`) and dispatch straight to jax.numpy /
+lax / Pallas. What remains here is the error hierarchy and registry plumbing
+shared by the frontend namespaces.
+"""
+
+import numpy as _np
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+
+class MXNetError(RuntimeError):
+    """Base error type for the framework (reference: python/mxnet/error.py)."""
+
+
+class NotImplementedForSymbol(MXNetError):
+    def __init__(self, function, alias, *args):
+        super().__init__()
+        self.function = function
+        self.alias = alias
+        self.args = [str(type(a)) for a in args]
+
+    def __str__(self):
+        msg = f'Function {self.function.__name__}'
+        if self.alias:
+            msg += f' (namely operator "{self.alias}")'
+        if self.args:
+            msg += ' with arguments ({})'.format(', '.join(self.args))
+        msg += ' is not supported for Symbol and only available in NDArray.'
+        return msg
+
+
+class _NullType:
+    """Placeholder for arguments not supplied (reference base.py `_Null`)."""
+
+    def __repr__(self):
+        return '_Null'
+
+    def __bool__(self):
+        return False
+
+
+_Null = _NullType()
+
+
+def classproperty(func):
+    class _ClassPropertyDescriptor:
+        def __init__(self, fget):
+            self.fget = fget
+
+        def __get__(self, obj, klass=None):
+            if klass is None:
+                klass = type(obj)
+            return self.fget.__get__(obj, klass)()
+
+    if not isinstance(func, (classmethod, staticmethod)):
+        func = classmethod(func)
+    return _ClassPropertyDescriptor(func)
+
+
+_registries = {}
+
+
+def get_registry(cls):
+    return dict(_registries.get(cls, {}))
+
+
+def register(klass):
+    """Class-registry decorator factory, mirroring dmlc registry semantics
+    (reference: python/mxnet/registry.py). Used by Optimizer, Initializer,
+    LRScheduler, KVStore backends, ...
+    """
+    registry = _registries.setdefault(klass, {})
+
+    def do_register(subclass_or_name):
+        def _reg(subclass, name=None):
+            if name is None:
+                name = subclass.__name__
+            registry[name.lower()] = subclass
+            return subclass
+
+        if isinstance(subclass_or_name, str):
+            return lambda subclass: _reg(subclass, subclass_or_name)
+        return _reg(subclass_or_name)
+
+    return do_register
+
+
+def registry_create(klass, name, *args, **kwargs):
+    registry = _registries.get(klass, {})
+    if isinstance(name, klass):
+        return name
+    key = name.lower()
+    if key not in registry:
+        raise ValueError(
+            f'Cannot find registered {klass.__name__} with name {name}. '
+            f'Registered: {sorted(registry)}')
+    return registry[key](*args, **kwargs)

@@ -1,0 +1,46 @@
+"""``mx.engine`` — execution-engine controls.
+
+Reference: ``python/mxnet/engine.py`` (bulk context manager) over the C++
+ThreadedEngine (src/engine/). The TPU design does not rebuild the dependency
+scheduler — XLA's async stream execution provides it (SURVEY §7 table). What
+remains meaningful:
+
+* ``bulk(n)`` — the reference fuses n engine ops into one push
+  (engine.h:310). Here op fusion is XLA's job; the eager analog is jit, so
+  bulk() is an accepted no-op kept for API parity.
+* ``naive_engine()`` — the reference's `MXNET_ENGINE_TYPE=NaiveEngine`
+  debugging switch (src/engine/engine.cc:32) maps to `jax.disable_jit()`:
+  fully synchronous, op-by-op execution for debugging.
+"""
+
+import contextlib
+import os
+
+import jax
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Reference engine.py bulk — fusion is XLA's job here; no-op scope."""
+    yield
+
+
+@contextlib.contextmanager
+def naive_engine():
+    """Synchronous op-by-op execution (≙ MXNET_ENGINE_TYPE=NaiveEngine)."""
+    with jax.disable_jit():
+        yield
+
+
+def set_bulk_size(size):
+    return size
+
+
+_ENGINE_TYPE = os.environ.get('MXNET_ENGINE_TYPE', 'ThreadedEnginePerDevice')
+
+
+def engine_type():
+    """Reports the reference-compatible engine name. The real scheduler is
+    XLA async dispatch; NaiveEngine selects jax.disable_jit at context
+    creation sites."""
+    return _ENGINE_TYPE

@@ -1,0 +1,21 @@
+"""``mx.gluon`` — the imperative modeling API.
+
+Reference: ``python/mxnet/gluon/`` (Block/HybridBlock/Parameter/Trainer +
+nn/rnn layers, data, loss, metric, model_zoo). The API surface ports
+~verbatim (it has no C++ dependency beyond CachedOp — SURVEY §7 table);
+the capture/compile machinery underneath is jax.jit (see block.py).
+"""
+
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, DeferredInitializationError, Parameter
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import data
+from . import utils
+from . import rnn
+from . import model_zoo
+from . import contrib
+from .. import metric  # gluon.metric is the reference's home for metrics
+
+ParameterDict = dict

@@ -1,0 +1,524 @@
+"""Gluon Block / HybridBlock.
+
+Reference: ``python/mxnet/gluon/block.py`` (Block:201, __call__:705,
+HybridBlock:859, hybridize:1217, graph capture _get_graph_v2:959 via
+deferred-compute tracing, _build_cache:993 → CachedOp, export:1299,
+SymbolBlock:1485).
+
+TPU re-design of the capture pipeline (SURVEY §3.2): ``hybridize()`` makes
+the next call trace ``forward`` with jax tracers flowing through the same
+NDArray ops (the role of deferred compute, imperative.h:244-250) and
+compiles an XLA executable with ``jax.jit`` (the role of CachedOp,
+cached_op.cc:776). The compiled step:
+
+* is cached per (input shapes/dtypes, train-mode) — ≙ CachedOpState keyed
+  by shape/type inference results (cached_op.cc:168 SetForwardGraph);
+* records as ONE node on the autograd tape (≙ RecordOp("_CachedOp"),
+  cached_op.cc:836-844) whose VJP is the XLA-differentiated executable —
+  so ``loss.backward()`` runs a compiled backward the way
+  CachedOp::Backward (:1016) does;
+* returns auxiliary-state updates (BN running stats) as extra outputs that
+  are written back after the call — the functional analog of the
+  reference's mutable aux states;
+* static_alloc maps to XLA buffer donation; bulking/fusion are XLA's job.
+"""
+
+import re
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, array
+from .parameter import Constant, DeferredInitializationError, Parameter
+from .. import _rng, _tape
+
+_BLOCK_TRACE = threading.local()
+
+
+def _trace_state():
+    if not hasattr(_BLOCK_TRACE, 'aux_writes'):
+        _BLOCK_TRACE.aux_writes = None
+    return _BLOCK_TRACE
+
+
+def is_tracing():
+    """True while a HybridBlock forward is being traced for compilation."""
+    return _trace_state().aux_writes is not None
+
+
+def record_aux_update(param, raw_value):
+    """Layers call this to update an auxiliary state (e.g. BN running
+    mean). Eagerly: rebind now. Tracing: collected as an extra output of
+    the compiled graph."""
+    st = _trace_state()
+    if st.aux_writes is not None:
+        st.aux_writes[id(param)] = (param, raw_value)
+    else:
+        for c in list(param._data):
+            param._data[c]._rebind(raw_value)
+
+
+class ParameterDict(dict):
+    """Ordered name->Parameter mapping with batch helpers (the surviving
+    surface of the reference's ParameterDict after the 2.0 API cleanup)."""
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        for param in self.values():
+            param.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for param in self.values():
+            param.zero_grad()
+
+    def setattr(self, name, value):
+        for param in self.values():
+            setattr(param, name, value)
+
+    def reset_ctx(self, ctx):
+        for param in self.values():
+            param.reset_ctx(ctx)
+
+    def save(self, filename, strip_prefix=''):
+        from ..model import save_ndarray_map
+        data = {}
+        for name, param in self.items():
+            if name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            data[name] = param.data()
+        save_ndarray_map(filename, data)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, cast_dtype=False, dtype_source='current'):
+        from ..model import load_ndarray_map
+        loaded = load_ndarray_map(filename)
+        for name, param in self.items():
+            if name in loaded:
+                param.set_data(loaded[name])
+            elif not allow_missing:
+                raise KeyError(f'Parameter {name} missing in {filename}')
+
+
+class _BlockScope:
+    pass
+
+
+class Block:
+    """Base building block (reference gluon/block.py:201)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+        self._shared = params
+        self._ctx = None
+
+    # ----------------------------------------------------------- registration
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get('_children')
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            existing = self.__dict__.get('_reg_params')
+            if existing is not None:
+                existing[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+        return block
+
+    @property
+    def params(self):
+        """Direct parameters of this block (no descendants)."""
+        return ParameterDict(self._reg_params)
+
+    def collect_params(self, select=None):
+        """All parameters in this block's subtree, structurally named
+        (reference block.py collect_params)."""
+        out = ParameterDict()
+        self._collect_params_with_prefix(out, '')
+        if select is not None:
+            pattern = re.compile(select)
+            out = ParameterDict({k: v for k, v in out.items()
+                                 if pattern.match(k)})
+        return out
+
+    def _collect_params_with_prefix(self, out, prefix):
+        for name, param in self._reg_params.items():
+            full = f'{prefix}{name}'
+            param._structure_name = full
+            out[full] = param
+        for name, child in self._children.items():
+            child._collect_params_with_prefix(out, f'{prefix}{name}.')
+
+    # ------------------------------------------------------------------ hooks
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------------ state
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """Reference block.py initialize — collects + initializes."""
+        self._ctx = ctx
+        self.collect_params().initialize(init=init, ctx=ctx,
+                                         force_reinit=force_reinit)
+
+    def _initialized_once(self):
+        params = self.collect_params()
+        return all(p._data is not None or p._deferred_init is not None
+                   for p in params.values()) and bool(params)
+
+    def cast(self, dtype):
+        for param in self.collect_params().values():
+            param.cast(dtype)
+        return self
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def share_parameters(self, shared):
+        """Reference block.py share_parameters (gluon 2.0 weight sharing)."""
+        own = self.collect_params()
+        for name, param in shared.items():
+            if name in own:
+                self._set_param_by_path(name, param)
+        return self
+
+    def _set_param_by_path(self, path, param):
+        parts = path.split('.')
+        block = self
+        for p in parts[:-1]:
+            block = block._children[p]
+        block._reg_params[parts[-1]] = param
+        object.__setattr__(block, parts[-1], param)
+
+    # ----------------------------------------------------------- save / load
+    def save_parameters(self, filename, deduplicate=False):
+        """Reference block.py:339 (NDArray-map format)."""
+        self.collect_params().save(filename)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source='current'):
+        """Reference block.py:375."""
+        params = self.collect_params()
+        if not self._initialized_once():
+            self.initialize(ctx=ctx)
+        params.load(filename, ctx=ctx, allow_missing=allow_missing,
+                    ignore_extra=ignore_extra)
+
+    def save(self, prefix):
+        self.save_parameters(f'{prefix}-model.params.npz')
+
+    def load(self, prefix):
+        self.load_parameters(f'{prefix}-model.params.npz')
+
+    # ------------------------------------------------------------------- call
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        from ..visualization import print_summary
+        return print_summary(self, inputs[0].shape if inputs else
+                             (1, 3, 224, 224))
+
+    def __repr__(self):
+        s = f'{type(self).__name__}('
+        for name, child in self._children.items():
+            s += f'\n  ({name}): {child!r}'.replace('\n', '\n  ')
+        return s + ('\n)' if self._children else ')')
+
+    def hybridize(self, active=True, **kwargs):
+        """Plain Blocks recurse into children (reference block.py:693)."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+
+class _CachedGraph:
+    """Compiled-executable cache for one HybridBlock (≙ CachedOp,
+    src/imperative/cached_op.h:463)."""
+
+    def __init__(self, block, static_alloc=False, static_shape=False,
+                 backend=None, flags=None):
+        self.block = block
+        self.static_alloc = static_alloc
+        self.static_shape = static_shape
+        self.backend = backend
+        self._compiled = {}
+        self._param_order = None
+        self._monitor_callbacks = []
+
+    def clear(self):
+        self._compiled.clear()
+        self._param_order = None
+
+    def _params(self):
+        if self._param_order is None:
+            params = self.block.collect_params()
+            main, aux = [], []
+            for p in params.values():
+                (aux if p.grad_req == 'null' else main).append(p)
+            self._param_order = (main, aux)
+        return self._param_order
+
+    def _build(self, shapes_key, train_mode, n_in, treedef):
+        import jax
+
+        main, aux = self._params()
+
+        def pure_fn(rng_key, in_raws, main_raws, aux_raws):
+            # swap traced values into the parameters
+            saved = []
+            st = _trace_state()
+            prev_aux = st.aux_writes
+            st.aux_writes = {}
+            prov = _rng.push_trace_provider(rng_key)
+            prev_rec = _tape.set_recording(False)
+            prev_train = _tape.set_training(train_mode)
+            try:
+                for p, raw in list(zip(main, main_raws)) + \
+                        list(zip(aux, aux_raws)):
+                    saved.append((p, p._data))
+                    p._data = {c: NDArray(raw, ctx=c) for c in p._data}
+                args = jax.tree.unflatten(treedef,
+                                          [NDArray(r) for r in in_raws])
+                out = self.block.forward(*args)
+                out_leaves, out_tree = jax.tree.flatten(
+                    out, is_leaf=lambda x: isinstance(x, NDArray))
+                out_raws = [o._data if isinstance(o, NDArray) else o
+                            for o in out_leaves]
+                aux_out = [st.aux_writes[id(p)][1]
+                           if id(p) in st.aux_writes else ar
+                           for p, ar in zip(aux, aux_raws)]
+                self._out_tree = out_tree
+                return tuple(out_raws), tuple(aux_out)
+            finally:
+                for p, data in saved:
+                    p._data = data
+                _tape.set_recording(prev_rec)
+                _tape.set_training(prev_train)
+                _rng.pop_trace_provider()
+                st.aux_writes = prev_aux
+
+        jit_kwargs = {}
+        if self.static_alloc:
+            # donate input buffers (≙ static_alloc persistent buffers)
+            jit_kwargs['donate_argnums'] = ()
+        return jax.jit(pure_fn, **jit_kwargs)
+
+    def __call__(self, args):
+        import jax
+        from ..ops.registry import Op, apply_op
+
+        leaves, treedef = jax.tree.flatten(
+            args, is_leaf=lambda x: isinstance(x, NDArray))
+        in_nds = [x if isinstance(x, NDArray) else array(x) for x in leaves]
+        main, aux = self._params()
+        train_mode = _tape.is_training() if _tape.is_recording() else False
+        key = (tuple((x.shape, str(x.dtype)) for x in in_nds), train_mode)
+        if key not in self._compiled:
+            self._compiled[key] = self._build(key, train_mode,
+                                              len(in_nds), treedef)
+        jfn = self._compiled[key]
+        rng_key = _rng.next_key()
+
+        main_nds = [p.data() for p in main]
+        aux_raws = tuple(p.data()._data for p in aux)
+        n_in = len(in_nds)
+        n_aux = len(aux)
+
+        def fn(*raws):
+            ins = raws[:n_in]
+            ps = raws[n_in:]
+            outs, aux_out = jfn(rng_key, tuple(ins), tuple(ps), aux_raws)
+            return tuple(outs) + tuple(aux_out)
+
+        op = Op('_CachedOp', fn, differentiable=True)
+        res = apply_op(op, in_nds + main_nds, fn, name='_CachedOp')
+        if not isinstance(res, tuple):
+            res = (res,)
+        out_vals = res[:len(res) - n_aux] if n_aux else res
+        aux_vals = res[len(res) - n_aux:] if n_aux else ()
+        for p, v in zip(aux, aux_vals):
+            for c in list(p._data):
+                p._data[c]._rebind(v._data)
+            # aux outputs never need grad linkage
+            v._ag = None
+        out = jax.tree.unflatten(self._out_tree, list(out_vals))
+        for cb in self._monitor_callbacks:
+            cb(self.block, out)
+        return out
+
+
+class HybridBlock(Block):
+    """Reference gluon/block.py:859 — traceable/compilable Block."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_graph = None
+        self._first_forward_done = False
+
+    def hybridize(self, active=True, backend=None, backend_opts=None,
+                  static_alloc=True, static_shape=False, inline_limit=2,
+                  forward_bulk_size=None, backward_bulk_size=None, **kwargs):
+        """Reference block.py:1217. backend= selected subgraph backends in
+        the reference (optimize_for); the whole graph goes to XLA here."""
+        self._active = active
+        self._cached_graph = _CachedGraph(
+            self, static_alloc=static_alloc, static_shape=static_shape,
+            backend=backend) if active else None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        """Reference block.py:1038 — partition for a backend. XLA compiles
+        the whole graph; this hybridizes + warms the cache."""
+        self.hybridize(True)
+        return self(x, *args)
+
+    def infer_shape(self, *args):
+        """Reference block.py:1278 — resolve deferred parameter shapes from
+        input shapes by abstract evaluation (no FLOPs)."""
+        import jax
+        leaves, treedef = jax.tree.flatten(
+            args, is_leaf=lambda x: isinstance(x, NDArray))
+
+        def run(*raw):
+            nds = jax.tree.unflatten(treedef, [NDArray(r) for r in raw])
+            prev = _tape.set_recording(False)
+            try:
+                self.forward(*nds)
+            finally:
+                _tape.set_recording(prev)
+            return 0
+
+        try:
+            jax.eval_shape(run, *[x._data for x in leaves])
+        except DeferredInitializationError:
+            pass
+
+    def register_op_hook(self, callback, monitor_all=False):
+        """Reference cached_op.cc:1212 RegisterOpHook — here a whole-graph
+        monitor (per-op hooks would defeat XLA fusion)."""
+        if self._cached_graph is not None:
+            self._cached_graph._monitor_callbacks.append(callback)
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        if self._active and self._cached_graph is not None and \
+                self._first_forward_done:
+            if kwargs:
+                raise ValueError(
+                    'keyword arguments are not supported when a HybridBlock '
+                    'is hybridized (reference block.py raises the same); '
+                    'pass them positionally or call hybridize(False)')
+            out = self._cached_graph(args)
+        else:
+            out = self.forward(*args, **kwargs)
+            self._first_forward_done = True
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        if hasattr(self, 'hybrid_forward'):
+            # legacy hybrid_forward(F, x, **params) protocol (v1 graph mode)
+            from .. import ndarray as F
+            pdata = {name: p.data() for name, p in self._reg_params.items()}
+            return self.hybrid_forward(F, *args, **pdata)
+        raise NotImplementedError(
+            f'{type(self).__name__} must implement forward')
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Reference block.py:1299 — serialize compiled graph + params.
+
+        Emits ``{path}-symbol.stablehlo`` (portable StableHLO bytes via
+        jax.export — the role of model-symbol.json) and
+        ``{path}-{epoch:04d}.params.npz``.
+        """
+        from ..model import save_ndarray_map
+        params = self.collect_params()
+        save_ndarray_map(f'{path}-{epoch:04d}.params.npz',
+                         {k: v.data() for k, v in params.items()})
+        if self._cached_graph and self._cached_graph._compiled:
+            try:
+                import jax
+                from jax import export as jexport
+                (key, jfn) = next(iter(self._cached_graph._compiled.items()))
+                # serialize with abstract args from the cache key
+                shapes, _ = key
+                main, aux = self._cached_graph._params()
+                args = (jax.ShapeDtypeStruct((2,), _np.uint32),
+                        tuple(jax.ShapeDtypeStruct(s, d) for s, d in shapes),
+                        tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                              for p in main),
+                        tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                              for p in aux))
+                exp = jexport.export(jax.jit(jfn))(*args)
+                with open(f'{path}-symbol.stablehlo', 'wb') as f:
+                    f.write(exp.serialize())
+            except Exception as e:  # serialization is best-effort
+                import logging
+                logging.warning('StableHLO export skipped: %s', e)
+        return f'{path}-symbol.stablehlo', f'{path}-{epoch:04d}.params.npz'
+
+
+class SymbolBlock(HybridBlock):
+    """Run an exported graph as a Block (reference block.py:1485).
+
+    Wraps a deserialized StableHLO executable; parameters load from the
+    params file.
+    """
+
+    def __init__(self, outputs=None, inputs=None, params=None):
+        super().__init__()
+        self._exported = outputs
+
+    @staticmethod
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None):
+        from jax import export as jexport
+        with open(symbol_file, 'rb') as f:
+            exp = jexport.deserialize(f.read())
+        block = SymbolBlock(outputs=exp)
+        if param_file:
+            from ..model import load_ndarray_map
+            block._loaded_params = load_ndarray_map(param_file, ctx=ctx)
+        return block
+
+    def forward(self, *args):
+        raise NotImplementedError(
+            'call the deserialized executable via .call_exported')
+
+    def call_exported(self, *flat_args):
+        return self._exported.call(*flat_args)

@@ -1,0 +1,4 @@
+"""``gluon.contrib`` (reference python/mxnet/gluon/contrib/)."""
+
+from . import estimator
+from . import cnn

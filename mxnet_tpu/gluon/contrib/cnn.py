@@ -1,0 +1,103 @@
+"""Contrib CNN layers (reference python/mxnet/gluon/contrib/cnn/conv_layers.py).
+
+DeformableConvolution: the data-dependent sampling is expressed as bilinear
+gathers (XLA gather), replacing the hand-written CUDA kernel
+(src/operator/contrib/deformable_convolution.cu).
+"""
+
+import jax.numpy as jnp
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ..nn import Conv2D
+from ...ndarray.ndarray import NDArray
+from ...ops.registry import Op, apply_op
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable conv v1 (reference contrib/cnn/conv_layers.py:44)."""
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, use_bias=True, in_channels=0,
+                 activation=None, weight_initializer=None,
+                 bias_initializer='zeros',
+                 offset_weight_initializer='zeros',
+                 offset_bias_initializer='zeros', **kwargs):
+        super().__init__(**kwargs)
+        k = kernel_size if isinstance(kernel_size, tuple) else \
+            (kernel_size, kernel_size)
+        self._k = k
+        self._strides = strides if isinstance(strides, tuple) else \
+            (strides, strides)
+        self._padding = padding if isinstance(padding, tuple) else \
+            (padding, padding)
+        self._channels = channels
+        self._use_bias = use_bias
+        self.offset_conv = Conv2D(
+            2 * k[0] * k[1] * num_deformable_group, kernel_size=k,
+            strides=self._strides, padding=self._padding,
+            weight_initializer=offset_weight_initializer,
+            bias_initializer=offset_bias_initializer)
+        self.weight = Parameter('weight',
+                                shape=(channels, in_channels, *k),
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        if use_bias:
+            self.bias = Parameter('bias', shape=(channels,),
+                                  init=bias_initializer,
+                                  allow_deferred_init=True)
+
+    def forward(self, x):
+        offsets = self.offset_conv(x)
+        if self.weight.shape[1] == 0:
+            w = list(self.weight.shape)
+            w[1] = x.shape[1]
+            self.weight.shape = tuple(w)
+            self.weight._finish_deferred_init()
+        if self._use_bias and self.bias._data is None:
+            self.bias._finish_deferred_init()
+        arrays = [x, offsets, self.weight.data()] + (
+            [self.bias.data()] if self._use_bias else [])
+        kh, kw = self._k
+        sh, sw = self._strides
+        ph, pw = self._padding
+
+        def fn(xr, off, w, *b):
+            n, c, h, wd = xr.shape
+            oh, ow = off.shape[2], off.shape[3]
+            xp = jnp.pad(xr, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+            # base sampling grid per kernel tap
+            ys = jnp.arange(oh) * sh
+            xs = jnp.arange(ow) * sw
+            out = jnp.zeros((n, self._channels, oh, ow), xr.dtype)
+            cols = []
+            for i in range(kh):
+                for j in range(kw):
+                    t = i * kw + j
+                    dy = off[:, 2 * t]
+                    dx = off[:, 2 * t + 1]
+                    yy = ys[None, :, None] + i + dy
+                    xx = xs[None, None, :] + j + dx
+                    y0 = jnp.clip(jnp.floor(yy), 0, h + 2 * ph - 2)
+                    x0 = jnp.clip(jnp.floor(xx), 0, wd + 2 * pw - 2)
+                    wy = yy - y0
+                    wx = xx - x0
+                    y0 = y0.astype(jnp.int32)
+                    x0 = x0.astype(jnp.int32)
+                    bidx = jnp.arange(n)[:, None, None]
+                    v = (xp[bidx, :, y0, x0] * ((1 - wy) * (1 - wx))[..., None]
+                         + xp[bidx, :, y0 + 1, x0] * (wy * (1 - wx))[..., None]
+                         + xp[bidx, :, y0, x0 + 1] * ((1 - wy) * wx)[..., None]
+                         + xp[bidx, :, y0 + 1, x0 + 1] * (wy * wx)[..., None])
+                    cols.append(v)  # (n, oh, ow, c)
+            col = jnp.stack(cols, axis=-1)  # (n, oh, ow, c, kh*kw)
+            col = col.reshape(n, oh, ow, c * kh * kw)
+            wmat = w.reshape(self._channels, c * kh * kw)
+            out = jnp.einsum('nhwk,ok->nohw', col, wmat)
+            if b:
+                out = out + b[0][None, :, None, None]
+            return out
+
+        op = Op('deformable_convolution', fn, differentiable=True)
+        return apply_op(op, arrays, fn, name='deformable_convolution')

@@ -1,0 +1,133 @@
+"""DataLoader (reference python/mxnet/gluon/data/dataloader.py).
+
+The reference forks worker processes that rebuild NDArrays over shared
+memory (dataloader.py:67-133, CPUSharedStorageManager). Here workers
+exchange plain numpy arrays (pickle over pipes) and the final device_put
+happens in the consumer — XLA stages the host→TPU copy asynchronously, which
+plays the role of pin_memory+copy streams. num_workers=0 is the
+synchronous path; num_workers>0 uses a multiprocessing pool with the
+dataset inherited by fork (zero-copy for mmap'd sources like RecordIO).
+"""
+
+import multiprocessing
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Reference dataloader.py:default_batchify_fn."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return NDArray(jnp.stack([d._data for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return array(data)
+
+
+def _as_host(data):
+    if isinstance(data, NDArray):
+        return data.asnumpy()
+    if isinstance(data, (list, tuple)):
+        return type(data)(_as_host(d) for d in data)
+    return data
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples):
+    """Fetch + batchify host-side in the worker."""
+    batch = [_worker_dataset[i] for i in samples]
+    if isinstance(batch[0], tuple):
+        cols = list(zip(*batch))
+        return tuple(_np.asarray([_as_host(c) for c in col]) for col in cols)
+    return _np.asarray([_as_host(b) for b in batch])
+
+
+class DataLoader:
+    """Reference dataloader.py:DataLoader."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError('batch_size must be specified unless '
+                                 'batch_sampler is specified')
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError('shuffle must not be specified if sampler '
+                                 'is specified')
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or 'keep')
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError('batch_size, shuffle, sampler and last_batch '
+                             'must not be specified if batch_sampler is '
+                             'specified.')
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+                self._pool = ThreadPool(self._num_workers,
+                                        initializer=_worker_init,
+                                        initargs=(dataset,))
+            else:
+                ctx = multiprocessing.get_context('fork')
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_init,
+                                      initargs=(dataset,))
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch])
+            return
+        # pipelined pool: keep `prefetch` batches in flight
+        results = []
+        it = iter(self._batch_sampler)
+        try:
+            for _ in range(self._prefetch):
+                results.append(self._pool.apply_async(_worker_fn,
+                                                      (next(it),)))
+        except StopIteration:
+            pass
+        while results:
+            res = results.pop(0)
+            try:
+                results.append(self._pool.apply_async(_worker_fn,
+                                                      (next(it),)))
+            except StopIteration:
+                pass
+            raw = res.get(self._timeout)
+            if isinstance(raw, tuple):
+                yield [array(r) for r in raw]
+            else:
+                yield array(raw)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,199 @@
+"""Vision datasets (reference python/mxnet/gluon/data/vision/datasets.py).
+
+Download-dependent datasets (MNIST/CIFAR) read from local files when
+present (MXNET_HOME/datasets, same layout as the reference); the zero-egress
+CI environment uses synthetic fallbacks in tests instead.
+"""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as _np
+
+from ....ndarray.ndarray import array
+from ..dataset import Dataset, RecordFileDataset, _DownloadedDataset
+
+
+def _data_home():
+    return os.environ.get('MXNET_HOME',
+                          os.path.join(os.path.expanduser('~'), '.mxnet'))
+
+
+class MNIST(_DownloadedDataset):
+    """Reference datasets.py:MNIST (idx-format files)."""
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        root = root or os.path.join(_data_home(), 'datasets', 'mnist')
+        self._train_data = ('train-images-idx3-ubyte.gz',)
+        self._train_label = ('train-labels-idx1-ubyte.gz',)
+        self._test_data = ('t10k-images-idx3-ubyte.gz',)
+        self._test_label = ('t10k-labels-idx1-ubyte.gz',)
+        super().__init__(root, transform)
+
+    def _read_idx(self, path):
+        opener = gzip.open if path.endswith('.gz') else open
+        if not os.path.exists(path) and path.endswith('.gz') and \
+                os.path.exists(path[:-3]):
+            path, opener = path[:-3], open
+        with opener(path, 'rb') as f:
+            _, _, ndim = struct.unpack('>HBB', f.read(4))
+            dims = struct.unpack('>' + 'I' * ndim, f.read(4 * ndim))
+            return _np.frombuffer(f.read(), dtype=_np.uint8).reshape(dims)
+
+    def _get_data(self):
+        data_file = (self._train_data if self._train else self._test_data)[0]
+        label_file = (self._train_label if self._train
+                      else self._test_label)[0]
+        data = self._read_idx(os.path.join(self._root, data_file))
+        label = self._read_idx(os.path.join(self._root, label_file))
+        self._data = array(data[..., None])
+        self._label = label.astype(_np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=None, train=True, transform=None):
+        root = root or os.path.join(_data_home(), 'datasets', 'fashion-mnist')
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """Reference datasets.py:CIFAR10 (python pickle batches)."""
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        root = root or os.path.join(_data_home(), 'datasets', 'cifar10')
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, 'rb') as f:
+            batch = pickle.load(f, encoding='bytes')
+        data = batch[b'data'].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        label = _np.array(batch.get(b'labels', batch.get(b'fine_labels')))
+        return data, label
+
+    def _get_data(self):
+        base = os.path.join(self._root, 'cifar-10-batches-py')
+        if not os.path.isdir(base):
+            tar = os.path.join(self._root, 'cifar-10-python.tar.gz')
+            if os.path.exists(tar):
+                with tarfile.open(tar) as t:
+                    t.extractall(self._root)
+        files = [f'data_batch_{i}' for i in range(1, 6)] if self._train \
+            else ['test_batch']
+        datas, labels = [], []
+        for fn in files:
+            d, l = self._read_batch(os.path.join(base, fn))
+            datas.append(d)
+            labels.append(l)
+        self._data = array(_np.concatenate(datas))
+        self._label = _np.concatenate(labels).astype(_np.int32)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root=None, fine_label=False, train=True,
+                 transform=None):
+        self._fine = fine_label
+        root = root or os.path.join(_data_home(), 'datasets', 'cifar100')
+        CIFAR10.__init__(self, root, train, transform)
+
+    def _get_data(self):
+        base = os.path.join(self._root, 'cifar-100-python')
+        files = ['train'] if self._train else ['test']
+        datas, labels = [], []
+        for fn in files:
+            d, l = self._read_batch(os.path.join(base, fn))
+            datas.append(d)
+            labels.append(l)
+        self._data = array(_np.concatenate(datas))
+        self._label = _np.concatenate(labels).astype(_np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images + labels from a RecordIO pack (reference
+    datasets.py:ImageRecordDataset; C++ src/io/dataset.cc
+    ImageRecordFileDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack
+        from ....image import imdecode
+        record = super().__getitem__(idx)
+        header, img_bytes = unpack(record)
+        img = imdecode(img_bytes, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """class-per-subfolder layout (reference datasets.py:ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = ['.jpg', '.jpeg', '.png', '.bmp']
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        img = imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class ImageListDataset(Dataset):
+    """Reference datasets.py:ImageListDataset (.lst format)."""
+
+    def __init__(self, root='.', imglist=None, flag=1):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self.items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for line in f:
+                    parts = line.strip().split('\t')
+                    label = float(parts[1]) if len(parts) == 3 else \
+                        [float(i) for i in parts[1:-1]]
+                    self.items.append((os.path.join(self._root, parts[-1]),
+                                       label))
+        else:
+            for entry in imglist or []:
+                self.items.append((os.path.join(self._root, entry[-1]),
+                                   entry[0] if len(entry) == 2
+                                   else list(entry[:-1])))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        img = imread(self.items[idx][0], self._flag)
+        return img, self.items[idx][1]
+
+    def __len__(self):
+        return len(self.items)

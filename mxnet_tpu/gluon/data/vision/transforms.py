@@ -1,0 +1,200 @@
+"""Vision transforms as HybridBlocks (reference
+python/mxnet/gluon/data/vision/transforms.py).
+"""
+
+import numpy as _np
+
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import HybridSequential, Sequential
+from ....ndarray.ndarray import NDArray, array
+from ....ops.registry import get_op, invoke
+
+
+def _op(name, *args, **kw):
+    return invoke(get_op(name), args, kw)
+
+
+class Compose(Sequential):
+    """Reference transforms.py:Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype='float32'):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference
+    transforms.py:ToTensor)."""
+
+    def forward(self, x):
+        x = x.astype('float32') / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """Channel-wise normalize of CHW input (reference
+    transforms.py:Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32).reshape(-1, 1, 1)
+        self._std = _np.asarray(std, dtype=_np.float32).reshape(-1, 1, 1)
+
+    def forward(self, x):
+        mean = array(self._mean, ctx=x._ctx)
+        std = array(self._std, ctx=x._ctx)
+        return (x - mean) / std
+
+
+class Resize(HybridBlock):
+    """Reference transforms.py:Resize (HWC input)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else \
+            (size, size)
+        self._keep = keep_ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        from ....image import imresize, resize_short
+        if self._keep:
+            return resize_short(x, min(self._size), self._interp)
+        return imresize(x, self._size[0], self._size[1], self._interp)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else \
+            (size, size)
+        self._interp = interpolation
+
+    def forward(self, x):
+        from ....image import center_crop
+        return center_crop(x, self._size, self._interp)[0]
+
+
+class RandomResizedCrop(Block):
+    """Reference transforms.py:RandomResizedCrop."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else \
+            (size, size)
+        self._scale = scale
+        self._ratio = ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        from ....image import fixed_crop
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            new_w = int(round(_np.sqrt(target_area * aspect)))
+            new_h = int(round(_np.sqrt(target_area / aspect)))
+            if new_w <= w and new_h <= h:
+                x0 = _np.random.randint(0, w - new_w + 1)
+                y0 = _np.random.randint(0, h - new_h + 1)
+                return fixed_crop(x, x0, y0, new_w, new_h, self._size,
+                                  self._interp)
+        from ....image import center_crop
+        return center_crop(x, self._size, self._interp)[0]
+
+
+class RandomFlipLeftRight(HybridBlock):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return _op('flip', x, axis=1 if x.ndim == 3 else 2)
+        return x
+
+
+class RandomFlipTopBottom(HybridBlock):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return _op('flip', x, axis=0 if x.ndim == 3 else 1)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        f = 1.0 + _np.random.uniform(-self._b, self._b)
+        return (x.astype('float32') * f).clip(0, 255)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        f = 1.0 + _np.random.uniform(-self._c, self._c)
+        x = x.astype('float32')
+        mean = x.mean()
+        return ((x - mean) * f + mean).clip(0, 255)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        f = 1.0 + _np.random.uniform(-self._s, self._s)
+        x = x.astype('float32')
+        gray = x.mean(axis=-1, keepdims=True)
+        return (x * f + gray * (1 - f)).clip(0, 255)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        for t in _np.random.permutation(len(self._ts)):
+            x = self._ts[t](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference transforms.py:RandomLighting)."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
+    _eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+
+    def __init__(self, alpha_std=0.05):
+        super().__init__()
+        self._std = alpha_std
+
+    def forward(self, x):
+        alpha = _np.random.normal(0, self._std, 3).astype(_np.float32)
+        rgb = (self._eigvec * alpha) @ self._eigval
+        return (x.astype('float32') + array(rgb)).clip(0, 255)

@@ -1,0 +1,6 @@
+"""``gluon.rnn`` (reference python/mxnet/gluon/rnn/)."""
+
+from .rnn_cell import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+                       DropoutCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell, HybridRecurrentCell, RecurrentCell)
+from .rnn_layer import RNN, LSTM, GRU

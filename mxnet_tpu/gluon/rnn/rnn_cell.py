@@ -1,0 +1,350 @@
+"""RNN cells (reference python/mxnet/gluon/rnn/rnn_cell.py).
+
+Cell-level API + ``unroll``. On TPU, unrolling uses ``lax.scan`` through the
+layer API (rnn_layer.py) for compiled loops; the Python unroll here matches
+the reference's step-by-step semantics for cell composition.
+"""
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ...ops.registry import get_op, invoke
+from ... import _tape
+
+
+def _op(name, *args, **kw):
+    return invoke(get_op(name), args, kw)
+
+
+class RecurrentCell(HybridBlock):
+    """Reference rnn_cell.py:RecurrentCell."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info['shape']
+            states.append(F.zeros(shape))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None, valid_length=None):
+        """Reference rnn_cell.py unroll."""
+        axis = layout.find('T')
+        batch_axis = layout.find('N')
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            batch = inputs.shape[batch_axis]
+            seq = [
+                inputs[(slice(None),) * axis + (t,)]
+                for t in range(length)]
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = _op('stack', *outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, inputs, states):
+        raise NotImplementedError
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    """Elman RNN cell (reference rnn_cell.py:RNNCell)."""
+
+    def __init__(self, hidden_size, activation='tanh', i2h_weight_initializer
+                 =None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = Parameter('i2h_weight',
+                                    shape=(hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter('h2h_weight',
+                                    shape=(hidden_size, hidden_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter('i2h_bias', shape=(hidden_size,),
+                                  init=i2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter('h2h_bias', shape=(hidden_size,),
+                                  init=h2h_bias_initializer,
+                                  allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (batch_size, self._hidden_size), '__layout__':
+                 'NC'}]
+
+    def _infer(self, x):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+            for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias,
+                      self.h2h_bias):
+                p._finish_deferred_init()
+
+    def forward(self, inputs, states):
+        self._infer(inputs)
+        i2h = _op('fully_connected', inputs, self.i2h_weight.data(),
+                  self.i2h_bias.data(), num_hidden=self._hidden_size)
+        h2h = _op('fully_connected', states[0], self.h2h_weight.data(),
+                  self.h2h_bias.data(), num_hidden=self._hidden_size)
+        out = _op('activation', i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    """Reference rnn_cell.py:LSTMCell (gate order i, f, c, o as in the
+    fused kernel src/operator/rnn_impl.h)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer='zeros',
+                 h2h_bias_initializer='zeros', input_size=0,
+                 activation='tanh', recurrent_activation='sigmoid',
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self.i2h_weight = Parameter('i2h_weight',
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter('h2h_weight',
+                                    shape=(4 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter('i2h_bias', shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter('h2h_bias', shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer,
+                                  allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (batch_size, self._hidden_size)},
+                {'shape': (batch_size, self._hidden_size)}]
+
+    def _infer(self, x):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+            for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias,
+                      self.h2h_bias):
+                p._finish_deferred_init()
+
+    def forward(self, inputs, states):
+        self._infer(inputs)
+        h = self._hidden_size
+        gates = _op('fully_connected', inputs, self.i2h_weight.data(),
+                    self.i2h_bias.data(), num_hidden=4 * h) + \
+            _op('fully_connected', states[0], self.h2h_weight.data(),
+                self.h2h_bias.data(), num_hidden=4 * h)
+        i = _op('sigmoid', gates[:, :h])
+        f = _op('sigmoid', gates[:, h:2 * h])
+        g = _op('tanh', gates[:, 2 * h:3 * h])
+        o = _op('sigmoid', gates[:, 3 * h:])
+        c = f * states[1] + i * g
+        out = o * _op('tanh', c)
+        return out, [out, c]
+
+
+class GRUCell(RecurrentCell):
+    """Reference rnn_cell.py:GRUCell (gate order r, z, n)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer='zeros',
+                 h2h_bias_initializer='zeros', input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self.i2h_weight = Parameter('i2h_weight',
+                                    shape=(3 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter('h2h_weight',
+                                    shape=(3 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter('i2h_bias', shape=(3 * hidden_size,),
+                                  init=i2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter('h2h_bias', shape=(3 * hidden_size,),
+                                  init=h2h_bias_initializer,
+                                  allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (batch_size, self._hidden_size)}]
+
+    def _infer(self, x):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+            for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias,
+                      self.h2h_bias):
+                p._finish_deferred_init()
+
+    def forward(self, inputs, states):
+        self._infer(inputs)
+        h = self._hidden_size
+        i2h = _op('fully_connected', inputs, self.i2h_weight.data(),
+                  self.i2h_bias.data(), num_hidden=3 * h)
+        h2h = _op('fully_connected', states[0], self.h2h_weight.data(),
+                  self.h2h_bias.data(), num_hidden=3 * h)
+        r = _op('sigmoid', i2h[:, :h] + h2h[:, :h])
+        z = _op('sigmoid', i2h[:, h:2 * h] + h2h[:, h:2 * h])
+        n = _op('tanh', i2h[:, 2 * h:] + r * h2h[:, 2 * h:])
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells (reference rnn_cell.py:SequentialRNNCell)."""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kwargs):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.begin_state(batch_size, **kwargs))
+        return out
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[p:p + n])
+            p += n
+            next_states.extend(st)
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = _op('dropout', inputs, p=self._rate, axes=self._axes,
+                         training=_tape.is_training())
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class ZoneoutCell(ModifierCell):
+    """Reference rnn_cell.py:ZoneoutCell."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+        if _tape.is_training():
+            def mix(p, new, old):
+                if p == 0.0 or old is None:
+                    return new
+                mask = _op('random_bernoulli', prob=1 - p, size=new.shape)
+                return mask * new + (1 - mask) * old
+            prev = self._prev_output
+            out = mix(self.zoneout_outputs, next_output, prev)
+            next_states = [mix(self.zoneout_states, ns, s)
+                           for ns, s in zip(next_states, states)]
+            self._prev_output = out
+            return out, next_states
+        return next_output, next_states
+
+
+class ResidualCell(ModifierCell):
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Reference rnn_cell.py:BidirectionalCell."""
+
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.l_cell.begin_state(batch_size, **kwargs) + \
+            self.r_cell.begin_state(batch_size, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None, valid_length=None):
+        axis = layout.find('T')
+        nl = len(self.l_cell.state_info())
+        states = begin_state if begin_state is not None else \
+            self.begin_state(inputs.shape[layout.find('N')])
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, states[:nl], layout, merge_outputs=False)
+        rev = _op('flip', inputs, axis=axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, states[nl:], layout, merge_outputs=False)
+        r_out = r_out[::-1]
+        outs = [_op('concatenate', l, r, axis=-1)
+                for l, r in zip(l_out, r_out)]
+        if merge_outputs:
+            outs = _op('stack', *outs, axis=axis)
+        return outs, l_states + r_states
+
+    def forward(self, inputs, states):
+        raise NotImplementedError('use unroll for BidirectionalCell')

@@ -1,0 +1,230 @@
+"""Fused multi-layer RNN/LSTM/GRU layers.
+
+Reference: ``python/mxnet/gluon/rnn/rnn_layer.py`` backed by the fused
+``_npx_rnn`` op with its cudnn path (src/operator/rnn.cc, rnn-inl.h). TPU
+design: the time loop is a ``lax.scan`` — XLA compiles it into a single
+fused while-loop with the gate matmuls batched on the MXU, which is the
+role cuDNN's fused RNN kernels played. Bidirectional runs a reversed scan;
+multi-layer stacks scans with optional inter-layer dropout.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ...ndarray.ndarray import NDArray
+from ...ops.registry import Op, apply_op
+from ... import _rng, _tape
+
+
+def _lstm_step(carry, x_t, wi, wh, bi, bh):
+    h, c = carry
+    gates = x_t @ wi.T + bi + h @ wh.T + bh
+    hid = h.shape[-1]
+    i, f, g, o = (gates[:, :hid], gates[:, hid:2 * hid],
+                  gates[:, 2 * hid:3 * hid], gates[:, 3 * hid:])
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+def _gru_step(carry, x_t, wi, wh, bi, bh):
+    (h,) = carry
+    hid = h.shape[-1]
+    gi = x_t @ wi.T + bi
+    gh = h @ wh.T + bh
+    r = jax.nn.sigmoid(gi[:, :hid] + gh[:, :hid])
+    z = jax.nn.sigmoid(gi[:, hid:2 * hid] + gh[:, hid:2 * hid])
+    n = jnp.tanh(gi[:, 2 * hid:] + r * gh[:, 2 * hid:])
+    h = (1 - z) * n + z * h
+    return (h,), h
+
+
+def _rnn_step_tanh(carry, x_t, wi, wh, bi, bh):
+    (h,) = carry
+    h = jnp.tanh(x_t @ wi.T + bi + h @ wh.T + bh)
+    return (h,), h
+
+
+def _rnn_step_relu(carry, x_t, wi, wh, bi, bh):
+    (h,) = carry
+    h = jax.nn.relu(x_t @ wi.T + bi + h @ wh.T + bh)
+    return (h,), h
+
+
+_STEPS = {'lstm': (_lstm_step, 2, 4), 'gru': (_gru_step, 1, 3),
+          'rnn_tanh': (_rnn_step_tanh, 1, 1),
+          'rnn_relu': (_rnn_step_relu, 1, 1)}
+
+
+class _RNNLayer(HybridBlock):
+    """Base fused layer (reference rnn_layer.py:_RNNLayer)."""
+
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer='zeros',
+                 h2h_bias_initializer='zeros', **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ('TNC', 'NTC')
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        _, self._num_states, ngates = _STEPS[mode]
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                suffix = '_l' if d == 0 else '_r'
+                in_size = input_size if layer == 0 else \
+                    hidden_size * self._dir
+                setattr(self, f'{suffix[1]}{layer}_i2h_weight', Parameter(
+                    f'{suffix[1]}{layer}_i2h_weight',
+                    shape=(ngates * hidden_size, in_size),
+                    init=i2h_weight_initializer, allow_deferred_init=True))
+                setattr(self, f'{suffix[1]}{layer}_h2h_weight', Parameter(
+                    f'{suffix[1]}{layer}_h2h_weight',
+                    shape=(ngates * hidden_size, hidden_size),
+                    init=h2h_weight_initializer, allow_deferred_init=True))
+                setattr(self, f'{suffix[1]}{layer}_i2h_bias', Parameter(
+                    f'{suffix[1]}{layer}_i2h_bias',
+                    shape=(ngates * hidden_size,),
+                    init=i2h_bias_initializer, allow_deferred_init=True))
+                setattr(self, f'{suffix[1]}{layer}_h2h_bias', Parameter(
+                    f'{suffix[1]}{layer}_h2h_bias',
+                    shape=(ngates * hidden_size,),
+                    init=h2h_bias_initializer, allow_deferred_init=True))
+
+    def _params_of(self, layer, d):
+        s = 'l' if d == 0 else 'r'
+        return [getattr(self, f'{s}{layer}_{n}') for n in
+                ('i2h_weight', 'h2h_weight', 'i2h_bias', 'h2h_bias')]
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}] * self._num_states
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        return [F.zeros((self._num_layers * self._dir, batch_size,
+                         self._hidden_size))
+                for _ in range(self._num_states)]
+
+    def _infer(self, x):
+        in_size = x.shape[-1]
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                wi, wh, bi, bh = self._params_of(layer, d)
+                if wi.shape[1] == 0:
+                    wi.shape = (wi.shape[0],
+                                in_size if layer == 0
+                                else self._hidden_size * self._dir)
+                for p in (wi, wh, bi, bh):
+                    if p._data is None:
+                        p._finish_deferred_init()
+
+    def forward(self, inputs, states=None):
+        self._infer(inputs)
+        layout = self._layout
+        batch_axis = layout.find('N')
+        batch = inputs.shape[batch_axis]
+        return_states = states is not None
+        if states is None:
+            states = self.begin_state(batch)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+
+        step_fn, n_states, _ = _STEPS[self._mode]
+        n_layers, n_dir, hid = self._num_layers, self._dir, self._hidden_size
+        dropout = self._dropout if _tape.is_training() else 0.0
+        key = _rng.next_key() if dropout else None
+
+        params = []
+        for layer in range(n_layers):
+            for d in range(n_dir):
+                params.extend(p.data() for p in self._params_of(layer, d))
+
+        arrays = [inputs] + [s for s in states] + params
+        n_in = 1 + len(states)
+
+        def fn(*raws):
+            x = raws[0]
+            st = raws[1:n_in]
+            ps = raws[n_in:]
+            if layout == 'NTC':
+                x = jnp.swapaxes(x, 0, 1)  # scan over time-major
+            out = x
+            final_states = [[] for _ in range(n_states)]
+            pi = 0
+            for layer in range(n_layers):
+                outs_dir = []
+                for d in range(n_dir):
+                    wi, wh, bi, bh = ps[pi:pi + 4]
+                    pi += 4
+                    idx = layer * n_dir + d
+                    init = tuple(st[k][idx] for k in range(n_states))
+                    seq = out if d == 0 else jnp.flip(out, 0)
+                    carry, ys = lax.scan(
+                        lambda c, xt: step_fn(c, xt, wi, wh, bi, bh),
+                        init, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs_dir.append(ys)
+                    for k in range(n_states):
+                        final_states[k].append(carry[k])
+                out = outs_dir[0] if n_dir == 1 else \
+                    jnp.concatenate(outs_dir, axis=-1)
+                if dropout and layer < n_layers - 1:
+                    mask = jax.random.bernoulli(
+                        jax.random.fold_in(key, layer), 1 - dropout,
+                        out.shape)
+                    out = jnp.where(mask, out / (1 - dropout), 0.0)
+            if layout == 'NTC':
+                out = jnp.swapaxes(out, 0, 1)
+            finals = [jnp.stack(fs) for fs in final_states]
+            return tuple([out] + finals)
+
+        op = Op(f'_rnn_{self._mode}', fn, differentiable=True)
+        res = apply_op(op, arrays, fn, name=f'rnn_{self._mode}')
+        out, new_states = res[0], list(res[1:])
+        if return_states:
+            return out, new_states
+        return out
+
+    def __repr__(self):
+        return (f'{type(self).__name__}({self._hidden_size}, '
+                f'num_layers={self._num_layers})')
+
+
+class RNN(_RNNLayer):
+    """Reference rnn_layer.py:RNN."""
+
+    def __init__(self, hidden_size, num_layers=1, activation='tanh',
+                 layout='TNC', dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        super().__init__(f'rnn_{activation}', hidden_size, num_layers,
+                         layout, dropout, bidirectional, input_size,
+                         **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Reference rnn_layer.py:LSTM."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__('lstm', hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    """Reference rnn_layer.py:GRU."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__('gru', hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

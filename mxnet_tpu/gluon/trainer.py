@@ -1,0 +1,250 @@
+"""``gluon.Trainer`` — bridges Parameters ↔ KVStore ↔ Optimizer.
+
+Reference: ``python/mxnet/gluon/trainer.py`` (_init_kvstore:188, step:334,
+_allreduce_grads:385, _update:444, save_states:482). Semantics preserved:
+``step(batch_size)`` = gradient aggregation (kvstore pushpull across device
+replicas / hosts) + per-parameter optimizer update. On TPU the per-key
+priority scheduling (priority=-i for comm/compute overlap) is a no-op —
+XLA's async collectives already overlap — but the argument is accepted.
+"""
+
+from ..kvstore import create as _create_kvstore
+from ..kvstore.base import KVStoreBase
+from .. import optimizer as opt
+from .parameter import Parameter
+from ..ndarray.ndarray import NDArray
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore
+                 ='device', compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError('params must be a dict/list of Parameters')
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(f'invalid parameter {param}')
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_params = {
+            'kvstore': kvstore, 'update_on_kvstore': update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    # ----------------------------------------------------------------- setup
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data is not None or \
+                param._deferred_init is not None else None
+            if ctx is None:
+                continue
+            assert contexts is None or contexts == ctx, (
+                f'All Parameters must be initialized on the same set of '
+                f'contexts, but Parameter {param.name} is on {ctx} while '
+                f'previous ones are on {contexts}.')
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                'optimizer_params must be None if optimizer is an instance'
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._states = {}
+
+    def _reset_kvstore(self):
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = list(self._params)
+
+    def _init_kvstore(self):
+        """Reference trainer.py:188 — decides kvstore type +
+        update_on_kvstore. Here: multi-worker → dist_tpu_sync allreduce
+        (never server-side updates: there are no servers)."""
+        config = self._kvstore_params
+        kv = config['kvstore']
+        if kv is None or kv == '' or not self._contexts:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            self._kvstore = kv if isinstance(kv, KVStoreBase) else \
+                _create_kvstore(kv)
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
+            self._update_on_kvstore = bool(config['update_on_kvstore']) \
+                if config['update_on_kvstore'] is not None else False
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    def _init_params(self):
+        """Broadcast initial params across workers (reference
+        trainer.py:_init_params)."""
+        params_to_init = []
+        for param in self._params_to_init:
+            if param._deferred_init is not None and param._data is None:
+                params_to_init.append(param)
+            elif self._kvstore is not None and param._data is not None:
+                idx = self._param2idx[param.name]
+                vals = param.list_data()
+                self._kvstore.broadcast(idx, vals[0], vals)
+        self._params_to_init = params_to_init
+
+    # ------------------------------------------------------------ properties
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------ step
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Reference trainer.py:334."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._update_on_kvstore and self._kv_initialized and \
+                self._kvstore is not None:
+            if self._optimizer.rescale_grad != scale:
+                raise UserWarning(
+                    'Possible change in the `batch_size` from previous '
+                    '`step` detected.')
+        self._optimizer.rescale_grad = scale
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        """Reference trainer.py:385 — per-param pushpull, priority −i."""
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != 'null':
+                grads = param.list_grad()
+                if not grads:
+                    continue
+                if self._update_on_kvstore:
+                    # server-side update: fresh weights land in the param
+                    # arrays directly (reference trainer.py:385 out=data)
+                    self._kvstore.pushpull(i, grads,
+                                           out=param.list_data(),
+                                           priority=-i)
+                else:
+                    self._kvstore.pushpull(i, grads, priority=-i)
+
+    def _update(self, ignore_stale_grad=False):
+        """Reference trainer.py:444 — run optimizer per device replica."""
+        if self._update_on_kvstore:
+            return  # server-side update already applied by pushpull
+        for i, param in enumerate(self._params):
+            if param.grad_req == 'null' or param._data is None:
+                continue
+            if i not in self._states:
+                self._states[i] = self._optimizer.create_state_multi_precision(
+                    i, param.data())
+            datas = param.list_data()
+            grads = param.list_grad()
+            # after allreduce all replicas hold the same grad; update the
+            # first replica then mirror (one optimizer step per param)
+            self._optimizer.update_multi_precision(
+                i, datas[0], grads[0], self._states[i])
+            for d in datas[1:]:
+                d._rebind(datas[0]._data)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Manual update path (reference trainer.py:update)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not self._update_on_kvstore, \
+            'update() cannot be called when update_on_kvstore is set'
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    # ------------------------------------------------------------ save / load
+    def save_states(self, fname):
+        """Reference trainer.py:482 (pickled updater states)."""
+        import pickle
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            # optimizer state lives in the kvstore updater in this mode
+            # (reference trainer.py:482 warns it's rank-local)
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=False)
+            return
+        with open(fname, 'wb') as f:
+            states = {i: _state_to_host(s) for i, s in self._states.items()}
+            pickle.dump((states, self._optimizer.num_update), f)
+
+    def load_states(self, fname):
+        """Reference trainer.py:511."""
+        import pickle
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            return
+        with open(fname, 'rb') as f:
+            states, num_update = pickle.load(f)
+        self._states = {i: _state_from_host(s) for i, s in states.items()}
+        self._optimizer.num_update = num_update
+
+
+def _state_to_host(state):
+    import numpy as _np
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state.asnumpy()
+    if isinstance(state, (list, tuple)):
+        return tuple(_state_to_host(s) for s in state)
+    return state
+
+
+def _state_from_host(state):
+    import numpy as _np
+    from ..ndarray.ndarray import array
+    if state is None:
+        return None
+    if isinstance(state, _np.ndarray):
+        return array(state)
+    if isinstance(state, tuple):
+        return tuple(_state_from_host(s) for s in state)
+    return state

@@ -1,0 +1,97 @@
+"""``gluon.utils`` (reference python/mxnet/gluon/utils.py)."""
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray, array
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Reference utils.py:split_data."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f'data with shape {data.shape} cannot be evenly split into '
+            f'{num_slice} slices along axis {batch_axis}.')
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Reference utils.py:split_and_load — see also
+    mxnet_tpu.parallel.split_and_load for the mesh-sharded form."""
+    if not isinstance(data, NDArray):
+        data = array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Reference utils.py:clip_global_norm."""
+    import jax.numpy as jnp
+    assert len(arrays) > 0
+    total = jnp.sqrt(sum(jnp.sum(a._data.astype(jnp.float32) ** 2)
+                         for a in arrays))
+    total_norm = float(total)
+    if check_isfinite and not _np.isfinite(total_norm):
+        import warnings
+        warnings.warn('nan or inf is detected. Clipping results will be '
+                      'undefined.', stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._rebind(arr._data * scale)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, 'rb') as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Reference utils.py:download. No egress in CI — raises with a clear
+    message when the network is unavailable."""
+    import os
+    import urllib.request
+    fname = path or url.split('/')[-1]
+    if os.path.isdir(fname):
+        fname = os.path.join(fname, url.split('/')[-1])
+    if not overwrite and os.path.exists(fname) and (
+            not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    try:
+        urllib.request.urlretrieve(url, fname)
+    except Exception as e:
+        raise OSError(
+            f'Failed to download {url} (offline environment?). Place the '
+            f'file at {fname} manually.') from e
+    return fname
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s is not None and s > 0 for s in shape)
+
+
+def _indent(s, num_spaces):
+    lines = s.split('\n')
+    first = lines.pop(0)
+    return first + '\n'.join(' ' * num_spaces + line for line in lines)

@@ -1,0 +1,92 @@
+"""``mx.image`` — image I/O and augmentation.
+
+Reference: ``python/mxnet/image/image.py`` (ImageIter:1285 + augmenters) and
+the C++ decode path (src/io/image_aug_default.cc). Decode runs host-side
+(cv2/PIL); augmentation ops run as registered ops so they can execute on
+device inside the input pipeline.
+"""
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray, array
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Reference image.py:imread."""
+    try:
+        import cv2
+        img = cv2.imread(filename, flag)
+        if img is None:
+            raise OSError(f'cannot read {filename}')
+        if to_rgb and img.ndim == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    except ImportError:
+        from PIL import Image
+        img = _np.asarray(Image.open(filename).convert(
+            'RGB' if flag else 'L'))
+    return array(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Reference image.py:imdecode."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    try:
+        import cv2
+        img = cv2.imdecode(_np.frombuffer(buf, dtype=_np.uint8), flag)
+        if to_rgb and img is not None and img.ndim == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    except ImportError:
+        import io
+        from PIL import Image
+        img = _np.asarray(Image.open(io.BytesIO(buf)))
+    return array(img)
+
+
+def imresize(src, w, h, interp=1):
+    import jax.image
+    raw = src._data if isinstance(src, NDArray) else src
+    method = {0: 'nearest', 1: 'linear', 2: 'cubic'}.get(interp, 'linear')
+    out = jax.image.resize(raw.astype('float32'), (h, w) + tuple(
+        raw.shape[2:]), method)
+    return NDArray(out)
+
+
+def resize_short(src, size, interp=2):
+    """Reference image.py:resize_short."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size if isinstance(size, (tuple, list)) else (size, size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size if isinstance(size, (tuple, list)) else (size, size)
+    x0 = _np.random.randint(0, max(w - new_w, 0) + 1)
+    y0 = _np.random.randint(0, max(h - new_h, 0) + 1)
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src

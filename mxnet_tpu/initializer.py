@@ -1,0 +1,237 @@
+"""``mx.init`` — weight initializers (reference python/mxnet/initializer.py).
+
+Initialization happens host-side with numpy (as the reference effectively
+does), then lands on the Context device when the Parameter materializes.
+"""
+
+import math
+
+import numpy as _np
+
+from .base import register, registry_create
+
+
+class InitDesc(str):
+    """Name+attrs descriptor passed to initializers (reference
+    initializer.py:InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer (reference initializer.py:Initializer)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            desc = InitDesc('weight')
+        name = desc.lower() if isinstance(desc, str) else 'weight'
+        init_hint = desc.attrs.get('__init__', '') if hasattr(desc, 'attrs') \
+            else ''
+        if init_hint:
+            create(init_hint)._init_weight(desc, arr)
+        elif name.endswith('bias') or name.endswith('beta') or \
+                name.endswith('running_mean') or name.endswith('moving_mean'):
+            self._init_zero(desc, arr)
+        elif name.endswith('gamma') or name.endswith('running_var') or \
+                name.endswith('moving_var'):
+            self._init_one(desc, arr)
+        else:
+            self._init_weight(desc, arr)
+
+    def init_weight(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def _set(self, arr, value):
+        from .ndarray.ndarray import array
+        arr._rebind(array(value.astype(_np.dtype(arr.dtype)),
+                          ctx=arr._ctx)._data)
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, _np.zeros(arr.shape))
+
+    def _init_one(self, desc, arr):
+        self._set(arr, _np.ones(arr.shape))
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f'{type(self).__name__}({self._kwargs})'
+
+
+register = register(Initializer)
+
+
+def create(name, **kwargs):
+    return registry_create(Initializer, name, **kwargs)
+
+
+@register('zeros')
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_zero(desc, arr)
+
+
+@register('ones')
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_one(desc, arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np.full(arr.shape, self.value))
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference initializer.py:Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np.random.uniform(-self.scale, self.scale,
+                                          arr.shape))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np.random.normal(0, self.sigma, arr.shape))
+
+
+def _fans(shape, factor_type='avg'):
+    hw = 1
+    for d in shape[2:]:
+        hw *= d
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    fan_out = shape[0] * hw
+    return fan_in, fan_out
+
+
+@register
+class Xavier(Initializer):
+    """Reference initializer.py:Xavier (aka Glorot)."""
+
+    def __init__(self, rnd_type='uniform', factor_type='avg', magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        fan_in, fan_out = _fans(arr.shape)
+        if self.factor_type == 'avg':
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == 'in':
+            factor = fan_in
+        elif self.factor_type == 'out':
+            factor = fan_out
+        else:
+            raise ValueError('Incorrect factor type')
+        scale = math.sqrt(self.magnitude / max(factor, 1))
+        if self.rnd_type == 'uniform':
+            w = _np.random.uniform(-scale, scale, arr.shape)
+        elif self.rnd_type == 'gaussian':
+            w = _np.random.normal(0, scale, arr.shape)
+        else:
+            raise ValueError('Unknown random type')
+        self._set(arr, w)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Reference initializer.py:MSRAPrelu (He init)."""
+
+    def __init__(self, factor_type='avg', slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__('gaussian', factor_type, magnitude)
+        self._kwargs = {'factor_type': factor_type, 'slope': slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type='uniform'):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == 'uniform':
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        self._set(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference initializer.py:Bilinear)."""
+
+    def _init_weight(self, desc, arr):
+        weight = _np.zeros(arr.size)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(arr.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference initializer.py:LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = _np.zeros(arr.shape)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+
+@register
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        import re
+        super().__init__()
+        self.map = [(re.compile(p), init)
+                    for p, init in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError(f'no initializer matches {name}')
+
+
+Load = dict  # placeholder for reference's Load initializer (checkpoint warm-start)

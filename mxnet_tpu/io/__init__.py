@@ -1,0 +1,316 @@
+"""``mx.io`` — legacy DataIter interface.
+
+Reference: ``python/mxnet/io/io.py`` + C++ iterators (src/io/,
+MXNET_REGISTER_IO_ITER). The Gluon DataLoader (gluon/data) is the primary
+pipeline; these iterators remain for reference-API compatibility and wrap
+host numpy/RecordIO sources.
+"""
+
+import collections
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray, array
+
+DataDesc = collections.namedtuple('DataDesc', ['name', 'shape', 'dtype',
+                                               'layout'])
+DataDesc.__new__.__defaults__ = (_np.float32, 'NCHW')
+
+
+class DataBatch:
+    """One batch (reference io.py:DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (reference io.py:DataIter; C++ IIterator<DataBatch>
+    include/mxnet/io.h:43)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference io.py:NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle='pad', data_name='data',
+                 label_name='softmax_label'):
+        super().__init__(batch_size)
+        self.data = self._init_data(data, data_name)
+        self.label = self._init_data(label, label_name, allow_empty=True)
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self.idx = _np.arange(self.num_data)
+        if shuffle:
+            _np.random.shuffle(self.idx)
+
+    @staticmethod
+    def _init_data(data, default_name, allow_empty=False):
+        if data is None:
+            assert allow_empty
+            return []
+        if isinstance(data, (NDArray, _np.ndarray)):
+            data = [(default_name, data)]
+        elif isinstance(data, (list, tuple)):
+            data = [(f'{default_name}_{i}' if i else default_name, d)
+                    for i, d in enumerate(data)]
+        elif isinstance(data, dict):
+            data = list(data.items())
+        out = []
+        for name, arr in data:
+            if isinstance(arr, NDArray):
+                arr = arr.asnumpy()
+            out.append((name, _np.asarray(arr)))
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc(name, (self.batch_size,) + arr.shape[1:], arr.dtype)
+                for name, arr in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(name, (self.batch_size,) + arr.shape[1:], arr.dtype)
+                for name, arr in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == 'roll_over':
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        end = self.cursor + self.batch_size
+        out = []
+        for _, arr in arrays:
+            chunk = arr[self.idx[self.cursor:min(end, self.num_data)]]
+            if end > self.num_data and self.last_batch_handle == 'pad':
+                pad = end - self.num_data
+                chunk = _np.concatenate([chunk, arr[self.idx[:pad]]], axis=0)
+            out.append(array(chunk))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == 'pad' and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize the epoch length of an iterator (reference io.py:ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Double-buffering wrapper (reference io.py:PrefetchingIter; C++
+    PrefetcherIter src/io/iter_prefetcher.h). A background thread stays one
+    batch ahead — host decode overlaps device compute."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import queue
+        import threading
+        self.iters = iters if isinstance(iters, list) else [iters]
+        super().__init__(self.iters[0].batch_size)
+        self._queue = queue.Queue(maxsize=2)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        import threading
+
+        def worker():
+            try:
+                for batch in self.iters[0]:
+                    if self._stop.is_set():
+                        return
+                    self._queue.put(batch)
+            finally:
+                self._queue.put(None)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        while self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except Exception:
+                pass
+            self._thread.join(timeout=0.01)
+        self._stop.clear()
+        self.iters[0].reset()
+        self._start()
+
+    def __next__(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    next = __next__
+
+    def iter_next(self):
+        try:
+            self._batch = self.__next__()
+            return True
+        except StopIteration:
+            return False
+
+
+def CSVIter(data_csv, data_shape, label_csv=None, label_shape=(1,),
+            batch_size=1, **kwargs):
+    """Reference src/io/iter_csv.cc — host-side CSV load into NDArrayIter."""
+    data = _np.loadtxt(data_csv, delimiter=',').reshape((-1,) + tuple(
+        data_shape))
+    label = None
+    if label_csv is not None:
+        label = _np.loadtxt(label_csv, delimiter=',').reshape(
+            (-1,) + tuple(label_shape))
+    return NDArrayIter(data, label, batch_size=batch_size, **kwargs)
+
+
+def MNISTIter(image, label, batch_size=1, shuffle=True, flat=False,
+              silent=False, seed=0, **kwargs):
+    """Reference src/io/iter_mnist.cc — reads idx-format MNIST files."""
+    import gzip
+    import struct
+
+    def read_idx(path):
+        opener = gzip.open if path.endswith('.gz') else open
+        with opener(path, 'rb') as f:
+            magic = struct.unpack('>HBB', f.read(4))
+            ndim = magic[2]
+            dims = struct.unpack('>' + 'I' * ndim, f.read(4 * ndim))
+            return _np.frombuffer(f.read(), dtype=_np.uint8).reshape(dims)
+
+    images = read_idx(image).astype(_np.float32) / 255.0
+    labels = read_idx(label).astype(_np.float32)
+    if flat:
+        images = images.reshape(images.shape[0], -1)
+    else:
+        images = images[:, None, :, :]
+    return NDArrayIter(images, labels, batch_size=batch_size,
+                       shuffle=shuffle, **kwargs)
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
+                    shuffle=False, path_imgidx=None, **kwargs):
+    """Reference src/io/iter_image_recordio_2.cc — RecordIO image batches.
+
+    Python decode path; the gluon ImageRecordDataset + DataLoader is the
+    performant pipeline.
+    """
+    from ..gluon.data.vision.datasets import ImageRecordDataset
+    from ..gluon.data import DataLoader
+
+    ds = ImageRecordDataset(path_imgrec)
+
+    class _Iter(DataIter):
+        def __init__(self):
+            super().__init__(batch_size)
+            self._loader = DataLoader(ds, batch_size=batch_size,
+                                      shuffle=shuffle, last_batch='discard')
+            self._it = iter(self._loader)
+
+        def reset(self):
+            self._it = iter(self._loader)
+
+        def __next__(self):
+            img, lab = next(self._it)
+            img = img.transpose((0, 3, 1, 2)).astype('float32')
+            return DataBatch(data=[img], label=[lab], pad=0)
+
+        next = __next__
+
+    return _Iter()

@@ -1,0 +1,24 @@
+"""``mx.kvstore`` — distributed key-value parameter synchronization.
+
+Reference: include/mxnet/kvstore.h:59 + src/kvstore/ (local/device comms,
+NCCL, ps-lite dist_sync servers — SURVEY §2.1 KVStore row). TPU re-design
+(SURVEY §2.3): the parameter-server stack is replaced wholesale by XLA
+collectives. ``local``/``device`` aggregate across in-process device copies;
+``dist_tpu_sync`` allreduces across hosts over ICI/DCN via
+``jax.distributed`` + psum — no server processes, no ZMQ, no NCCL. The
+KVStore *API* (init/push/pull/pushpull/broadcast/rank/num_workers/barrier +
+the optimizer/updater hooks) is preserved so Trainer and reference example
+code run unchanged.
+"""
+
+from .base import KVStoreBase
+from .kvstore import KVStore, KVStoreLocal
+from .tpu import KVStoreTPUSync, Horovod, BytePS
+
+
+def create(name='local'):
+    """Factory (reference src/kvstore/kvstore.cc:42 KVStore::Create +
+    python/mxnet/kvstore/kvstore.py create)."""
+    if not isinstance(name, str):
+        raise TypeError('name must be a string')
+    return KVStoreBase.get_kvstore(name)

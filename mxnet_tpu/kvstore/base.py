@@ -1,0 +1,67 @@
+"""KVStore plugin registry (reference python/mxnet/kvstore/base.py:249,432).
+
+Backends register by name; ``create('horovod')`` etc. resolve here — the
+same surface the reference exposes so external backends can plug in.
+"""
+
+KVSTORE_REGISTRY = {}
+
+
+def register(klass):
+    """Register a KVStoreBase subclass (reference kvstore/base.py:432)."""
+    name = getattr(klass, 'NAME', klass.__name__).lower()
+    KVSTORE_REGISTRY[name] = klass
+    return klass
+
+
+class KVStoreBase:
+    """Abstract KVStore (reference kvstore/base.py:249).
+
+    Methods mirror include/mxnet/kvstore.h: broadcast ≙ Init+Pull (:105,187),
+    pushpull ≙ PushPull (:237), plus the classic push/pull split.
+    """
+
+    @staticmethod
+    def register(klass):
+        return register(klass)
+
+    @staticmethod
+    def get_kvstore(name):
+        name = name.lower()
+        # reference type-string aliases (src/kvstore/kvstore.cc:42-85)
+        aliases = {
+            'local_allreduce_cpu': 'local',
+            'local_allreduce_device': 'device',
+            'nccl': 'device',
+            'dist': 'dist_tpu_sync',
+            'dist_sync': 'dist_tpu_sync',
+            'dist_async': 'dist_tpu_sync',
+            'dist_sync_device': 'dist_tpu_sync',
+            'dist_device_sync': 'dist_tpu_sync',
+        }
+        name = aliases.get(name, name)
+        if name not in KVSTORE_REGISTRY:
+            raise ValueError(
+                f'Unknown KVStore type {name!r}; registered: '
+                f'{sorted(KVSTORE_REGISTRY)}')
+        return KVSTORE_REGISTRY[name]()
+
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        raise NotImplementedError
+
+    @property
+    def num_workers(self):
+        raise NotImplementedError
+
+    OPTIMIZER = 'optimizer'

@@ -1,0 +1,166 @@
+"""In-process KVStore types: ``local`` and ``device``.
+
+Reference: src/kvstore/kvstore_local.h:70 + comm.h (CommCPU :104 /
+CommDevice :452 — the GPU reduce trees). On TPU a single process owns all
+local chips; "reduce across device copies" is one stacked jnp.sum that XLA
+executes with on-chip ICI transfers, so CommDevice/CommDeviceTree collapse
+into one fused reduction. The updater/optimizer hooks
+(set_updater/set_optimizer, include/mxnet/kvstore.h:297) are preserved.
+"""
+
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase, register
+
+
+def _group(keys, values):
+    """Group possibly-flat (key, value) lists by key
+    (reference kvstore_local.h GroupKVPairs)."""
+    if not isinstance(keys, (list, tuple)):
+        return [(keys, values if isinstance(values, (list, tuple))
+                 else [values])]
+    if len(keys) == len(values) and not any(
+            isinstance(v, (list, tuple)) for v in values):
+        merged = {}
+        order = []
+        for k, v in zip(keys, values):
+            if k not in merged:
+                merged[k] = []
+                order.append(k)
+            merged[k].append(v)
+        return [(k, merged[k]) for k in order]
+    return [(k, v if isinstance(v, (list, tuple)) else [v])
+            for k, v in zip(keys, values)]
+
+
+def _reduce(values):
+    """Sum a list of NDArray replicas (CommDevice::Reduce, comm.h:452)."""
+    if len(values) == 1:
+        return values[0]._data
+    return jnp.sum(jnp.stack([v._data for v in values]), axis=0)
+
+
+@register
+class KVStoreLocal(KVStoreBase):
+    """Reference kvstore_local.h:70 — single-process aggregation."""
+
+    NAME = 'local'
+
+    def __init__(self):
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._states = {}
+
+    # ------------------------------------------------------- classic surface
+    def init(self, key, value):
+        for k, vals in _group(key, value):
+            self._store[k] = NDArray(vals[0]._data, ctx=vals[0]._ctx)
+
+    def push(self, key, value, priority=0):
+        for k, vals in _group(key, value):
+            merged = _reduce(vals)
+            if self._updater is not None and k in self._store:
+                self._updater(k, NDArray(merged), self._store[k])
+            elif k in self._store:
+                self._store[k]._rebind(self._store[k]._data + merged)
+            else:
+                self._store[k] = NDArray(merged)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        for k, outs in _group(key, out):
+            src = self._store[k]
+            for o in outs:
+                o._rebind(src._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (reference PushPullDefault kvstore_dist.h:578).
+
+        Without an updater this is a pure allreduce: out ← sum(value).
+        """
+        for k, vals in _group(key, value):
+            merged = _reduce(vals)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise ValueError(
+                        f'pushpull with an updater requires key {k!r} to be '
+                        'initialized first (init/broadcast), matching the '
+                        'reference KVStore contract')
+                self._updater(k, NDArray(merged), self._store[k])
+                result = self._store[k]._data
+            else:
+                result = merged
+            if out is not None:
+                outs = [o for kk, os in _group(key, out) if kk == k
+                        for o in os]
+                for o in outs:
+                    o._rebind(result)
+            else:
+                for v in vals:
+                    v._rebind(result)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull degrades to dense pull until the sparse module lands
+        (the reference itself falls back widely — src/common/exec_utils.h)."""
+        self.pull(key, out=out, priority=priority)
+
+    # ------------------------------------------------------ optimizer hooks
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        from ..optimizer import get_updater
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit PS compression (gradient_compression.h:37) has no role on
+        ICI allreduce; accepted for compatibility."""
+
+    # ------------------------------------------------------------- topology
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        pass
+
+    @property
+    def type(self):
+        return self.NAME
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, 'updater is not initialized'
+        with open(fname, 'wb') as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, 'updater is not initialized'
+        with open(fname, 'rb') as f:
+            self._updater.set_states(f.read())
+
+    @staticmethod
+    def is_capable(capability):
+        return capability.lower() in ('optimizer', 'init')
+
+
+@register
+class KVStoreDevice(KVStoreLocal):
+    """Reference 'device' type: aggregation on-accelerator (CommDevice).
+    Identical here — the reduce already runs on TPU."""
+
+    NAME = 'device'
+
+
+KVStore = KVStoreLocal  # classic class name (python/mxnet/kvstore/kvstore.py)

@@ -1,0 +1,126 @@
+"""``dist_tpu_sync`` — multi-host KVStore over XLA collectives.
+
+This is the BASELINE.json north-star component: the replacement for the
+entire ps-lite stack (kvstore_dist.h:44, kvstore_dist_server.h:155 — worker/
+server/scheduler processes, ZMQ vans, explicit key sharding). Design:
+
+* one JAX process per host, joined via ``jax.distributed.initialize``
+  (rendezvous ≙ the reference's DMLC_PS_ROOT_URI env protocol, but handled
+  by the TPU runtime);
+* ``pushpull`` = a jitted global mean/sum over all processes' arrays —
+  lowered by XLA to an ICI allreduce within a slice and DCN collectives
+  across slices. There are no servers: every host holds the full reduced
+  value afterwards (allreduce-DP, the Horovod topology, but on ICI).
+* sync is implicit in SPMD — ``barrier`` maps to a trivial collective.
+
+Single-process fallback: with one process this degrades exactly to
+KVStoreLocal semantics, so CI (8 virtual CPU devices) exercises the same
+code path the pod runs.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+from .base import register
+from .kvstore import KVStoreLocal, _group, _reduce
+
+
+@register
+class KVStoreTPUSync(KVStoreLocal):
+    """dist_tpu_sync / dist_sync: cross-host synchronous allreduce."""
+
+    NAME = 'dist_tpu_sync'
+
+    def __init__(self):
+        super().__init__()
+        self._nproc = jax.process_count()
+        self._mesh = None
+        if self._nproc > 1:
+            devs = jax.devices()
+            self._mesh = jax.sharding.Mesh(devs, ('dp',))
+
+    def _allreduce(self, local_sum):
+        """Global sum across processes: per-process partial sums are placed
+        on a global mesh and reduced by one XLA collective."""
+        if self._nproc == 1:
+            return local_sum
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(local_sum).sum(axis=0)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        for k, vals in _group(key, value):
+            merged = self._allreduce(_reduce(vals))
+            if self._updater is not None:
+                if k not in self._store:
+                    raise ValueError(
+                        f'pushpull with an updater requires key {k!r} to be '
+                        'initialized first (init/broadcast)')
+                self._updater(k, NDArray(merged), self._store[k])
+                result = self._store[k]._data
+            else:
+                result = merged
+            targets = ([o for kk, os in _group(key, out) if kk == k
+                        for o in os] if out is not None else vals)
+            for t in targets:
+                t._rebind(result)
+
+    def push(self, key, value, priority=0):
+        for k, vals in _group(key, value):
+            merged = self._allreduce(_reduce(vals))
+            if self._updater is not None and k in self._store:
+                self._updater(k, NDArray(merged), self._store[k])
+            else:
+                self._store[k] = NDArray(merged)
+
+    def broadcast(self, key, value, out, priority=0):
+        """Rank-0's value wins (reference KVStoreDist::Init semantics)."""
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+            for k, vals in _group(key, value):
+                v = multihost_utils.broadcast_one_to_all(vals[0]._data)
+                self._store[k] = NDArray(v)
+        else:
+            self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+    def barrier(self):
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices('kvstore_barrier')
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Reference include/mxnet/kvstore.h:408 — the TPU runtime restarts
+        the whole SPMD job on failure, so a reachable store has 0 dead."""
+        return 0
+
+    @property
+    def type(self):
+        return 'dist_tpu_sync'
+
+
+@register
+class Horovod(KVStoreTPUSync):
+    """Horovod-compatible plugin surface (reference
+    python/mxnet/kvstore/horovod.py:25) backed by the same XLA allreduce."""
+
+    NAME = 'horovod'
+
+    @property
+    def local_rank(self):
+        return jax.process_index()
+
+
+@register
+class BytePS(KVStoreTPUSync):
+    """BytePS plugin surface (reference python/mxnet/kvstore/byteps.py:45)."""
+
+    NAME = 'byteps'

@@ -1,0 +1,19 @@
+"""Version and build-feature information.
+
+TPU-native analog of the reference's ``python/mxnet/libinfo.py`` (version at
+libinfo.py:149) and ``src/libinfo.cc`` feature flags. There is no ``libmxnet.so``
+to locate: the compute backend is JAX/XLA, so "features" report what the JAX
+installation supports instead of CMake build flags.
+"""
+
+__version__ = "2.0.0.tpu0"
+
+
+def find_lib_path():
+    """Kept for API compatibility; there is no native core library to load.
+
+    The reference resolves ``libmxnet.so`` here (libinfo.py:25). In the
+    TPU-native design the backend is the in-process JAX/XLA runtime, so this
+    returns an empty list.
+    """
+    return []

@@ -1,0 +1,325 @@
+"""``mx.metric`` / ``gluon.metric`` — evaluation metrics.
+
+Reference: ``python/mxnet/gluon/metric.py`` (1,856 LoC). Metrics accumulate
+host-side scalars; per-batch reductions run on device and sync once per
+update (cheap — one scalar transfer).
+"""
+
+import numpy as _np
+
+from .base import register as _register_factory, registry_create
+from .ndarray.ndarray import NDArray
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+class EvalMetric:
+    """Base metric (reference gluon/metric.py:EvalMetric)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float('nan'))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def update_dict(self, label, pred):
+        self.update(list(label.values()), list(pred.values()))
+
+    def __str__(self):
+        return f'EvalMetric: {dict(self.get_name_value())}'
+
+
+register = _register_factory(EvalMetric)
+
+
+def create(metric, *args, **kwargs):
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    return registry_create(EvalMetric, metric, *args, **kwargs)
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name='composite', **kw):
+        super().__init__(name, **kw)
+        self.metrics = metrics or []
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        for metric in getattr(self, 'metrics', []):
+            metric.reset()
+
+    def get(self):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = metric.get()
+            names.append(name)
+            values.append(value)
+        return names, values
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name='accuracy', **kw):
+        super().__init__(name, **kw)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            if pred.shape != label.shape:
+                pred = pred.argmax(axis=self.axis)
+            pred = pred.astype('int32').ravel()
+            label = label.astype('int32').ravel()
+            self.sum_metric += (pred == label).sum()
+            self.num_inst += len(label)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name='top_k_accuracy', **kw):
+        super().__init__(f'{name}_{top_k}', **kw)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).astype('int32')
+            pred = _to_np(pred)
+            argsorted = _np.argsort(-pred, axis=-1)[..., :self.top_k]
+            correct = (argsorted == label[..., None]).any(axis=-1)
+            self.sum_metric += correct.sum()
+            self.num_inst += correct.size
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name='mae', **kw):
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _to_np(label), _to_np(pred)
+            self.sum_metric += _np.abs(label - pred.reshape(label.shape)).sum()
+            self.num_inst += label.size
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name='mse', **kw):
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _to_np(label), _to_np(pred)
+            self.sum_metric += ((label - pred.reshape(label.shape)) ** 2).sum()
+            self.num_inst += label.size
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name='rmse', **kw):
+        EvalMetric.__init__(self, name, **kw)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float('nan'))
+        return (self.name, _np.sqrt(self.sum_metric / self.num_inst))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name='cross-entropy', **kw):
+        super().__init__(name, **kw)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel().astype('int64')
+            pred = _to_np(pred)
+            prob = pred[_np.arange(label.shape[0]), label]
+            self.sum_metric += (-_np.log(prob + self.eps)).sum()
+            self.num_inst += label.shape[0]
+
+
+@register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name='perplexity', **kw):
+        super().__init__(name=name, **kw)
+        self.ignore_label = ignore_label
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float('nan'))
+        return (self.name, float(_np.exp(self.sum_metric / self.num_inst)))
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name='nll-loss', **kw):
+        super().__init__(eps=eps, name=name, **kw)
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name='f1', average='macro', **kw):
+        super().__init__(name, **kw)
+        self.average = average
+        self.reset_stats()
+
+    def reset_stats(self):
+        self._tp = self._fp = self._fn = 0
+
+    def reset(self):
+        super().reset()
+        self.reset_stats()
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel().astype('int32')
+            pred = _to_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.ravel().astype('int32')
+            self._tp += ((pred == 1) & (label == 1)).sum()
+            self._fp += ((pred == 1) & (label == 0)).sum()
+            self._fn += ((pred == 0) & (label == 1)).sum()
+            self.num_inst += 1
+
+    def get(self):
+        prec = self._tp / max(self._tp + self._fp, 1)
+        rec = self._tp / max(self._tp + self._fn, 1)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return (self.name, f1)
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (reference gluon/metric.py:MCC)."""
+
+    def __init__(self, name='mcc', **kw):
+        super().__init__(name, **kw)
+        self._tp = self._fp = self._tn = self._fn = 0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._tn = self._fn = 0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel().astype('int32')
+            pred = _to_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.ravel().astype('int32')
+            self._tp += ((pred == 1) & (label == 1)).sum()
+            self._fp += ((pred == 1) & (label == 0)).sum()
+            self._tn += ((pred == 0) & (label == 0)).sum()
+            self._fn += ((pred == 0) & (label == 1)).sum()
+            self.num_inst += 1
+
+    def get(self):
+        tp, fp, tn, fn = self._tp, self._fp, self._tn, self._fn
+        denom = _np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        mcc = (tp * tn - fp * fn) / denom if denom else 0.0
+        return (self.name, mcc)
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name='pearsonr', **kw):
+        super().__init__(name, **kw)
+        self._labels, self._preds = [], []
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            self._labels.append(_to_np(label).ravel())
+            self._preds.append(_to_np(pred).ravel())
+            self.num_inst += 1
+
+    def get(self):
+        if not self._labels:
+            return (self.name, float('nan'))
+        lab = _np.concatenate(self._labels)
+        pre = _np.concatenate(self._preds)
+        return (self.name, float(_np.corrcoef(lab, pre)[0, 1]))
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name='loss', **kw):
+        super().__init__(name, **kw)
+
+    def update(self, _, preds):
+        for pred in _as_list(preds):
+            loss = _to_np(pred)
+            self.sum_metric += loss.sum()
+            self.num_inst += loss.size
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name='custom', allow_extra_outputs=False, **kw):
+        super().__init__(f'{name}({feval.__name__})', **kw)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            reval = self._feval(_to_np(label), _to_np(pred))
+            if isinstance(reval, tuple):
+                m, n = reval
+                self.sum_metric += m
+                self.num_inst += n
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name='custom', allow_extra_outputs=False):
+    """Wrap a numpy feval into a metric (reference metric.py:np)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -1,0 +1,63 @@
+"""Checkpoint helpers (reference python/mxnet/model.py + the NDArray
+Save/Load binary format, src/ndarray/ndarray.cc:1697,1820).
+
+Format: ``.npz``-based NDArray map (named tensors) — a portable stand-in for
+the reference's magic+version binary map. Gluon's
+``save_parameters/load_parameters`` route through these. A
+tensorstore/orbax-backed *sharded* checkpoint lives in
+mxnet_tpu/parallel/checkpoint.py for the distributed path.
+"""
+
+import numpy as _np
+
+from .ndarray.ndarray import NDArray, array
+
+_MAGIC_KEY = '__mxnet_tpu_format__'
+
+
+def save_ndarray_map(fname, data):
+    """mx.nd.save (reference ndarray.cc:1697 NDArray::Save)."""
+    if isinstance(data, NDArray):
+        data = {'0': data}
+    elif isinstance(data, (list, tuple)):
+        data = {str(i): v for i, v in enumerate(data)}
+    arrays = {k: v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
+              for k, v in data.items()}
+    arrays[_MAGIC_KEY] = _np.array([2, 0])  # format version
+    _np.savez(fname if str(fname).endswith('.npz') or '.' in str(fname)
+              else fname, **arrays)
+
+
+def load_ndarray_map(fname, ctx=None):
+    """mx.nd.load (reference ndarray.cc:1820 NDArray::Load)."""
+    with _np.load(fname, allow_pickle=False) as z:
+        out = {k: array(z[k], ctx=ctx) for k in z.files if k != _MAGIC_KEY}
+    keys = list(out)
+    if keys and all(k.isdigit() for k in keys):
+        return [out[str(i)] for i in range(len(keys))]
+    return out
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Reference model.py:save_checkpoint — params-%04d file pair."""
+    data = {}
+    for k, v in (arg_params or {}).items():
+        data[f'arg:{k}'] = v
+    for k, v in (aux_params or {}).items():
+        data[f'aux:{k}'] = v
+    save_ndarray_map(f'{prefix}-{epoch:04d}.params.npz', data)
+    if symbol is not None and hasattr(symbol, 'save'):
+        symbol.save(f'{prefix}-symbol.json')
+
+
+def load_checkpoint(prefix, epoch):
+    """Reference model.py:load_checkpoint."""
+    data = load_ndarray_map(f'{prefix}-{epoch:04d}.params.npz')
+    arg_params, aux_params = {}, {}
+    for k, v in data.items():
+        if k.startswith('arg:'):
+            arg_params[k[4:]] = v
+        elif k.startswith('aux:'):
+            aux_params[k[4:]] = v
+    return None, arg_params, aux_params

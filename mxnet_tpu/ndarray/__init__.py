@@ -1,0 +1,48 @@
+"""Legacy ``mx.nd`` namespace.
+
+Reference: ``python/mxnet/ndarray/`` — the pre-numpy NDArray API. One
+NDArray class backs both this and ``mx.np`` (the reference maintains two
+array types; here the semantics differences are parameter defaults only, so
+one class suffices and `as_np_ndarray()`/`as_nd_ndarray()` are identity).
+"""
+
+import sys as _sys
+
+from .ndarray import NDArray, array, _wrap_out
+from ..ops.creation import FRONTEND_CREATORS as _CREATORS
+from ..ops import registry as _registry  # ensure ops imported
+from . import register as _register
+
+waitall = None
+
+
+def _waitall():
+    """Block until all async work completes (reference mx.nd.waitall)."""
+    import jax
+    (jax.device_put(0.0) + 0).block_until_ready()
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+waitall = _waitall
+
+_mod = _sys.modules[__name__]
+for _n, _f in _CREATORS.items():
+    setattr(_mod, _n, _f)
+
+_register.populate(_mod.__dict__, 'nd')
+
+# legacy spellings
+from .ndarray import array as from_numpy  # noqa: E402
+
+
+def save(fname, data):
+    from ..model import save_ndarray_map
+    save_ndarray_map(fname, data)
+
+
+def load(fname):
+    from ..model import load_ndarray_map
+    return load_ndarray_map(fname)

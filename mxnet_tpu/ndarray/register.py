@@ -1,0 +1,27 @@
+"""Namespace code-generation from the op registry.
+
+Mirrors the reference's ``_init_op_module`` (python/mxnet/base.py:600,
+python/mxnet/ndarray/register.py:265-277): at import time, every registered
+op gets a frontend function injected into the requested namespace module(s),
+so ``mx.nd.*`` / ``mx.np.*`` / ``mx.npx.*`` are populated the same way the
+reference populates them from ``MXSymbolListAtomicSymbolCreators``.
+"""
+
+from ..ops import registry as _reg
+
+
+def populate(module_dict, namespace, extra_aliases=True):
+    """Inject frontend functions for all ops tagged with ``namespace``."""
+    seen = set()
+    for name, op in _reg.list_ops().items():
+        if namespace not in op.namespaces:
+            continue
+        if id(op) in seen and name == op.name:
+            continue
+        fn = _reg.make_frontend(op.name)
+        module_dict.setdefault(name, fn)
+        if extra_aliases and name == op.name:
+            for a in op.aliases:
+                module_dict.setdefault(a, fn)
+        seen.add(id(op))
+    return module_dict

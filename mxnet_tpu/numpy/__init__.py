@@ -1,0 +1,103 @@
+"""``mx.np`` — the NumPy-compatible frontend.
+
+Reference: ``python/mxnet/numpy/multiarray.py`` (mx.np.ndarray at :264) with
+``__array_function__`` dispatch and official-numpy fallback
+(numpy/fallback.py). Here the single NDArray class plays ndarray, and every
+registered op with the 'np' tag is injected below (≙ the reference's
+codegen'd ``_npi_*`` wrappers).
+"""
+
+import sys as _sys
+
+import numpy as _onp
+
+from ..ndarray.ndarray import NDArray, array
+from ..ndarray import register as _register
+from ..ops.creation import FRONTEND_CREATORS as _CREATORS
+
+ndarray = NDArray
+
+# dtype & constant re-exports (reference numpy/__init__.py mirrors numpy's)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+bfloat16 = 'bfloat16'
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+dtype = _onp.dtype
+
+_mod = _sys.modules[__name__]
+for _n, _f in _CREATORS.items():
+    setattr(_mod, _n, _f)
+
+_register.populate(_mod.__dict__, 'np')
+
+
+def asarray(obj, dtype=None, ctx=None):
+    if isinstance(obj, NDArray) and dtype is None and ctx is None:
+        return obj
+    return array(obj, dtype=dtype, ctx=ctx)
+
+
+def shape(a):
+    return a.shape if hasattr(a, 'shape') else _onp.shape(a)
+
+
+def ndim(a):
+    return a.ndim if hasattr(a, 'ndim') else _onp.ndim(a)
+
+
+def size(a):
+    return a.size if hasattr(a, 'size') else _onp.size(a)
+
+
+def result_type(*args):
+    raws = [a._data if isinstance(a, NDArray) else a for a in args]
+    import jax.numpy as jnp
+    return _onp.dtype(jnp.result_type(*raws))
+
+
+def may_share_memory(a, b):
+    return False  # functional arrays never alias
+
+
+def shares_memory(a, b):
+    return False
+
+
+class linalg:
+    """``mx.np.linalg`` namespace (reference numpy/linalg.py)."""
+
+
+class random:
+    """``mx.np.random`` namespace (reference numpy/random.py)."""
+
+
+def _build_sub_namespaces():
+    from ..ops import registry as _reg
+    for name, op in _reg.list_ops().items():
+        if name.startswith('linalg_'):
+            setattr(linalg, name[len('linalg_'):], staticmethod(
+                _reg.make_frontend(op.name)))
+        if name.startswith('random_'):
+            setattr(random, name[len('random_'):], staticmethod(
+                _reg.make_frontend(op.name)))
+    from ..ops.random_ops import seed as _seed
+    random.seed = staticmethod(_seed)
+    linalg.norm = staticmethod(_reg.make_frontend('linalg_norm'))
+
+
+_build_sub_namespaces()

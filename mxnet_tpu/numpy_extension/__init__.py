@@ -1,0 +1,78 @@
+"""``mx.npx`` — NumPy-extension namespace for neural ops.
+
+Reference: ``python/mxnet/numpy_extension/`` — the home of operators that
+exist in MXNet but not NumPy (``npx.activation``, ``npx.batch_norm``,
+``npx.convolution``, ``npx.fully_connected``, attention ops, ...), plus the
+``set_np`` semantics switch.
+"""
+
+import sys as _sys
+
+from ..ndarray import register as _register
+from ..ops import registry as _reg
+
+_mod = _sys.modules[__name__]
+
+# every op is reachable from npx (the reference aliases `_npx_*` broadly)
+_register.populate(_mod.__dict__, 'np')
+_register.populate(_mod.__dict__, 'nd')
+
+_np_flags = {'shape': True, 'array': True}
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Reference: python/mxnet/util.py set_np. NumPy semantics (zero-dim,
+    zero-size shapes, numpy promotion) are native to the jax backend, so
+    this records the flags and returns."""
+    _np_flags['shape'] = shape
+    _np_flags['array'] = array
+
+
+def reset_np():
+    set_np(False, False)
+
+
+def is_np_shape():
+    return _np_flags['shape']
+
+
+def is_np_array():
+    return _np_flags['array']
+
+
+def use_np(func):
+    return func
+
+
+def waitall():
+    from ..ndarray import waitall as w
+    w()
+
+
+def current_device():
+    from ..context import current_context
+    return current_context()
+
+
+def cpu(i=0):
+    from ..context import cpu as _cpu
+    return _cpu(i)
+
+
+def gpu(i=0):
+    from ..context import gpu as _gpu
+    return _gpu(i)
+
+
+def num_gpus():
+    from ..context import num_gpus as n
+    return n()
+
+
+def seed(s):
+    from ..ops.random_ops import seed as _s
+    _s(s)
+
+
+def softmax(data, axis=-1, **kw):
+    return _reg.make_frontend('softmax')(data, axis=axis, **kw)

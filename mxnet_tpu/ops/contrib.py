@@ -1,0 +1,220 @@
+"""Contrib ops: transformer attention kernels, detection helpers, fused
+optimizer utilities.
+
+Reference: ``src/operator/contrib/`` (31.5 kLoC). The headline items for a
+transformer stack are the interleaved-matmul self-attention ops
+(src/operator/contrib/transformer.cc:650-826) — re-designed here as einsum
+compositions that XLA maps onto the MXU, plus a whole fused
+``multi_head_attention`` (the form the reference never had; on TPU one fused
+softmax(QK^T)V is both simpler and faster). A Pallas flash-attention path
+plugs in underneath for long sequences.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------- interleaved attention
+# Reference layout: qkv (seq, batch, num_heads * 3 * head_dim) interleaved.
+@register('interleaved_matmul_selfatt_qk')
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """Reference: src/operator/contrib/transformer.cc:650 — Q·K^T from
+    interleaved QKV projections. Output: (batch*heads, seq, seq)."""
+    s, b, e = queries_keys_values.shape
+    hd = e // (3 * heads)
+    x = queries_keys_values.reshape(s, b, heads, 3, hd)
+    q = x[:, :, :, 0]  # (s, b, h, d)
+    k = x[:, :, :, 1]
+    q = q * (hd ** -0.5)
+    scores = jnp.einsum('sbhd,tbhd->bhst', q, k)
+    return scores.reshape(b * heads, s, s)
+
+
+@register('interleaved_matmul_selfatt_valatt')
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    """Reference: transformer.cc:710 — attention · V back to interleaved
+    layout. attention: (batch*heads, seq, seq)."""
+    s, b, e = queries_keys_values.shape
+    hd = e // (3 * heads)
+    x = queries_keys_values.reshape(s, b, heads, 3, hd)
+    v = x[:, :, :, 2]  # (s, b, h, d)
+    att = attention.reshape(b, heads, s, s)
+    out = jnp.einsum('bhst,tbhd->sbhd', att, v)
+    return out.reshape(s, b, heads * hd)
+
+
+@register('interleaved_matmul_encdec_qk')
+def interleaved_matmul_encdec_qk(queries, keys_values, heads):
+    """Reference: transformer.cc:770 — cross-attention Q·K^T."""
+    sq, b, e = queries.shape
+    sk = keys_values.shape[0]
+    hd = e // heads
+    q = queries.reshape(sq, b, heads, hd) * (hd ** -0.5)
+    kv = keys_values.reshape(sk, b, heads, 2, hd)
+    k = kv[:, :, :, 0]
+    scores = jnp.einsum('sbhd,tbhd->bhst', q, k)
+    return scores.reshape(b * heads, sq, sk)
+
+
+@register('interleaved_matmul_encdec_valatt')
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
+    sk, b, e = keys_values.shape
+    hd = e // (2 * heads)
+    kv = keys_values.reshape(sk, b, heads, 2, hd)
+    v = kv[:, :, :, 1]
+    sq = attention.shape[1]
+    att = attention.reshape(b, heads, sq, sk)
+    out = jnp.einsum('bhst,tbhd->sbhd', att, v)
+    return out.reshape(sq, b, heads * hd)
+
+
+@register('multi_head_attention')
+def multi_head_attention(q, k, v, num_heads, mask=None, dropout_p=0.0,
+                         causal=False, key=None):
+    """Fused scaled-dot-product attention (batch, seq, embed) — the TPU-first
+    replacement for the interleaved-matmul pipeline. Uses
+    jax.nn.dot_product_attention which XLA fuses; see
+    ops/pallas_kernels.py:flash_attention for the long-sequence path."""
+    b, sq, e = q.shape
+    hd = e // num_heads
+    qh = q.reshape(b, sq, num_heads, hd)
+    kh = k.reshape(b, k.shape[1], num_heads, hd)
+    vh = v.reshape(b, v.shape[1], num_heads, hd)
+    out = jax.nn.dot_product_attention(
+        qh, kh, vh, mask=mask, is_causal=causal)
+    return out.reshape(b, sq, e)
+
+
+# ----------------------------------------------------------- detection utils
+@register('box_iou', differentiable=False)
+def box_iou(lhs, rhs, format='corner'):
+    """Reference: src/operator/contrib/bounding_box.cc _contrib_box_iou."""
+    if format == 'center':
+        def corner(b):
+            cx, cy, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                              cy + h / 2], axis=-1)
+        lhs, rhs = corner(lhs), corner(rhs)
+    l = lhs[..., :, None, :]
+    r = rhs[..., None, :, :]
+    tl = jnp.maximum(l[..., :2], r[..., :2])
+    br = jnp.minimum(l[..., 2:], r[..., 2:])
+    wh = jnp.clip(br - tl, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = (l[..., 2] - l[..., 0]) * (l[..., 3] - l[..., 1])
+    area_r = (r[..., 2] - r[..., 0]) * (r[..., 3] - r[..., 1])
+    return inter / jnp.maximum(area_l + area_r - inter, 1e-12)
+
+
+@register('box_nms', differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format='corner', out_format='corner'):
+    """Reference: src/operator/contrib/bounding_box.cc box_nms. Static-shape
+    NMS via iterative suppression with lax.fori_loop (TPU-friendly: no
+    dynamic shapes — suppressed boxes get score -1, as in the reference)."""
+    boxes = data[..., coord_start:coord_start + 4]
+    scores = data[..., score_index]
+    ids = data[..., id_index] if id_index >= 0 else None
+    n = data.shape[-2]
+
+    order = jnp.argsort(-scores, axis=-1)
+    boxes_s = jnp.take_along_axis(boxes, order[..., None], axis=-2)
+    scores_s = jnp.take_along_axis(scores, order, axis=-1)
+    iou = box_iou(boxes_s, boxes_s, format=in_format)
+    if ids is not None and not force_suppress:
+        ids_s = jnp.take_along_axis(ids, order, axis=-1)
+        same = ids_s[..., :, None] == ids_s[..., None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    valid = scores_s > valid_thresh
+
+    def body(i, keep):
+        sup = (iou[..., i, :] > overlap_thresh) & keep[..., i:i + 1] & \
+            (jnp.arange(n) > i)
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, n, body, valid)
+    out_scores = jnp.where(keep, scores_s, -1.0)
+    out = jnp.take_along_axis(data, order[..., None], axis=-2)
+    out = out.at[..., score_index].set(out_scores)
+    return out
+
+
+@register('roi_align')
+def roi_align(data, rois, pooled_size, spatial_scale, sample_ratio=2):
+    """Reference: src/operator/contrib/roi_align.cc. Bilinear sampling via
+    map_coordinates-style gathers (XLA gather, differentiable)."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        batch_idx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w, bin_h = rw / pw, rh / ph
+        s = max(sample_ratio, 1)
+        ys = y1 + (jnp.arange(ph)[:, None] + (jnp.arange(s)[None, :] + 0.5)
+                   / s) * bin_h
+        xs = x1 + (jnp.arange(pw)[:, None] + (jnp.arange(s)[None, :] + 0.5)
+                   / s) * bin_w
+        ys = ys.reshape(-1)
+        xs = xs.reshape(-1)
+        yy, xx = jnp.meshgrid(ys, xs, indexing='ij')
+        img = data[batch_idx]
+
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = yy - y0
+        wx = xx - x0
+        y0 = y0.astype(jnp.int32); x0 = x0.astype(jnp.int32)
+        y1i = y1i.astype(jnp.int32); x1i = x1i.astype(jnp.int32)
+        v = (img[:, y0, x0] * (1 - wy) * (1 - wx) +
+             img[:, y1i, x0] * wy * (1 - wx) +
+             img[:, y0, x1i] * (1 - wy) * wx +
+             img[:, y1i, x1i] * wy * wx)  # (c, ph*s, pw*s)
+        v = v.reshape(c, ph, s, pw, s)
+        return v.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register('all_finite', differentiable=False)
+def all_finite(*arrays, init_output=True):
+    """Reference: src/operator/contrib/all_finite.cc — AMP overflow check."""
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = ok & jnp.all(jnp.isfinite(a))
+    return ok
+
+
+@register('index_copy')
+def index_copy(old, index, new_tensor):
+    return old.at[index.astype(jnp.int32)].set(new_tensor)
+
+
+@register('index_add')
+def index_add(old, index, new_tensor):
+    return old.at[index.astype(jnp.int32)].add(new_tensor)
+
+
+@register('getnnz', differentiable=False)
+def getnnz(data, axis=None):
+    return jnp.count_nonzero(data, axis=axis)
+
+
+@register('count_sketch')
+def count_sketch(data, h, s, out_dim):
+    """Reference: src/operator/contrib/count_sketch.cc."""
+    idx = h.astype(jnp.int32)
+    signed = data * s
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), dtype=data.dtype)
+    return out.at[..., idx].add(signed)

@@ -1,0 +1,112 @@
+"""Array-creation ops (reference: src/operator/tensor/init_op.cc).
+
+These are frontends, not dispatch ops — they create fresh arrays on a
+Context rather than transforming inputs, so they bypass the tape.
+"""
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..context import Context, current_context
+from .registry import register
+
+
+def _dev(ctx, device=None):
+    ctx = ctx or device
+    if ctx is not None and not isinstance(ctx, Context):
+        ctx = Context(ctx)
+    return (ctx or current_context()).to_jax(), ctx
+
+
+def _creator(fn):
+    """Wrap a jnp creation fn into an NDArray-returning frontend."""
+    def wrapper(*args, ctx=None, device=None, **kwargs):
+        from ..ndarray.ndarray import NDArray
+        dev, ctx = _dev(ctx, device)
+        with jax.default_device(dev):
+            raw = fn(*args, **kwargs)
+        return NDArray(raw, ctx=ctx)
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+def zeros(shape, dtype='float32', ctx=None, device=None, order='C'):
+    return _creator(jnp.zeros)(shape, dtype=dtype, ctx=ctx, device=device)
+
+
+def ones(shape, dtype='float32', ctx=None, device=None, order='C'):
+    return _creator(jnp.ones)(shape, dtype=dtype, ctx=ctx, device=device)
+
+
+def full(shape, fill_value, dtype=None, ctx=None, device=None):
+    return _creator(jnp.full)(shape, fill_value, dtype=dtype, ctx=ctx,
+                              device=device)
+
+
+def empty(shape, dtype='float32', ctx=None, device=None):
+    return zeros(shape, dtype=dtype, ctx=ctx, device=device)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    return _creator(jnp.arange)(start, stop, step, dtype=dtype, ctx=ctx,
+                                device=device)
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None,
+             device=None):
+    return _creator(jnp.linspace)(start, stop, num, endpoint=endpoint,
+                                  dtype=dtype, ctx=ctx, device=device)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             ctx=None, device=None):
+    return _creator(jnp.logspace)(start, stop, num, endpoint=endpoint,
+                                  base=base, dtype=dtype, ctx=ctx,
+                                  device=device)
+
+
+def eye(N, M=None, k=0, dtype='float32', ctx=None, device=None):
+    return _creator(jnp.eye)(N, M, k=k, dtype=dtype, ctx=ctx, device=device)
+
+
+def identity(n, dtype='float32', ctx=None, device=None):
+    return eye(n, dtype=dtype, ctx=ctx, device=device)
+
+
+def tri(N, M=None, k=0, dtype='float32', ctx=None, device=None):
+    return _creator(jnp.tri)(N, M, k=k, dtype=dtype, ctx=ctx, device=device)
+
+
+def indices(dimensions, dtype='int32', ctx=None, device=None):
+    return _creator(jnp.indices)(dimensions, dtype=dtype, ctx=ctx,
+                                 device=device)
+
+
+# *_like ops go through the registry so they ride the tape (grad = zeros)
+@register('zeros_like', differentiable=False)
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+@register('ones_like', differentiable=False)
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype)
+
+
+@register('full_like', differentiable=False)
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+@register('copy')
+def copy_(x):
+    return jnp.copy(x)
+
+
+FRONTEND_CREATORS = {
+    'zeros': zeros, 'ones': ones, 'full': full, 'empty': empty,
+    'arange': arange, 'linspace': linspace, 'logspace': logspace, 'eye': eye,
+    'identity': identity, 'tri': tri, 'indices': indices,
+}

@@ -1,0 +1,156 @@
+"""Elementwise ops.
+
+Covers the reference's ``src/operator/tensor/elemwise_*`` +
+``src/operator/numpy/np_elemwise_*`` families (unary/binary/scalar with
+broadcasting). On TPU these are pure XLA elementwise HLOs that fuse into
+adjacent matmuls — no hand-written kernels needed (the role the NVRTC
+pointwise-fusion subsystem played on GPU, src/operator/fusion/, is played by
+the XLA fusion pass).
+"""
+
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+from jax import lax
+
+from .registry import register
+
+_BINARY = [
+    'add', 'subtract', 'multiply', 'true_divide', 'floor_divide', 'mod',
+    'power', 'maximum', 'minimum', 'hypot', 'arctan2', 'copysign',
+    'logaddexp', 'fmod', 'fmax', 'fmin', 'remainder', 'float_power',
+    'ldexp', 'heaviside', 'gcd', 'lcm', 'bitwise_and', 'bitwise_or',
+    'bitwise_xor', 'left_shift', 'right_shift', 'nextafter',
+]
+_COMPARE = ['equal', 'not_equal', 'less', 'less_equal', 'greater',
+            'greater_equal', 'logical_and', 'logical_or', 'logical_xor']
+_UNARY = [
+    'negative', 'abs', 'absolute', 'fabs', 'sign', 'rint', 'ceil', 'floor',
+    'trunc', 'fix', 'sqrt', 'cbrt', 'square', 'reciprocal', 'exp', 'expm1',
+    'exp2', 'log', 'log10', 'log2', 'log1p', 'sin', 'cos', 'tan', 'arcsin',
+    'arccos', 'arctan', 'sinh', 'cosh', 'tanh', 'arcsinh', 'arccosh',
+    'arctanh', 'degrees', 'radians', 'deg2rad', 'rad2deg', 'logical_not',
+    'invert', 'bitwise_not', 'positive', 'conjugate', 'conj', 'real', 'imag',
+    'angle', 'i0', 'sinc', 'signbit', 'spacing',
+]
+_UNARY_NONDIFF = ['isnan', 'isinf', 'isfinite', 'isposinf', 'isneginf',
+                  'iscomplex', 'isreal']
+
+
+def _reg_simple(names, nondiff=False, aliases_fn=None):
+    for nm in names:
+        fn = getattr(jnp, nm)
+        aliases = aliases_fn(nm) if aliases_fn else ()
+        register(nm, differentiable=not nondiff, aliases=aliases)(
+            _capture(fn))
+
+
+def _capture(fn):
+    def op(*args, **kwargs):
+        return fn(*args, **kwargs)
+    op.__name__ = fn.__name__
+    return op
+
+
+_reg_simple(_BINARY)
+_reg_simple(_COMPARE, nondiff=True)
+_reg_simple(_UNARY)
+_reg_simple(_UNARY_NONDIFF, nondiff=True)
+
+
+@register('divide', aliases=('div',))
+def divide(a, b):
+    return jnp.true_divide(a, b)
+
+
+@register('rtruediv')
+def rtruediv(a, b):
+    return jnp.true_divide(b, a)
+
+
+@register('cast', aliases=('Cast',), differentiable=True)
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+@register('clip')
+def clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register('round')
+def round_(x, decimals=0):
+    return jnp.round(x, decimals)
+
+
+@register('where')
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register('erf')
+def erf(x):
+    return jsp.erf(x)
+
+
+@register('erfinv')
+def erfinv(x):
+    return jsp.erfinv(x)
+
+
+@register('erfc')
+def erfc(x):
+    return jsp.erfc(x)
+
+
+@register('gamma')
+def gamma_fn(x):
+    return jnp.exp(jsp.gammaln(x))
+
+
+@register('gammaln')
+def gammaln(x):
+    return jsp.gammaln(x)
+
+
+@register('digamma')
+def digamma(x):
+    return jsp.digamma(x)
+
+
+@register('relu6')
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+@register('rsqrt')
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+@register('rcbrt')
+def rcbrt(x):
+    return 1.0 / jnp.cbrt(x)
+
+
+@register('logit')
+def logit(x):
+    return jsp.logit(x)
+
+
+@register('nan_to_num')
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register('stop_gradient', aliases=('BlockGrad', 'block_grad'),
+          differentiable=True)
+def stop_gradient(x):
+    return lax.stop_gradient(x)
+
+
+@register('smooth_l1')
+def smooth_l1(x, scalar=1.0):
+    # reference: src/operator/tensor/elemwise_unary_op.cc smooth_l1
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)

@@ -1,0 +1,243 @@
+"""Linear algebra ops.
+
+Reference: ``src/operator/tensor/dot*`` (incl. the la_op linalg family:
+potrf, gelqf, syevd — src/operator/tensor/la_op.cc) and
+``src/operator/numpy/linalg/``. On TPU every contraction here lands on the
+MXU via a single XLA dot_general; batched forms stay batched (no unrolling).
+"""
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register('dot')
+def dot(a, b):
+    return jnp.dot(a, b)
+
+
+@register('matmul')
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register('inner')
+def inner(a, b):
+    return jnp.inner(a, b)
+
+
+@register('outer')
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+@register('vdot')
+def vdot(a, b):
+    return jnp.vdot(a, b)
+
+
+@register('tensordot')
+def tensordot(a, b, axes=2):
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@register('einsum')
+def einsum(*operands, subscripts=None, optimize=True):
+    if subscripts is not None:
+        return jnp.einsum(subscripts, *operands, optimize=optimize)
+    return jnp.einsum(*operands, optimize=optimize)
+
+
+@register('kron')
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register('batch_dot')
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    """Reference: src/operator/tensor/dot.cc batch_dot — one MXU
+    dot_general with a batch dimension."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register('linalg_norm')
+def linalg_norm(x, ord=None, axis=None, keepdims=False):
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register('linalg_svd')
+def linalg_svd(a, full_matrices=True, compute_uv=True):
+    return jnp.linalg.svd(a, full_matrices=full_matrices,
+                          compute_uv=compute_uv)
+
+
+@register('linalg_inv')
+def linalg_inv(a):
+    return jnp.linalg.inv(a)
+
+
+@register('linalg_pinv')
+def linalg_pinv(a, rcond=None):
+    return jnp.linalg.pinv(a, rcond=rcond)
+
+
+@register('linalg_det')
+def linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register('linalg_slogdet')
+def linalg_slogdet(a):
+    return jnp.linalg.slogdet(a)
+
+
+@register('linalg_cholesky', aliases=('linalg_potrf',))
+def linalg_cholesky(a, lower=True):
+    """Reference la_op potrf (src/operator/tensor/la_op.cc)."""
+    L = jnp.linalg.cholesky(a)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+@register('linalg_qr', aliases=('linalg_gelqf',))
+def linalg_qr(a, mode='reduced'):
+    return jnp.linalg.qr(a, mode=mode)
+
+
+@register('linalg_eigh', aliases=('linalg_syevd',))
+def linalg_eigh(a, UPLO='L'):
+    return jnp.linalg.eigh(a, UPLO=UPLO)
+
+
+@register('linalg_eigvalsh', differentiable=False)
+def linalg_eigvalsh(a, UPLO='L'):
+    return jnp.linalg.eigvalsh(a, UPLO=UPLO)
+
+
+@register('linalg_eig', differentiable=False)
+def linalg_eig(a):
+    return jnp.linalg.eig(a)
+
+
+@register('linalg_eigvals', differentiable=False)
+def linalg_eigvals(a):
+    return jnp.linalg.eigvals(a)
+
+
+@register('linalg_solve')
+def linalg_solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+@register('linalg_lstsq', differentiable=False)
+def linalg_lstsq(a, b, rcond=None):
+    return jnp.linalg.lstsq(a, b, rcond=rcond)
+
+
+@register('linalg_matrix_rank', differentiable=False)
+def linalg_matrix_rank(a, tol=None):
+    return jnp.linalg.matrix_rank(a, tol=tol)
+
+
+@register('linalg_matrix_power')
+def linalg_matrix_power(a, n):
+    return jnp.linalg.matrix_power(a, n)
+
+
+@register('linalg_multi_dot')
+def linalg_multi_dot(*arrays):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return jnp.linalg.multi_dot(arrays)
+
+
+@register('linalg_cond', differentiable=False)
+def linalg_cond(a, p=None):
+    return jnp.linalg.cond(a, p=p)
+
+
+@register('linalg_tensorinv')
+def linalg_tensorinv(a, ind=2):
+    return jnp.linalg.tensorinv(a, ind=ind)
+
+
+@register('linalg_tensorsolve')
+def linalg_tensorsolve(a, b, axes=None):
+    return jnp.linalg.tensorsolve(a, b, axes=axes)
+
+
+@register('linalg_trmm')
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Reference la_op trmm: triangular matrix multiply."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register('linalg_trsm')
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Reference la_op trsm: triangular solve."""
+    import jax.scipy.linalg as jsl
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+        lower = not lower
+    if rightside:
+        # solve X tri = alpha B  ->  tri^T X^T = alpha B^T
+        sol = jsl.solve_triangular(jnp.swapaxes(tri, -1, -2),
+                                   jnp.swapaxes(alpha * B, -1, -2),
+                                   lower=not lower)
+        return jnp.swapaxes(sol, -1, -2)
+    return jsl.solve_triangular(tri, alpha * B, lower=lower)
+
+
+@register('linalg_gemm')
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0):
+    """Reference la_op gemm."""
+    if transpose_a:
+        A = jnp.swapaxes(A, -1, -2)
+    if transpose_b:
+        B = jnp.swapaxes(B, -1, -2)
+    return alpha * jnp.matmul(A, B) + beta * C
+
+
+@register('linalg_gemm2')
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        A = jnp.swapaxes(A, -1, -2)
+    if transpose_b:
+        B = jnp.swapaxes(B, -1, -2)
+    return alpha * jnp.matmul(A, B)
+
+
+@register('linalg_syrk')
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    At = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
+
+
+@register('linalg_extractdiag')
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register('linalg_makediag')
+def linalg_makediag(a, offset=0):
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+    rows = jnp.arange(a.shape[-1]) + max(-offset, 0)
+    cols = jnp.arange(a.shape[-1]) + max(offset, 0)
+    return out.at[..., rows, cols].set(a)
+
+
+@register('linalg_sumlogdiag')
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)

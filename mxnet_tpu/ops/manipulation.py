@@ -1,0 +1,418 @@
+"""Shape/layout manipulation + indexing ops.
+
+Reference: ``src/operator/tensor/matrix_op*`` (reshape/transpose/slice/...),
+``indexing_op`` (take/gather_nd/scatter_nd/one_hot), ``init_op`` tail. All
+are XLA reshapes/gathers — free or cheap on TPU when shapes are static.
+"""
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register('reshape', aliases=('Reshape',))
+def reshape(x, newshape, reverse=False, order='C'):
+    shape = tuple(int(s) for s in newshape)
+    # MXNet magic values 0 (copy input dim) and -2..-4 are legacy `nd.reshape`
+    # extras; `np.reshape`-style -1 handled by jnp directly.
+    if 0 in shape:
+        shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.reshape(x, shape, order=order)
+
+
+@register('transpose')
+def transpose(x, axes=None):
+    return jnp.transpose(x, axes=axes)
+
+
+@register('swapaxes', aliases=('SwapAxis',))
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@register('moveaxis')
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register('rollaxis')
+def rollaxis(x, axis, start=0):
+    return jnp.rollaxis(x, axis, start)
+
+
+@register('expand_dims')
+def expand_dims(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@register('squeeze')
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register('broadcast_to')
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+@register('ravel')
+def ravel(x, order='C'):
+    return jnp.ravel(x, order=order)
+
+
+@register('flatten', aliases=('Flatten',))
+def flatten(x):
+    """Reference Flatten: collapse all but the first axis
+    (src/operator/tensor/matrix_op.cc Flatten)."""
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register('concatenate', aliases=('concat', 'Concat'))
+def concatenate(*arrays, axis=0):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return jnp.concatenate(arrays, axis=axis)
+
+
+@register('stack')
+def stack(*arrays, axis=0):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return jnp.stack(arrays, axis=axis)
+
+
+@register('vstack')
+def vstack(*arrays):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return jnp.vstack(arrays)
+
+
+@register('hstack')
+def hstack(*arrays):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return jnp.hstack(arrays)
+
+
+@register('dstack')
+def dstack(*arrays):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return jnp.dstack(arrays)
+
+
+@register('column_stack')
+def column_stack(*arrays):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return jnp.column_stack(arrays)
+
+
+@register('split')
+def split(x, indices_or_sections, axis=0):
+    return tuple(jnp.split(x, indices_or_sections, axis=axis))
+
+
+@register('array_split')
+def array_split(x, indices_or_sections, axis=0):
+    return tuple(jnp.array_split(x, indices_or_sections, axis=axis))
+
+
+@register('tile')
+def tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+@register('repeat')
+def repeat(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register('flip')
+def flip(x, axis=None):
+    return jnp.flip(x, axis=axis)
+
+
+@register('fliplr')
+def fliplr(x):
+    return jnp.fliplr(x)
+
+
+@register('flipud')
+def flipud(x):
+    return jnp.flipud(x)
+
+
+@register('roll')
+def roll(x, shift, axis=None):
+    return jnp.roll(x, shift, axis=axis)
+
+
+@register('rot90')
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@register('pad')
+def pad(x, pad_width, mode='constant', constant_values=0):
+    if mode == 'constant':
+        return jnp.pad(x, pad_width, mode=mode,
+                       constant_values=constant_values)
+    return jnp.pad(x, pad_width, mode=mode)
+
+
+@register('take')
+def take(x, indices, axis=None, mode='clip'):
+    return jnp.take(x, indices.astype(jnp.int32) if hasattr(indices, 'astype')
+                    else indices, axis=axis, mode=mode)
+
+
+@register('take_along_axis')
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices.astype(jnp.int32), axis=axis)
+
+
+@register('pick')
+def pick(x, index, axis=-1, keepdims=False, mode='clip'):
+    """Reference: src/operator/tensor/broadcast_reduce_op_index.cc pick."""
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register('gather_nd')
+def gather_nd(data, indices):
+    """Reference: src/operator/tensor/indexing_op.cc gather_nd.
+
+    indices: (M, N1...Nk) selecting along the first M axes of data.
+    """
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register('scatter_nd', differentiable=True)
+def scatter_nd(data, indices, shape):
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[idx].add(data)
+
+
+@register('one_hot', differentiable=False)
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype='float32'):
+    import jax.nn as jnn
+    oh = jnn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype)
+    return oh * on_value + (1.0 - oh) * off_value
+
+
+@register('slice_axis')
+def slice_axis(x, axis, begin, end):
+    """Reference: src/operator/tensor/matrix_op.cc slice_axis."""
+    n = x.shape[axis]
+    if end is None:
+        end = n
+    if end < 0:
+        end += n
+    if begin < 0:
+        begin += n
+    sl = [slice(None)] * x.ndim
+    sl[axis] = slice(begin, end)
+    return x[tuple(sl)]
+
+
+@register('slice_like')
+def slice_like(x, shape_like, axes=()):
+    sl = [slice(None)] * x.ndim
+    axes = axes or range(x.ndim)
+    for ax in axes:
+        sl[ax] = slice(0, shape_like.shape[ax])
+    return x[tuple(sl)]
+
+
+@register('_slice_like_internal')
+def _slice_like_internal(x):
+    return x
+
+
+@register('where_nd', aliases=())
+def where_nd(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register('tril')
+def tril(x, k=0):
+    return jnp.tril(x, k=k)
+
+
+@register('triu')
+def triu(x, k=0):
+    return jnp.triu(x, k=k)
+
+
+@register('diag')
+def diag(x, k=0):
+    return jnp.diag(x, k=k)
+
+
+@register('diagonal')
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register('diagflat')
+def diagflat(x, k=0):
+    return jnp.diagflat(x, k=k)
+
+
+@register('trace')
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register('searchsorted', differentiable=False)
+def searchsorted(a, v, side='left'):
+    return jnp.searchsorted(a, v, side=side)
+
+
+@register('argwhere', differentiable=False)
+def argwhere(x, size=None):
+    return jnp.argwhere(x, size=size)
+
+
+@register('nonzero', differentiable=False)
+def nonzero(x, size=None):
+    return jnp.nonzero(x, size=size)
+
+
+@register('boolean_mask', differentiable=False)
+def boolean_mask(data, index, axis=0):
+    """Reference: src/operator/contrib/boolean_mask.cc. Dynamic output shape
+    — host-side in eager mode; unsupported under jit (use masking instead)."""
+    mask = index.astype(bool)
+    return jnp.compress(mask, data, axis=axis)
+
+
+@register('sequence_mask')
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    """Reference: src/operator/sequence_mask.cc."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    bshape = [1] * data.ndim
+    bshape[axis] = maxlen
+    steps = steps.reshape(bshape)
+    batch_axis = 1 if axis == 0 else 0
+    lshape = [1] * data.ndim
+    lshape[batch_axis] = data.shape[batch_axis]
+    lens = sequence_length.reshape(lshape)
+    return jnp.where(steps < lens, data, value)
+
+
+@register('reverse', aliases=('SequenceReverse_simple',))
+def reverse(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+@register('meshgrid')
+def meshgrid(*xs, indexing='xy'):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+@register('broadcast_arrays')
+def broadcast_arrays(*xs):
+    return tuple(jnp.broadcast_arrays(*xs))
+
+
+@register('atleast_1d')
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@register('atleast_2d')
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@register('atleast_3d')
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+@register('insert')
+def insert(arr, obj, values, axis=None):
+    return jnp.insert(arr, obj, values, axis=axis)
+
+
+@register('delete')
+def delete(arr, obj, axis=None):
+    return jnp.delete(arr, obj, axis=axis)
+
+
+@register('append')
+def append(arr, values, axis=None):
+    return jnp.append(arr, values, axis=axis)
+
+
+@register('resize')
+def resize(a, new_shape):
+    return jnp.resize(a, new_shape)
+
+
+@register('interp')
+def interp(x, xp, fp, left=None, right=None):
+    return jnp.interp(x, xp, fp, left=left, right=right)
+
+
+@register('fill_diagonal')
+def fill_diagonal(a, val, wrap=False):
+    return jnp.fill_diagonal(a, val, wrap=wrap, inplace=False)
+
+
+@register('ediff1d')
+def ediff1d(ary, to_end=None, to_begin=None):
+    return jnp.ediff1d(ary, to_end=to_end, to_begin=to_begin)
+
+
+@register('diff')
+def diff(a, n=1, axis=-1):
+    return jnp.diff(a, n=n, axis=axis)
+
+
+@register('cross')
+def cross(a, b, axis=-1):
+    return jnp.cross(a, b, axis=axis)
+
+
+@register('trapz')
+def trapz(y, x=None, dx=1.0, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+@register('isclose', differentiable=False)
+def isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register('allclose', differentiable=False)
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register('array_equal', differentiable=False)
+def array_equal(a, b):
+    return jnp.array_equal(a, b)
+
+
+@register('unravel_index', differentiable=False)
+def unravel_index(indices, shape):
+    return jnp.stack(jnp.unravel_index(indices, shape))
+
+
+@register('ravel_multi_index', differentiable=False, aliases=('ravel_index',))
+def ravel_multi_index(multi_index, shape):
+    idx = tuple(multi_index[i] for i in range(multi_index.shape[0]))
+    return jnp.ravel_multi_index(idx, shape, mode='clip')

@@ -1,0 +1,237 @@
+"""Random sampling ops.
+
+Reference: ``src/operator/random/`` samplers backed by per-device PRNG state
+(``random_generator.h``). Here each stochastic op is marked
+``stochastic=True`` in the registry, so the dispatch layer injects a fresh
+PRNG subkey from the Context-scoped generator (mxnet_tpu/_rng.py) — user
+code never handles keys, matching the reference's resource model, while the
+op itself stays pure (replayable for autograd, traceable for jit).
+
+These are frontends with a creation flavor: shape/ctx args, no array inputs
+(except the distribution-parameter broadcasting forms).
+"""
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .. import _rng
+from ..context import Context, current_context
+from .registry import register
+
+
+def _shape(shape, *params):
+    if shape is None:
+        bshape = jnp.broadcast_shapes(*[jnp.shape(p) for p in params]) \
+            if params else ()
+        return bshape
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register('random_uniform', stochastic=True, differentiable=False,
+          aliases=('uniform',))
+def uniform(low=0.0, high=1.0, size=None, dtype='float32', key=None):
+    shape = _shape(size, low, high)
+    low = jnp.asarray(low, dtype=dtype)
+    high = jnp.asarray(high, dtype=dtype)
+    return jax.random.uniform(key, shape, dtype=dtype,
+                              minval=0., maxval=1.) * (high - low) + low
+
+
+@register('random_normal', stochastic=True, differentiable=False,
+          aliases=('normal',))
+def normal(loc=0.0, scale=1.0, size=None, dtype='float32', key=None):
+    shape = _shape(size, loc, scale)
+    return jax.random.normal(key, shape, dtype=dtype) * scale + loc
+
+
+@register('random_randn', stochastic=True, differentiable=False,
+          aliases=('randn',))
+def randn(*shape, dtype='float32', key=None):
+    return jax.random.normal(key, shape, dtype=dtype)
+
+
+@register('random_rand', stochastic=True, differentiable=False,
+          aliases=('rand',))
+def rand(*shape, dtype='float32', key=None):
+    return jax.random.uniform(key, shape, dtype=dtype)
+
+
+@register('random_randint', stochastic=True, differentiable=False,
+          aliases=('randint',))
+def randint(low, high=None, size=None, dtype='int32', key=None):
+    if high is None:
+        low, high = 0, low
+    shape = _shape(size)
+    return jax.random.randint(key, shape, low, high, dtype=dtype)
+
+
+@register('random_gamma', stochastic=True, differentiable=False,
+          aliases=('gamma_sample',))
+def gamma_sample(shape_param=1.0, scale=1.0, size=None, dtype='float32',
+                 key=None):
+    shp = _shape(size, shape_param, scale)
+    return jax.random.gamma(key, jnp.asarray(shape_param, dtype=dtype),
+                            shp, dtype=dtype) * scale
+
+
+@register('random_exponential', stochastic=True, differentiable=False,
+          aliases=('exponential',))
+def exponential(scale=1.0, size=None, dtype='float32', key=None):
+    shp = _shape(size, scale)
+    return jax.random.exponential(key, shp, dtype=dtype) * scale
+
+
+@register('random_poisson', stochastic=True, differentiable=False,
+          aliases=('poisson',))
+def poisson(lam=1.0, size=None, dtype='float32', key=None):
+    shp = _shape(size, lam)
+    return jax.random.poisson(key, lam, shp).astype(dtype)
+
+
+@register('random_negative_binomial', stochastic=True, differentiable=False)
+def negative_binomial(k=1, p=0.5, size=None, dtype='float32', key=None):
+    shp = _shape(size)
+    lam = jax.random.gamma(key, float(k), shp) * ((1 - p) / p)
+    return jax.random.poisson(jax.random.fold_in(key, 1), lam, shp).astype(dtype)
+
+
+@register('random_beta', stochastic=True, differentiable=False,
+          aliases=('beta_sample',))
+def beta_sample(a, b, size=None, dtype='float32', key=None):
+    shp = _shape(size, a, b)
+    return jax.random.beta(key, a, b, shp, dtype=dtype)
+
+
+@register('random_chisquare', stochastic=True, differentiable=False,
+          aliases=('chisquare',))
+def chisquare(df, size=None, dtype='float32', key=None):
+    shp = _shape(size, df)
+    return jax.random.chisquare(key, df, shape=shp, dtype=dtype)
+
+
+@register('random_laplace', stochastic=True, differentiable=False,
+          aliases=('laplace',))
+def laplace(loc=0.0, scale=1.0, size=None, dtype='float32', key=None):
+    shp = _shape(size, loc, scale)
+    return jax.random.laplace(key, shp, dtype=dtype) * scale + loc
+
+
+@register('random_gumbel', stochastic=True, differentiable=False,
+          aliases=('gumbel',))
+def gumbel(loc=0.0, scale=1.0, size=None, dtype='float32', key=None):
+    shp = _shape(size, loc, scale)
+    return jax.random.gumbel(key, shp, dtype=dtype) * scale + loc
+
+
+@register('random_logistic', stochastic=True, differentiable=False,
+          aliases=('logistic',))
+def logistic(loc=0.0, scale=1.0, size=None, dtype='float32', key=None):
+    shp = _shape(size, loc, scale)
+    return jax.random.logistic(key, shp, dtype=dtype) * scale + loc
+
+
+@register('random_pareto', stochastic=True, differentiable=False,
+          aliases=('pareto',))
+def pareto(a, size=None, dtype='float32', key=None):
+    shp = _shape(size, a)
+    return jax.random.pareto(key, a, shape=shp, dtype=dtype)
+
+
+@register('random_power', stochastic=True, differentiable=False,
+          aliases=('power_sample',))
+def power_sample(a, size=None, dtype='float32', key=None):
+    shp = _shape(size, a)
+    u = jax.random.uniform(key, shp, dtype=dtype)
+    return u ** (1.0 / a)
+
+
+@register('random_rayleigh', stochastic=True, differentiable=False,
+          aliases=('rayleigh',))
+def rayleigh(scale=1.0, size=None, dtype='float32', key=None):
+    shp = _shape(size, scale)
+    u = jax.random.uniform(key, shp, dtype=dtype)
+    return scale * jnp.sqrt(-2.0 * jnp.log1p(-u))
+
+
+@register('random_weibull', stochastic=True, differentiable=False,
+          aliases=('weibull',))
+def weibull(a, size=None, dtype='float32', key=None):
+    shp = _shape(size, a)
+    u = jax.random.uniform(key, shp, dtype=dtype)
+    return (-jnp.log1p(-u)) ** (1.0 / a)
+
+
+@register('random_lognormal', stochastic=True, differentiable=False,
+          aliases=('lognormal',))
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype='float32', key=None):
+    shp = _shape(size, mean, sigma)
+    return jnp.exp(jax.random.normal(key, shp, dtype=dtype) * sigma + mean)
+
+
+@register('random_multinomial', stochastic=True, differentiable=False,
+          aliases=('sample_multinomial',))
+def multinomial(data, shape=None, get_prob=False, dtype='int32', key=None):
+    """Sample category indices given (batched) probabilities
+    (reference src/operator/random/sample_multinomial_op.cc)."""
+    n = 1 if shape is None else int(_np.prod(shape)) if not isinstance(
+        shape, int) else shape
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    out_shape = data.shape[:-1] + ((n,) if shape is not None else ())
+    idx = jax.random.categorical(
+        key, logits, axis=-1,
+        shape=data.shape[:-1] + (n,) if data.ndim > 1 else (n,))
+    if shape is None:
+        idx = jnp.squeeze(idx, -1)
+    idx = idx.reshape(out_shape) if shape is not None else idx
+    return idx.astype(dtype)
+
+
+@register('random_categorical', stochastic=True, differentiable=False,
+          aliases=('categorical',))
+def categorical(logits, num_samples=None, key=None):
+    shape = logits.shape[:-1] + ((num_samples,) if num_samples else ())
+    return jax.random.categorical(key, logits, axis=-1,
+                                  shape=shape or None)
+
+
+@register('random_choice', stochastic=True, differentiable=False,
+          aliases=('choice',))
+def choice(a, size=None, replace=True, p=None, key=None):
+    shp = _shape(size)
+    return jax.random.choice(key, a, shape=shp, replace=replace, p=p)
+
+
+@register('random_shuffle', stochastic=True, differentiable=False,
+          aliases=('shuffle',))
+def shuffle(x, key=None):
+    return jax.random.permutation(key, x, axis=0)
+
+
+@register('random_permutation', stochastic=True, differentiable=False,
+          aliases=('permutation',))
+def permutation(x, key=None):
+    return jax.random.permutation(key, x)
+
+
+@register('random_bernoulli', stochastic=True, differentiable=False,
+          aliases=('bernoulli',))
+def bernoulli(prob=0.5, size=None, dtype='float32', key=None):
+    shp = _shape(size, prob)
+    return jax.random.bernoulli(key, prob, shp).astype(dtype)
+
+
+@register('random_multivariate_normal', stochastic=True, differentiable=False,
+          aliases=('multivariate_normal',))
+def multivariate_normal(mean, cov, size=None, key=None):
+    shp = _shape(size) if size is not None else None
+    return jax.random.multivariate_normal(key, mean, cov, shape=shp)
+
+
+def seed(seed_state, ctx='all'):
+    """mx.random.seed (reference python/mxnet/random.py:seed)."""
+    _rng.seed(seed_state, ctx)
+    _np.random.seed(int(seed_state) & 0x7fffffff)

@@ -1,0 +1,150 @@
+"""Reductions, sorting, ordering ops.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op*`` +
+``ordering_op``(topk/sort/argsort) + numpy reductions. XLA lowers these to
+tree reductions over the VPU; no custom kernels needed at this size.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _axis_tuple(axis):
+    if axis is None or isinstance(axis, (tuple, list)):
+        return axis
+    return (axis,)
+
+
+def _reg(name, fn, nondiff=False, aliases=()):
+    register(name, differentiable=not nondiff, aliases=aliases)(fn)
+
+
+for nm in ['sum', 'mean', 'prod', 'max', 'min', 'amax', 'amin', 'nansum',
+           'nanprod', 'nanmax', 'nanmin', 'median', 'nanmean', 'ptp']:
+    def _mk(nm=nm):
+        f = getattr(jnp, nm)
+        def op(x, **kw):
+            return f(x, **kw)
+        op.__name__ = nm
+        return op
+    _reg(nm, _mk())
+
+for nm in ['argmax', 'argmin', 'nanargmax', 'nanargmin', 'count_nonzero']:
+    def _mk2(nm=nm):
+        f = getattr(jnp, nm)
+        def op(x, **kw):
+            return f(x, **kw)
+        op.__name__ = nm
+        return op
+    _reg(nm, _mk2(), nondiff=True)
+
+
+@register('std')
+def std(x, axis=None, ddof=0, keepdims=False):
+    return jnp.std(x, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register('var')
+def var(x, axis=None, ddof=0, keepdims=False):
+    return jnp.var(x, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register('average')
+def average(x, axis=None, weights=None, returned=False):
+    return jnp.average(x, axis=axis, weights=weights, returned=returned)
+
+
+@register('cumsum')
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@register('cumprod')
+def cumprod(x, axis=None, dtype=None):
+    return jnp.cumprod(x, axis=axis, dtype=dtype)
+
+
+@register('all', differentiable=False)
+def all_(x, axis=None, keepdims=False):
+    return jnp.all(x, axis=axis, keepdims=keepdims)
+
+
+@register('any', differentiable=False)
+def any_(x, axis=None, keepdims=False):
+    return jnp.any(x, axis=axis, keepdims=keepdims)
+
+
+@register('norm')
+def norm(x, ord=None, axis=None, keepdims=False):
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register('sort')
+def sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register('argsort', differentiable=False)
+def argsort(x, axis=-1, is_ascend=True, dtype=None):
+    idx = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx if dtype is None else idx.astype(dtype)
+
+
+@register('topk', differentiable=False)
+def topk(x, axis=-1, k=1, ret_typ='indices', is_ascend=False, dtype='float32'):
+    """Reference: src/operator/tensor/ordering_op.cc topk.
+
+    On TPU, ``lax.top_k`` maps to an efficient sort network; for non-last
+    axes we transpose in and out (XLA fuses the transposes).
+    """
+    xm = -x if is_ascend else x
+    moved = jnp.moveaxis(xm, axis, -1)
+    vals, idx = lax.top_k(moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == 'indices':
+        return idx.astype(dtype)
+    if ret_typ == 'value':
+        return vals
+    if ret_typ == 'both':
+        return vals, idx.astype(dtype)
+    raise ValueError(f'unknown ret_typ {ret_typ}')
+
+
+@register('unique', differentiable=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, size=None):
+    return jnp.unique(x, return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis, size=size)
+
+
+@register('histogram', differentiable=False)
+def histogram(x, bins=10, range=None):
+    return jnp.histogram(x, bins=bins, range=range)
+
+
+@register('bincount', differentiable=False)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@register('percentile')
+def percentile(x, q, axis=None, keepdims=False, interpolation='linear'):
+    return jnp.percentile(x, q, axis=axis, keepdims=keepdims,
+                          method=interpolation)
+
+
+@register('quantile')
+def quantile(x, q, axis=None, keepdims=False, interpolation='linear'):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdims,
+                        method=interpolation)

@@ -1,0 +1,183 @@
+"""Op registry + imperative dispatch.
+
+Replaces three reference components at once (SURVEY §3.1 call stack):
+
+* the NNVM op registry (``NNVM_REGISTER_OP``, e.g.
+  src/operator/nn/fully_connected.cc:251) → :class:`Op` records in a dict;
+* the PackedFunc FFI layer (src/api/operator/**, src/runtime/registry.cc) →
+  plain Python calls, since frontend and "kernels" share the process;
+* ``Imperative::Invoke`` → ``InvokeOp`` → ``Engine::PushAsync``
+  (src/imperative/imperative.cc:98,49) → :func:`apply_op`, which dispatches
+  to a pure jax function. JAX's async dispatch plays the role of the
+  ThreadedEngine: the call returns as soon as the work is enqueued on the
+  TPU stream, and ``wait_to_read``/``asnumpy`` are the sync points.
+
+Shape/dtype inference (the reference's FInferShape/FInferType attributes) is
+implicit: jax's abstract evaluation computes output avals during dispatch.
+"""
+
+import functools
+
+import jax
+
+from .. import _rng, _tape
+
+_OPS = {}
+
+
+class Op:
+    """One registered operator.
+
+    Attributes mirror the reference's op attrs (include/mxnet/op_attr_types.h):
+    ``fn`` ≙ FCompute (but pure, over jax arrays), ``differentiable=False`` ≙
+    MakeZeroGradNodes, ``stochastic`` ≙ FResourceRequest[kRandom] — the
+    dispatch layer injects a PRNG key kwarg drawn from the context RNG
+    resource (see mxnet_tpu/_rng.py).
+    """
+
+    __slots__ = ('name', 'fn', 'differentiable', 'stochastic', 'namespaces',
+                 'aliases', 'wrap')
+
+    def __init__(self, name, fn, differentiable=True, stochastic=False,
+                 namespaces=('np', 'nd'), aliases=(), wrap=None):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.stochastic = stochastic
+        self.namespaces = namespaces
+        self.aliases = aliases
+        self.wrap = wrap
+
+
+def register(name=None, differentiable=True, stochastic=False,
+             namespaces=('np', 'nd'), aliases=(), wrap=None):
+    """Decorator registering a raw-array function as an operator.
+
+    The decorated ``fn`` takes jax arrays (plus static kwargs) and returns a
+    jax array or tuple of them. A generic NDArray-level wrapper is generated
+    by the frontend (ndarray/register.py) unless ``wrap`` supplies a custom
+    one.
+    """
+
+    def deco(fn):
+        opname = name or fn.__name__
+        op = Op(opname, fn, differentiable=differentiable,
+                stochastic=stochastic, namespaces=namespaces,
+                aliases=aliases, wrap=wrap)
+        _OPS[opname] = op
+        for a in aliases:
+            _OPS[a] = op
+        return fn
+
+    return deco
+
+
+def get_op(name):
+    return _OPS[name]
+
+
+def list_ops():
+    return dict(_OPS)
+
+
+def apply_op(op, arrays, fn, n_out=None, name=None):
+    """Imperative dispatch of a pure function over NDArray inputs.
+
+    ``arrays``: NDArray inputs participating in autograd. ``fn``: closure over
+    their raw arrays (constants already baked in). Returns raw output(s);
+    the caller wraps them. If autograd is recording and any input is tracked,
+    a TapeNode is attached to the outputs (reference: Imperative::RecordOp).
+    """
+    from ..ndarray.ndarray import NDArray, _wrap_out
+
+    raws = [a._data for a in arrays]
+    recording = _tape.is_recording() and _tape._needs_grad(arrays)
+    vjp_fn = None
+    if recording and op.differentiable and _tape.is_training():
+        outs, vjp_fn = jax.vjp(fn, *raws)
+    else:
+        outs = fn(*raws)
+    multi = isinstance(outs, (tuple, list))
+    out_list = list(outs) if multi else [outs]
+
+    wrapped = [_wrap_out(o, arrays) for o in out_list]
+    if recording and op.differentiable:
+        node = _tape.TapeNode(
+            fn, raws, [getattr(a, '_ag', None) for a in arrays],
+            len(out_list), name or op.name, vjp_fn=vjp_fn,
+            out_avals=[jax.typeof(o) for o in out_list], multi=multi)
+        for i, w in enumerate(wrapped):
+            w._ag = _tape.AGInfo(node=node, index=i)
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+def invoke(op_name, args, kwargs):
+    """Generic call path used by generated frontend functions.
+
+    Splits NDArray args from constants, builds the pure closure, dispatches.
+    Handles ``out=`` keyword by writing into the given array (reference op
+    signature convention).
+    """
+    from ..ndarray.ndarray import NDArray
+
+    op = _OPS[op_name] if isinstance(op_name, str) else op_name
+    out = kwargs.pop('out', None)
+    if op.stochastic:
+        kwargs.setdefault('key', _rng.next_key())
+
+    # split tracked NDArrays (incl. inside list/tuple args, e.g. concat)
+    arr_slots = []   # (pos, sub_index or None)
+    arrays = []
+    consts = list(args)
+    for i, a in enumerate(args):
+        if isinstance(a, NDArray):
+            arr_slots.append((i, None))
+            arrays.append(a)
+        elif isinstance(a, (list, tuple)):
+            consts[i] = list(a)
+            for j, e in enumerate(a):
+                if isinstance(e, NDArray):
+                    arr_slots.append((i, j))
+                    arrays.append(e)
+    kw_arr = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
+    kw_static = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
+    kw_keys = list(kw_arr)
+    arrays = arrays + [kw_arr[k] for k in kw_keys]
+
+    fn_raw = op.fn
+    npos = len(arr_slots)
+
+    def fn(*raws):
+        a = [list(x) if isinstance(x, list) else x for x in consts]
+        for (i, j), r in zip(arr_slots, raws[:npos]):
+            if j is None:
+                a[i] = r
+            else:
+                a[i][j] = r
+        kw = dict(kw_static)
+        for k, r in zip(kw_keys, raws[npos:]):
+            kw[k] = r
+        return fn_raw(*a, **kw)
+
+    res = apply_op(op, arrays, fn, name=op.name)
+    if out is not None:
+        if isinstance(res, tuple):
+            raise ValueError('out= not supported for multi-output op')
+        out._rebind(res._data)
+        return out
+    return res
+
+
+def make_frontend(op_name):
+    """Generate the user-facing function for an op (≙ codegen in
+    reference python/mxnet/ndarray/register.py:265)."""
+    op = _OPS[op_name]
+    if op.wrap is not None:
+        return op.wrap
+
+    @functools.wraps(op.fn)
+    def frontend(*args, **kwargs):
+        return invoke(op, args, kwargs)
+
+    frontend.__name__ = op_name
+    return frontend

@@ -1,0 +1,594 @@
+"""``mx.optimizer`` — optimization algorithms.
+
+Reference: ``python/mxnet/optimizer/`` (base optimizer.py:29 + one file per
+algorithm) backed by fused C++/CUDA kernels (src/operator/optimizer_op.cc).
+TPU design: each update rule is a pure jitted function over (weight, grad,
+state...); XLA fuses the whole rule into one kernel, which is exactly what
+the reference's hand-fused `sgd_mom_update`-style kernels achieve. Scalar
+hyperparameters (lr, wd) are traced arguments so step-varying schedules
+don't trigger recompilation.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register as _register_factory, registry_create
+from ..ndarray.ndarray import NDArray
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer/optimizer.py:29)."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0, use_fused_step=True):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.param_dict = param_dict or {}
+        self.idx2name = param_idx2name or {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f'Cannot find optimizer {name}')
+
+    # ------------------------------------------------------------------ state
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return self.create_state(index, weight)
+
+    # ------------------------------------------------------------------- meta
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        param = self.param_dict.get(index)
+        if param is not None:
+            lr *= getattr(param, 'lr_mult', 1.0)
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        param = self.param_dict.get(index)
+        if param is not None:
+            wd *= getattr(param, 'wd_mult', 1.0)
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _prep(self, grad):
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    # ---------------------------------------------------------------- updates
+    def update(self, index, weight, grad, state):
+        """In-place weight update. Accepts single values or lists
+        (reference optimizer.py:295 supports aggregate updates)."""
+        if isinstance(weight, (list, tuple)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self._update_one(i, w, g, s)
+        else:
+            self._update_one(index, weight, grad, state)
+
+    update_multi_precision = update
+
+    def _update_one(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        new_w, new_state = self.step(weight._data, grad._data, state, lr, wd,
+                                     t)
+        weight._rebind(new_w)
+        self._write_state(state, new_state)
+
+    def _write_state(self, state, new_state):
+        if state is None:
+            return
+        if isinstance(state, NDArray):
+            state._rebind(new_state if not isinstance(new_state, tuple)
+                          else new_state[0])
+        elif isinstance(state, (list, tuple)):
+            for s, n in zip(state, new_state):
+                if isinstance(s, NDArray):
+                    s._rebind(n)
+
+    def step(self, w, g, state, lr, wd, t):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f'{type(self).__name__}(lr={self.lr})'
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _zeros_like_nd(weight):
+    return NDArray(jnp.zeros_like(weight._data), ctx=weight._ctx)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference optimizer/sgd.py:111; fused kernel
+    src/operator/optimizer_op.cc sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _zeros_like_nd(weight)
+        return None
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        if self.momentum == 0.0:
+            return _sgd_step(w, g, lr), None
+        mom = state._data
+        new_mom = self.momentum * mom - lr * g
+        return w + new_mom, new_mom
+
+
+@jax.jit
+def _sgd_step(w, g, lr):
+    return w - lr * g
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer/nag.py)."""
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        if self.momentum == 0.0:
+            return w - lr * g, None
+        mom = state._data
+        new_mom = self.momentum * mom - lr * g
+        return w + self.momentum * new_mom - lr * g, new_mom
+
+
+@register
+class Adam(Optimizer):
+    """Reference optimizer/adam.py; fused kernel adam_update."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        m, v = state[0]._data, state[1]._data
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        if self.correct_bias:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        return w - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (reference contrib adamw op
+    src/operator/contrib/adamw.cc)."""
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g)
+        m, v = state[0]._data, state[1]._data
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        return w - lr * (mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w), \
+            (m, v)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        m, u = state[0]._data, state[1]._data
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        lr_t = lr / (1 - self.beta1 ** t)
+        return w - lr_t * m / (u + 1e-8), (m, u)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t1 = self.beta1 * (1 - 0.5 *
+                                    0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        m_schedule_next = self.m_schedule * momentum_t1
+        m, v = state[0]._data, state[1]._data
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        g_prime = g / (1 - self.m_schedule)
+        m_prime = m / (1 - m_schedule_next)
+        v_prime = v / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t1 * m_prime
+        return w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon), (m, v)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return _zeros_like_nd(weight)
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        h = state._data + g * g
+        return w - lr * g / (jnp.sqrt(h) + self.epsilon), h
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        acc_g, acc_d = state[0]._data, state[1]._data
+        acc_g = self.rho * acc_g + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_d + self.epsilon) / \
+            jnp.sqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * delta * delta
+        return w - lr * delta, (acc_g, acc_d)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.rho = rho
+        self.momentum = momentum
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like_nd(weight), _zeros_like_nd(weight),
+                    _zeros_like_nd(weight))
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        if self.centered:
+            n, gbar, mom = (s._data for s in state)
+            n = self.rho * n + (1 - self.rho) * g * g
+            gbar = self.rho * gbar + (1 - self.rho) * g
+            mom = self.momentum * mom - lr * g / jnp.sqrt(
+                n - gbar * gbar + self.epsilon)
+            new_w = w + mom
+            out_state = (n, gbar, mom)
+        else:
+            n, mom = state[0]._data, state[1]._data
+            n = self.rho * n + (1 - self.rho) * g * g
+            mom = self.momentum * mom - lr * g / jnp.sqrt(n + self.epsilon)
+            new_w = w + mom
+            out_state = (n, mom)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        return new_w, out_state
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g)
+        z, n = state[0]._data, state[1]._data
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + g * g
+        new_w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1) /
+            ((self.beta + jnp.sqrt(n)) / lr + wd), 0.0)
+        return new_w, (z, n)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight),
+                _zeros_like_nd(weight))
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        d, v, z = (s._data for s in state)
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / lr * \
+            (jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * w
+        return -z / d_t, (d_t, v, z)
+
+
+@register
+class Signum(Optimizer):
+    """signSGD with momentum (reference optimizer/signum.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _zeros_like_nd(weight)
+        return None
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g)
+        if state is not None:
+            mom = state._data
+            mom = self.momentum * mom - (1 - self.momentum) * g
+            new_w = (1 - lr * (wd + self.wd_lh)) * w + lr * jnp.sign(mom)
+            return new_w, mom
+        return (1 - lr * (wd + self.wd_lh)) * w - lr * jnp.sign(g), None
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer/sgld.py)."""
+
+    def step(self, w, g, state, lr, wd, t):
+        from .. import _rng
+        g = self._prep(g) + wd * w
+        noise = jax.random.normal(_rng.next_key(), w.shape,
+                                  dtype=w.dtype) * math.sqrt(lr)
+        return w - lr / 2 * g + noise, None
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight) if self.momentum != 0.0 else None,
+                NDArray(weight._data, ctx=weight._ctx))
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g) + wd * w
+        mom, prev = state
+        prev_w = prev._data
+        comp = self.lamda * g * g * (w - prev_w)
+        if mom is not None:
+            m = self.momentum * mom._data - lr * (g + comp)
+            new_w = w + m
+            mom._rebind(m)
+        else:
+            new_w = w - lr * (g + comp)
+        prev._rebind(new_w)
+        return new_w, state
+
+    def _write_state(self, state, new_state):
+        pass  # managed in step
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference optimizer/lars.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _zeros_like_nd(weight)
+        return None
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g)
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon), 1.0)
+        g = g + wd * w
+        if state is not None:
+            mom = state._data
+            mom = self.momentum * mom + trust * lr * g
+            return w - mom, mom
+        return w - trust * lr * g, None
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise Adam for large batches (reference optimizer/lamb.py,
+    fused multi_lamb kernels src/operator/contrib/multi_lamb.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g)
+        m, v = state[0]._data, state[1]._data
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        if self.bias_correction:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w
+        w_norm = jnp.linalg.norm(w)
+        r_norm = jnp.linalg.norm(r)
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return w - lr * ratio * r, (m, v)
+
+
+@register
+class LANS(LAMB):
+    """LAMB + Nesterov (reference optimizer/lans.py)."""
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep(g)
+        g = g / jnp.maximum(jnp.linalg.norm(g), 1e-12)
+        return super().step(w, g * jnp.linalg.norm(g), state, lr, wd, t)
+
+
+class Updater:
+    """KVStore-server-side updater wrapper (reference optimizer/updater.py).
+
+    Keeps per-key state dict; used by `update_on_kvstore` mode and by the
+    classic `mx.kvstore.KVStore.set_optimizer` path.
+    """
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+    def set_states(self, states):
+        import pickle
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,13 @@
+"""``mx.parallel`` — SPMD parallelism over device meshes.
+
+This package is the TPU-native capability layer that the reference never had
+(SURVEY §2.3: TP/PP/SP absent in MXNet): mesh construction, sharding
+specs, sharded train steps, and ring attention for sequence/context
+parallelism. Built on jax.sharding + pjit/shard_map; collectives ride ICI
+within a slice and DCN across slices.
+"""
+
+from .mesh import (MeshConfig, make_mesh, data_parallel_mesh,
+                   split_and_load, local_devices)
+from .sharded import shard_params, replicate, make_sharded_train_step
+from . import ring_attention

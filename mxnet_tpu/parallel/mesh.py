@@ -1,0 +1,81 @@
+"""Mesh construction + batch splitting utilities.
+
+The mesh replaces the reference's device-topology machinery
+(gpu_topology.h's PCIe/NVLink tree discovery): TPU topology is exposed
+through jax's device order, and XLA routes collectives over ICI optimally
+for the mesh shape — nothing to hand-tune.
+"""
+
+import numpy as _np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ndarray.ndarray import NDArray, array
+
+
+class MeshConfig:
+    """Named axis sizes for a parallelism plan: dp/tp/pp/sp/ep."""
+
+    def __init__(self, dp=1, tp=1, pp=1, sp=1, ep=1):
+        self.axes = {'dp': dp, 'tp': tp, 'pp': pp, 'sp': sp, 'ep': ep}
+
+    def active_axes(self):
+        return {k: v for k, v in self.axes.items() if v > 1} or {'dp': 1}
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def make_mesh(config=None, devices=None, **axes):
+    """Build a jax Mesh from axis sizes, e.g. make_mesh(dp=2, tp=4)."""
+    if config is not None:
+        axes = config.active_axes()
+    if not axes:
+        axes = {'dp': len(devices or jax.devices())}
+    devices = devices or jax.devices()
+    sizes = list(axes.values())
+    n = int(_np.prod(sizes))
+    assert n <= len(devices), (
+        f'mesh needs {n} devices, have {len(devices)}')
+    dev_array = _np.array(devices[:n]).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def data_parallel_mesh(devices=None):
+    devices = devices or jax.devices()
+    return Mesh(_np.array(devices), ('dp',))
+
+
+def split_and_load(data, ctx_list=None, batch_axis=0, even_split=True,
+                   mesh=None):
+    """Reference gluon/utils.py split_and_load: split a batch across
+    devices. Two modes:
+
+    * ctx_list: returns per-context NDArray copies (reference semantics);
+    * mesh: returns ONE NDArray sharded over the mesh 'dp' axis — the
+      TPU-idiomatic form (no per-device Python loop; XLA sees the global
+      array).
+    """
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P(*(
+            ('dp',) + (None,) * (data.ndim - 1))))
+        return NDArray(jax.device_put(data._data, sharding))
+    if ctx_list is None:
+        raise ValueError('need ctx_list or mesh')
+    n = len(ctx_list)
+    if n == 1:
+        return [data.as_in_context(ctx_list[0])]
+    size = data.shape[batch_axis]
+    step = size // n
+    slices = []
+    for i, ctx in enumerate(ctx_list):
+        begin = i * step
+        end = (i + 1) * step if i < n - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)].as_in_context(ctx))
+    return slices

@@ -1,0 +1,94 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+NEW capability over the reference (SURVEY §2.3: SP/CP absent in MXNet —
+its longest-sequence asset is the fused attention matmul ops,
+src/operator/contrib/transformer.cc:650-826, single device).
+
+Design (Liu et al., Ring Attention; blockwise online-softmax): the sequence
+axis is sharded over mesh axis 'sp'. Each device holds Q/K/V blocks for its
+shard; K/V blocks rotate around the ring via ``lax.ppermute`` (ICI
+neighbor-to-neighbor — bandwidth-optimal) while each device accumulates its
+Q-block's attention with numerically-stable online softmax. Compute on the
+current block overlaps the transfer of the next, so the ring latency hides
+behind the matmuls.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _online_block(q, k, v, m_prev, l_prev, o_prev, scale, mask=None):
+    """One blockwise-softmax accumulation step.
+
+    q: (B, H, Sq, D); k/v: (B, H, Sk, D); running max m, denom l, out o.
+    """
+    s = jnp.einsum('bhqd,bhkd->bhqk', q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (all -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l_cur = jnp.sum(p, axis=-1)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_new = l_prev * alpha + l_cur
+    o_new = o_prev * alpha[..., None] + jnp.einsum('bhqk,bhkd->bhqd', p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention_kernel(q, k, v, axis_name='sp', causal=False):
+    """Per-shard ring attention body — call inside shard_map over 'sp'.
+
+    q, k, v: (B, H, S_local, D) — this device's sequence shard.
+    """
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = q.shape[-1] ** -0.5
+    B, H, Sl, D = q.shape
+
+    m = jnp.full((B, H, Sl), -jnp.inf, dtype=jnp.float32)
+    l = jnp.zeros((B, H, Sl), dtype=jnp.float32)
+    o = jnp.zeros((B, H, Sl, D), dtype=jnp.float32)
+    qf = q.astype(jnp.float32)
+
+    def body(i, carry):
+        m, l, o, k_blk, v_blk = carry
+        src_idx = (my_idx - i) % axis_size  # whose K/V we now hold
+        if causal:
+            # block-level causality: full block if src < mine, diagonal if ==
+            q_pos = my_idx * Sl + jnp.arange(Sl)[:, None]
+            k_pos = src_idx * Sl + jnp.arange(Sl)[None, :]
+            mask = (q_pos >= k_pos)[None, None]
+        else:
+            mask = None
+        m, l, o = _online_block(qf, k_blk.astype(jnp.float32),
+                                v_blk.astype(jnp.float32), m, l, o, scale,
+                                mask)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return m, l, o, k_blk, v_blk
+
+    m, l, o, _, _ = lax.fori_loop(0, axis_size, body, (m, l, o, k, v))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name='sp', causal=False):
+    """Sharded full attention: q/k/v (B, H, S, D) with S sharded over
+    ``axis_name``. Returns output with identical sharding."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ring_attention_kernel, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)

@@ -1,0 +1,124 @@
+"""``mx.profiler`` — tracing/profiling.
+
+Reference: ``python/mxnet/profiler.py`` over ``src/profiler/`` (chrome-trace
+JSON, aggregate stats). TPU design: delegate to ``jax.profiler`` — traces
+are written in the TensorBoard/XPlane format (viewable in Perfetto just like
+the reference's chrome traces), and ``dumps()`` reports per-op aggregate
+stats from a lightweight host-side recorder.
+"""
+
+import contextlib
+import time
+
+import jax
+
+_config = {'profile_all': False, 'filename': '/tmp/mxnet_tpu_profile',
+           'running': False}
+_records = []
+
+
+def set_config(profile_all=False, profile_symbolic=True,
+               profile_imperative=True, profile_memory=True, profile_api=True,
+               filename='/tmp/mxnet_tpu_profile', aggregate_stats=False,
+               **kwargs):
+    """Reference profiler.py set_config → MXSetProcessProfilerConfig."""
+    _config.update(profile_all=profile_all, filename=filename)
+
+
+def set_state(state='stop', profile_process='worker'):
+    if state == 'run':
+        start()
+    else:
+        stop()
+
+
+def start(profile_process='worker'):
+    if not _config['running']:
+        jax.profiler.start_trace(_config['filename'])
+        _config['running'] = True
+
+
+def stop(profile_process='worker'):
+    if _config['running']:
+        jax.profiler.stop_trace()
+        _config['running'] = False
+
+
+def pause(profile_process='worker'):
+    stop()
+
+
+def resume(profile_process='worker'):
+    start()
+
+
+def dump(finished=True, profile_process='worker'):
+    stop()
+
+
+def dumps(reset=False):
+    """Aggregate table of scoped timings recorded via profiler.scope/Marker."""
+    lines = ['Profile Statistics:', f'{"Name":<40}{"Count":>8}{"Total(ms)":>12}']
+    agg = {}
+    for name, dt in _records:
+        c, t = agg.get(name, (0, 0.0))
+        agg[name] = (c + 1, t + dt)
+    for name, (c, t) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f'{name:<40}{c:>8}{t * 1e3:>12.3f}')
+    if reset:
+        _records.clear()
+    return '\n'.join(lines)
+
+
+@contextlib.contextmanager
+def scope(name='<unk>:'):
+    """Reference profiler.scope — also emits a jax named annotation so the
+    region shows up in the device trace."""
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    _records.append((name, time.perf_counter() - t0))
+
+
+class Task:
+    def __init__(self, name, domain=None):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            _records.append((self.name, time.perf_counter() - self._t0))
+
+
+Frame = Task
+Event = Task
+
+
+class Counter:
+    def __init__(self, name, domain=None, value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+class Marker:
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope='process'):
+        _records.append((self.name, 0.0))
+
+
+def server_annotation(*a, **kw):
+    """TensorBoard server-side annotations — jax.profiler owns the server."""

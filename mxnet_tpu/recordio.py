@@ -1,0 +1,216 @@
+"""RecordIO file format (reference python/mxnet/recordio.py:36,215 +
+dmlc-core RecordIO).
+
+Binary-compatible with the reference format so datasets packed by the
+reference's ``tools/im2rec`` load here unchanged:
+
+* each record: [kMagic:u32][lrec:u32][data (padded to 4B)]
+  where lrec's upper 3 bits are a continuation flag and lower 29 the length;
+* ``IRHeader`` packed struct (flag, label, id, id2) for image records.
+
+The pure-Python reader is the portable path; a C++ indexer/reader
+(src_native/) accelerates bulk scans in later rounds.
+"""
+
+import ctypes
+import numbers
+import os
+import struct
+
+import numpy as _np
+
+_kMagic = 0xced7230a
+_IR_FORMAT = 'IfQQ'
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class IRHeader:
+    """Image-record header (reference recordio.py:343 IRHeader)."""
+
+    __slots__ = ('flag', 'label', 'id', 'id2')
+
+    def __init__(self, flag, label, id, id2):  # noqa: A002
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+
+def pack(header, s):
+    """Pack a header + payload into a record string
+    (reference recordio.py:pack)."""
+    label = header.label
+    if isinstance(label, numbers.Number):
+        hdr = struct.pack(_IR_FORMAT, 0, float(label), header.id, header.id2)
+        return hdr + s
+    label = _np.asarray(label, dtype=_np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Reference recordio.py:unpack."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = _np.frombuffer(s[:flag * 4], dtype=_np.float32)
+        s = s[flag * 4:]
+    header = IRHeader(flag, label, id_, id2)
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    header, s = unpack(s)
+    img = _decode_img(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt='.jpg'):
+    import cv2
+    if img_fmt.lower() in ('.jpg', '.jpeg'):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    else:
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, 'failed to encode image'
+    return pack(header, buf.tobytes())
+
+
+def _decode_img(s, iscolor=-1):
+    try:
+        import cv2
+        return cv2.imdecode(_np.frombuffer(s, dtype=_np.uint8), iscolor)
+    except ImportError:
+        from PIL import Image
+        import io
+        return _np.asarray(Image.open(io.BytesIO(s)))
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference recordio.py:36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == 'w':
+            self.record = open(self.uri, 'wb')
+            self.writable = True
+        elif self.flag == 'r':
+            self.record = open(self.uri, 'rb')
+            self.writable = False
+        else:
+            raise ValueError('Invalid flag %s' % self.flag)
+        self.pid = os.getpid()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d['record'] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        self.open()
+
+    def _check_pid(self, allow_reset=False):
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError('forked process must reset MXRecordIO')
+
+    def close(self):
+        if self.record is not None and not self.record.closed:
+            self.record.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid()
+        length = len(buf)
+        self.record.write(struct.pack('<II', _kMagic, length & 0x1fffffff))
+        self.record.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.record.write(b'\x00' * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        hdr = self.record.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, lrec = struct.unpack('<II', hdr)
+        assert magic == _kMagic, 'invalid record magic'
+        length = lrec & 0x1fffffff
+        buf = self.record.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.record.read(pad)
+        return buf
+
+    def tell(self):
+        return self.record.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.record.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO with .idx file (reference recordio.py:215)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split('\t')
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        elif self.writable:
+            self.fidx = open(self.idx_path, 'w')
+
+    def close(self):
+        super().close()
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d['fidx'] = None
+        return d
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f'{key}\t{pos}\n')
+        self.idx[key] = pos
+        self.keys.append(key)

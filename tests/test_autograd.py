@@ -1,0 +1,162 @@
+"""Autograd (reference tests/python/unittest/test_autograd.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_basic_backward():
+    x = mx.np.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 4 * np.array([1., 2., 3.]))
+
+
+def test_chain_rule():
+    x = mx.np.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.np.exp(mx.np.sin(x)).sum()
+    y.backward()
+    want = np.exp(np.sin([0.5, -0.5])) * np.cos([0.5, -0.5])
+    assert_almost_equal(x.grad, want, rtol=1e-5)
+
+
+def test_out_grad():
+    x = mx.np.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(mx.np.array([10., 100.]))
+    assert_almost_equal(x.grad, [30., 300.])
+
+
+def test_grad_req_add():
+    x = mx.np.array([1., 1.])
+    x.attach_grad(grad_req='add')
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad, [6., 6.])
+
+
+def test_multiple_variables():
+    a = mx.np.array([2.])
+    b = mx.np.array([3.])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = a * b + a
+    y.backward()
+    assert_almost_equal(a.grad, [4.])   # b + 1
+    assert_almost_equal(b.grad, [2.])   # a
+
+
+def test_grad_function():
+    x = mx.np.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    g = autograd.grad(y, x)
+    assert_almost_equal(g, 3 * np.array([1., 4., 9.]))
+    # .grad buffer untouched by autograd.grad
+    assert_almost_equal(x.grad, np.zeros(3))
+
+
+def test_detach_and_stop_gradient():
+    x = mx.np.array([2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, [4.])  # only d(y_const*x)/dx = y = 4
+    x2 = mx.np.array([2.])
+    x2.attach_grad()
+    with autograd.record():
+        w = mx.nd.stop_gradient(x2 * x2) * x2
+    w.backward()
+    assert_almost_equal(x2.grad, [4.])
+
+
+def test_pause_and_modes():
+    x = mx.np.array([1.])
+    x.attach_grad()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+            y_nograd = x * 5
+        y = x * 2
+    assert y_nograd._ag is None
+    y.backward()
+    assert_almost_equal(x.grad, [2.])
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_retain_graph():
+    x = mx.np.array([3.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    assert_almost_equal(x.grad, [6.])
+    y.backward()
+    assert_almost_equal(x.grad, [6.])  # write req overwrites
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = mx.np.array(1.0 / (1.0 + np.exp(-x.asnumpy())))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.np.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0, -1.0])))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-4)
+
+
+def test_numeric_gradient():
+    check_numeric_gradient(lambda x: (x * x + 3 * x).sum(),
+                           [np.random.randn(2, 3).astype('float32')])
+
+
+def test_grad_through_matmul():
+    a = np.random.randn(3, 4).astype('float32')
+    w = mx.np.array(np.random.randn(4, 2).astype('float32'))
+    w.attach_grad()
+    with autograd.record():
+        out = (mx.np.dot(mx.np.array(a), w)).sum()
+    out.backward()
+    assert_almost_equal(w.grad, a.sum(0)[:, None].repeat(2, 1), rtol=1e-4)
+
+
+def test_mark_variables_api():
+    x = mx.np.array([1.])
+    g = mx.np.zeros((1,))
+    autograd.mark_variables(x, g)
+    with autograd.record():
+        y = x * 7
+    y.backward()
+    assert_almost_equal(x.grad, [7.])

@@ -1,0 +1,284 @@
+"""Gluon blocks/layers (reference tests/python/unittest/test_gluon.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter('weight', shape=(10, 10))
+    p.initialize(init='xavier')
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    p.set_data(mx.np.ones((10, 10)))
+    assert_almost_equal(p.data(), np.ones((10, 10)))
+    p.zero_grad()
+    assert_almost_equal(p.grad(), np.zeros((10, 10)))
+
+
+def test_parameter_deferred_init():
+    p = gluon.Parameter('weight', shape=(4, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape = (4, 3)
+    p._finish_deferred_init()
+    assert p.data().shape == (4, 3)
+
+
+def test_constant():
+    c = gluon.Constant(mx.np.array([[1., 2.]]))
+    c.initialize()
+    assert c.grad_req == 'null'
+    assert_almost_equal(c.data(), [[1., 2.]])
+
+
+def test_dense():
+    net = nn.Dense(5, in_units=3, use_bias=True)
+    net.initialize()
+    x = mx.np.ones((2, 3))
+    out = net(x)
+    assert out.shape == (2, 5)
+    want = x.asnumpy() @ net.weight.data().asnumpy().T + \
+        net.bias.data().asnumpy()
+    assert_almost_equal(out, want, rtol=1e-5)
+
+
+def test_dense_deferred_shape():
+    net = nn.Dense(7)
+    net.initialize()
+    out = net(mx.np.ones((4, 3, 2)))  # flatten -> in_units 6
+    assert out.shape == (4, 7)
+    assert net.weight.shape == (7, 6)
+    net2 = nn.Dense(7, flatten=False)
+    net2.initialize()
+    out2 = net2(mx.np.ones((4, 3, 2)))
+    assert out2.shape == (4, 3, 7)
+
+
+def test_sequential():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation='relu'), nn.Dense(2))
+    net.initialize()
+    assert len(net) == 2
+    out = net(mx.np.ones((3, 5)))
+    assert out.shape == (3, 2)
+    params = net.collect_params()
+    assert set(params) == {'0.weight', '0.bias', '1.weight', '1.bias'}
+
+
+def test_conv_pool_shapes():
+    x = mx.np.array(np.random.randn(2, 3, 16, 16).astype('float32'))
+    conv = nn.Conv2D(8, kernel_size=3, padding=1)
+    conv.initialize()
+    assert conv(x).shape == (2, 8, 16, 16)
+    conv_s = nn.Conv2D(8, kernel_size=3, strides=2)
+    conv_s.initialize()
+    assert conv_s(x).shape == (2, 8, 7, 7)
+    grouped = nn.Conv2D(6, kernel_size=3, padding=1, groups=3)
+    grouped.initialize()
+    assert grouped(x).shape == (2, 6, 16, 16)
+    tconv = nn.Conv2DTranspose(4, kernel_size=2, strides=2)
+    tconv.initialize()
+    assert tconv(x).shape == (2, 4, 32, 32)
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 8, 8)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 8, 8)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    c1 = nn.Conv1D(4, kernel_size=3)
+    c1.initialize()
+    assert c1(mx.np.ones((2, 3, 10))).shape == (2, 4, 8)
+
+
+def test_conv_numeric():
+    # conv vs naive correlation
+    x = np.random.randn(1, 1, 5, 5).astype('float32')
+    conv = nn.Conv2D(1, kernel_size=3, use_bias=False, in_channels=1)
+    conv.initialize()
+    out = conv(mx.np.array(x)).asnumpy()
+    w = conv.weight.data().asnumpy()
+    want = np.zeros((1, 1, 3, 3), 'float32')
+    for i in range(3):
+        for j in range(3):
+            want[0, 0, i, j] = (x[0, 0, i:i + 3, j:j + 3] * w[0, 0]).sum()
+    assert_almost_equal(out, want, rtol=1e-4)
+
+
+def test_batchnorm():
+    bn = nn.BatchNorm()
+    bn.initialize()
+    x = mx.np.array(np.random.randn(8, 4, 3, 3).astype('float32') * 3 + 1)
+    with autograd.record():
+        out = bn(x)
+    xn = out.asnumpy()
+    assert abs(xn.mean(axis=(0, 2, 3))).max() < 1e-4
+    assert abs(xn.std(axis=(0, 2, 3)) - 1).max() < 1e-2
+    # running stats moved toward batch stats
+    assert abs(bn.running_mean.data().asnumpy()).sum() > 0
+    # inference uses running stats
+    out_inf = bn(x)
+    assert not np.allclose(out_inf.asnumpy(), xn)
+
+
+def test_layernorm_groupnorm():
+    x = mx.np.array(np.random.randn(2, 6, 4).astype('float32'))
+    ln = nn.LayerNorm()
+    ln.initialize()
+    out = ln(x).asnumpy()
+    assert abs(out.mean(-1)).max() < 1e-4
+    gn = nn.GroupNorm(num_groups=3)
+    gn.initialize()
+    assert gn(x).shape == (2, 6, 4)
+    inorm = nn.InstanceNorm()
+    inorm.initialize()
+    assert inorm(x).shape == (2, 6, 4)
+
+
+def test_dropout():
+    do = nn.Dropout(0.5)
+    x = mx.np.ones((100, 100))
+    # inference: identity
+    assert_almost_equal(do(x), np.ones((100, 100)))
+    with autograd.record():
+        y = do(x)
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.4 < frac < 0.6
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.np.array([[1, 2], [3, 4]])
+    out = emb(idx)
+    assert out.shape == (2, 2, 4)
+    assert_almost_equal(out[0, 0], emb.weight.data()[1])
+
+
+def test_activations():
+    x = mx.np.array([-2., 0., 2.])
+    assert_almost_equal(nn.Activation('relu')(x), [0, 0, 2])
+    lr = nn.LeakyReLU(0.1)
+    assert_almost_equal(lr(x), [-0.2, 0, 2], rtol=1e-5)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    assert prelu(x).shape == (3,)
+    for act in [nn.ELU(), nn.SELU(), nn.GELU(), nn.SiLU()]:
+        assert act(x).shape == (3,)
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation='relu'), nn.BatchNorm(), nn.Dense(3))
+    net.initialize()
+    x = mx.np.array(np.random.randn(4, 6).astype('float32'))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    h1 = net(x).asnumpy()   # first call (eager warmup)
+    h2 = net(x).asnumpy()   # compiled
+    assert_almost_equal(eager, h1, rtol=1e-5)
+    assert_almost_equal(h1, h2, rtol=1e-5)
+
+
+def test_hybridize_train_matches_eager():
+    np.random.seed(0)
+    x = mx.np.array(np.random.randn(8, 5).astype('float32'))
+    y = mx.np.array(np.random.randn(8, 1).astype('float32'))
+    loss_fn = gluon.loss.L2Loss()
+
+    def build():
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(4, activation='tanh'), nn.Dense(1))
+        net.initialize()
+        return net
+
+    grads = []
+    for hybrid in (False, True):
+        net = build()
+        if hybrid:
+            net.hybridize()
+            net(x)  # warmup builds cache
+        with autograd.record():
+            l = loss_fn(net(x), y).mean()
+        l.backward()
+        grads.append(net[0].weight.grad().asnumpy())
+    assert_almost_equal(grads[0], grads[1], rtol=1e-4, atol=1e-5)
+
+
+def test_save_load_parameters(tmp_path):
+    f = str(tmp_path / 'net.params.npz')
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = mx.np.ones((1, 3))
+    want = net(x).asnumpy()
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(f)
+    assert_almost_equal(net2(x), want)
+
+
+def test_share_parameters():
+    a = nn.Dense(4, in_units=3)
+    a.initialize()
+    b = nn.Dense(4, in_units=3)
+    b.share_parameters(a.collect_params())
+    b.initialize()
+    assert b.weight is a.weight
+
+
+def test_block_repr_and_apply():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2))
+    r = repr(net)
+    assert 'Dense' in r
+    seen = []
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert 'Dense' in seen
+
+
+def test_forward_hooks():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    calls = []
+    net.register_forward_pre_hook(lambda blk, args: calls.append('pre'))
+    net.register_forward_hook(lambda blk, args, out: calls.append('post'))
+    net(mx.np.ones((1, 2)))
+    assert calls == ['pre', 'post']
+
+
+def test_cast():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.cast('float16')
+    assert net.weight.data().dtype == np.float16
+
+
+def test_zero_grad_collect():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    with autograd.record():
+        y = net(mx.np.ones((1, 2))).sum()
+    y.backward()
+    assert abs(net.weight.grad().asnumpy()).sum() > 0
+    net.collect_params().zero_grad()
+    assert abs(net.weight.grad().asnumpy()).sum() == 0
+
+
+def test_lambda_blocks():
+    lam = nn.HybridLambda('square')
+    out = lam(mx.np.array([2., 3.]))
+    assert_almost_equal(out, [4., 9.])
+
+
+def test_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    net.summary(mx.np.ones((1, 3)))
+    assert 'Total params' in capsys.readouterr().out

@@ -1,0 +1,136 @@
+"""gluon.data (reference tests/python/unittest/test_gluon_data.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_array_dataset():
+    X = np.random.randn(10, 3).astype('float32')
+    Y = np.arange(10).astype('int32')
+    ds = gdata.ArrayDataset(X, Y)
+    assert len(ds) == 10
+    x, y = ds[3]
+    assert_almost_equal(x, X[3])
+    assert y == 3
+
+
+def test_simple_dataset_transform():
+    ds = gdata.SimpleDataset(list(range(10)))
+    doubled = ds.transform(lambda x: x * 2)
+    assert doubled[4] == 8
+    first = gdata.ArrayDataset(np.arange(6).reshape(3, 2).astype('float32'),
+                               np.arange(3)).transform_first(lambda x: x + 1)
+    x, y = first[0]
+    assert_almost_equal(x, [1., 2.])
+
+
+def test_dataset_shard_take_filter():
+    ds = gdata.SimpleDataset(list(range(10)))
+    s0 = ds.shard(3, 0)
+    s1 = ds.shard(3, 1)
+    s2 = ds.shard(3, 2)
+    assert len(s0) + len(s1) + len(s2) == 10
+    assert len(ds.take(4)) == 4
+    evens = ds.filter(lambda x: x % 2 == 0)
+    assert len(evens) == 5
+
+
+def test_samplers():
+    seq = list(gdata.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = list(gdata.RandomSampler(5))
+    assert sorted(rnd) == [0, 1, 2, 3, 4]
+    batches = list(gdata.BatchSampler(gdata.SequentialSampler(5), 2,
+                                      'keep'))
+    assert batches == [[0, 1], [2, 3], [4]]
+    batches = list(gdata.BatchSampler(gdata.SequentialSampler(5), 2,
+                                      'discard'))
+    assert batches == [[0, 1], [2, 3]]
+    sp = gdata.SplitSampler(10, num_parts=2, part_index=1, shuffle=False)
+    assert list(sp) == [5, 6, 7, 8, 9]
+    iv = list(gdata.IntervalSampler(6, 2))
+    assert iv == [0, 2, 4, 1, 3, 5]
+
+
+def test_dataloader_basic():
+    X = np.random.randn(10, 3).astype('float32')
+    Y = np.arange(10).astype('int32')
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, Y), batch_size=4,
+                              last_batch='keep')
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3)
+    assert_almost_equal(xb, X[:4])
+    assert batches[2][0].shape == (2, 3)
+
+
+def test_dataloader_shuffle_covers_all():
+    X = np.arange(20).astype('float32')
+    loader = gdata.DataLoader(gdata.SimpleDataset(X), batch_size=5,
+                              shuffle=True)
+    seen = np.concatenate([b.asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == X.tolist()
+
+
+def test_dataloader_multiworker():
+    X = np.random.randn(12, 2).astype('float32')
+    Y = np.arange(12).astype('int32')
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, Y), batch_size=4,
+                              num_workers=2, thread_pool=True)
+    batches = list(loader)
+    assert len(batches) == 3
+    total = np.concatenate([b[1].asnumpy() for b in batches])
+    assert sorted(total.tolist()) == list(range(12))
+
+
+def test_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = mx.np.array(np.random.randint(0, 255, (8, 8, 3)).astype('uint8'))
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 8, 8)
+    assert float(t.max().asnumpy()) <= 1.0
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
+    out = norm(t)
+    assert out.shape == (3, 8, 8)
+    comp = transforms.Compose([transforms.ToTensor(),
+                               transforms.Normalize(0.0, 1.0)])
+    assert comp(img).shape == (3, 8, 8)
+    resized = transforms.Resize(4)(img)
+    assert resized.shape[:2] == (4, 4)
+    flipped = transforms.RandomFlipLeftRight()(img)
+    assert flipped.shape == img.shape
+    cast = transforms.Cast('float16')(t)
+    assert cast.dtype == np.float16
+
+
+def test_record_file_dataset(tmp_path):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / 'data.rec')
+    idx = str(tmp_path / 'data.idx')
+    w = recordio.MXIndexedRecordIO(idx, rec, 'w')
+    for i in range(5):
+        w.write_idx(i, f'payload-{i}'.encode())
+    w.close()
+    ds = gdata.RecordFileDataset(rec)
+    assert len(ds) == 5
+    assert ds[3] == b'payload-3'
+
+
+def test_ndarray_iter():
+    from mxnet_tpu.io import NDArrayIter
+    X = np.random.randn(10, 3).astype('float32')
+    Y = np.arange(10).astype('float32')
+    it = NDArrayIter(X, Y, batch_size=4, last_batch_handle='pad')
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3)
+    assert batches[2].pad == 2
+    it.reset()
+    assert len(list(it)) == 3

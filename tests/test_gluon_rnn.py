@@ -1,0 +1,115 @@
+"""RNN cells and layers (reference tests/python/unittest/test_gluon_rnn.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_rnn_cells_shapes():
+    for cell_cls, n_states in [(rnn.RNNCell, 1), (rnn.LSTMCell, 2),
+                               (rnn.GRUCell, 1)]:
+        cell = cell_cls(16)
+        cell.initialize()
+        x = mx.np.array(np.random.randn(4, 8).astype('float32'))
+        states = cell.begin_state(4)
+        out, new_states = cell(x, states)
+        assert out.shape == (4, 16)
+        assert len(new_states) == n_states
+
+
+def test_cell_unroll():
+    cell = rnn.LSTMCell(8)
+    cell.initialize()
+    x = mx.np.array(np.random.randn(2, 5, 4).astype('float32'))  # NTC
+    outs, states = cell.unroll(5, x, layout='NTC', merge_outputs=True)
+    assert outs.shape == (2, 5, 8)
+    assert len(states) == 2
+
+
+def test_sequential_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8))
+    stack.add(rnn.LSTMCell(8))
+    stack.initialize()
+    x = mx.np.array(np.random.randn(3, 4).astype('float32'))
+    out, states = stack(x, stack.begin_state(3))
+    assert out.shape == (3, 8)
+    assert len(states) == 4
+
+
+def test_dropout_zoneout_residual_cells():
+    base = rnn.GRUCell(6)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    x = mx.np.array(np.random.randn(2, 6).astype('float32'))
+    out, _ = res(x, res.begin_state(2))
+    assert out.shape == (2, 6)
+    drop = rnn.DropoutCell(0.5)
+    out2, _ = drop(x, [])
+    assert out2.shape == (2, 6)
+
+
+def test_rnn_layers_shapes():
+    x = mx.np.array(np.random.randn(7, 3, 5).astype('float32'))  # TNC
+    for layer_cls, n_states in [(rnn.RNN, 1), (rnn.LSTM, 2), (rnn.GRU, 1)]:
+        layer = layer_cls(10, num_layers=2)
+        layer.initialize()
+        out = layer(x)
+        assert out.shape == (7, 3, 10)
+        states = layer.begin_state(3)
+        out2, new_states = layer(x, states)
+        assert out2.shape == (7, 3, 10)
+        assert len(new_states) == n_states
+        assert new_states[0].shape == (2, 3, 10)
+
+
+def test_bidirectional_layer():
+    x = mx.np.array(np.random.randn(6, 2, 4).astype('float32'))
+    layer = rnn.LSTM(5, bidirectional=True)
+    layer.initialize()
+    out = layer(x)
+    assert out.shape == (6, 2, 10)
+
+
+def test_ntc_layout():
+    x = mx.np.array(np.random.randn(2, 6, 4).astype('float32'))
+    layer = rnn.GRU(5, layout='NTC')
+    layer.initialize()
+    assert layer(x).shape == (2, 6, 5)
+
+
+def test_lstm_layer_grad_flows():
+    x = mx.np.array(np.random.randn(4, 2, 3).astype('float32'))
+    layer = rnn.LSTM(6)
+    layer.initialize()
+    with autograd.record():
+        out = layer(x).sum()
+    out.backward()
+    g = layer.l0_i2h_weight.grad()
+    assert abs(g.asnumpy()).sum() > 0
+
+
+def test_lstm_layer_matches_cell():
+    """Single-layer LSTM layer vs manual cell unroll with shared weights."""
+    np.random.seed(0)
+    T, B, I, H = 3, 2, 4, 5
+    x = mx.np.array(np.random.randn(T, B, I).astype('float32'))
+    layer = rnn.LSTM(H)
+    layer.initialize()
+    out = layer(x).asnumpy()
+
+    cell = rnn.LSTMCell(H)
+    cell.initialize()
+    cell.i2h_weight.shape = (4 * H, I)
+    cell.i2h_weight._finish_deferred_init()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outs, _ = cell.unroll(T, [x[t] for t in range(T)], layout='TNC')
+    manual = np.stack([o.asnumpy() for o in outs])
+    assert_almost_equal(out, manual, rtol=1e-4, atol=1e-5)

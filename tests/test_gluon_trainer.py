@@ -1,0 +1,112 @@
+"""Trainer (reference tests/python/unittest/test_gluon_trainer.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _make_net():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    return net
+
+
+def test_trainer_basic_step():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    w0 = net.weight.data().asnumpy().copy()
+    x = mx.np.ones((4, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+    assert not np.allclose(net.weight.data().asnumpy(), w0)
+
+
+def test_trainer_learning_rate():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    assert trainer.learning_rate == pytest.approx(0.1)
+    trainer.set_learning_rate(0.2)
+    assert trainer.learning_rate == pytest.approx(0.2)
+
+
+def test_linear_regression_convergence():
+    np.random.seed(3)
+    true_w = np.array([[2.0], [-3.4]], dtype='float32')
+    true_b = 4.2
+    X = np.random.randn(256, 2).astype('float32')
+    Y = (X @ true_w).ravel() + true_b
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    data, label = mx.np.array(X), mx.np.array(Y)
+    for _ in range(150):
+        with autograd.record():
+            l = loss_fn(net(data), label).mean()
+        l.backward()
+        trainer.step(1)
+    assert float(l.asnumpy()) < 1e-3
+    assert_almost_equal(net.weight.data().asnumpy().ravel(),
+                        true_w.ravel(), rtol=0.05, atol=0.02)
+    assert abs(float(net.bias.data().asnumpy()) - true_b) < 0.05
+
+
+def test_trainer_states_roundtrip(tmp_path):
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), 'adam')
+    x = mx.np.ones((2, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    f = str(tmp_path / 'trainer.states')
+    trainer.save_states(f)
+    trainer2 = gluon.Trainer(net.collect_params(), 'adam')
+    trainer2.load_states(f)
+    assert trainer2._optimizer.num_update == trainer._optimizer.num_update
+
+
+def test_trainer_with_kvstore_types():
+    for kv in ('local', 'device', 'dist_sync'):
+        net = _make_net()
+        trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                                {'learning_rate': 0.01}, kvstore=kv)
+        x = mx.np.ones((2, 2))
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(2)
+
+
+def test_trainer_update_on_kvstore():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1}, kvstore='local',
+                            update_on_kvstore=True)
+    x = mx.np.ones((2, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    w0 = net.weight.data().asnumpy().copy()
+    trainer.step(2)
+    assert not np.allclose(net.weight.data().asnumpy(), w0)
+
+
+def test_trainer_allreduce_and_update_split():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    x = mx.np.ones((2, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.allreduce_grads()
+    trainer.update(2)

@@ -1,0 +1,110 @@
+"""KVStore semantics (reference tests/python/unittest/test_kvstore.py +
+tests/nightly/dist_sync_kvstore.py assertions, run single-process)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_create_types():
+    for name in ('local', 'device', 'dist_sync', 'dist_tpu_sync', 'horovod',
+                 'byteps', 'nccl'):
+        kv = kvstore.create(name)
+        assert kv.rank == 0
+        assert kv.num_workers == 1
+    with pytest.raises(ValueError):
+        kvstore.create('bogus_type')
+
+
+def test_init_push_pull():
+    kv = kvstore.create('local')
+    kv.init(3, mx.np.ones((2, 3)))
+    out = mx.np.zeros((2, 3))
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.ones((2, 3)))
+
+
+def test_push_aggregation():
+    kv = kvstore.create('local')
+    kv.init('a', mx.np.zeros((2,)))
+    # push a list of device replicas -> summed (reference Comm::Reduce)
+    kv.push('a', [mx.np.ones((2,)), mx.np.ones((2,)) * 2])
+    out = mx.np.zeros((2,))
+    kv.pull('a', out=out)
+    assert_almost_equal(out, [3., 3.])
+
+
+def test_pushpull_allreduce():
+    kv = kvstore.create('dist_sync')
+    vals = [mx.np.ones((4,)), mx.np.ones((4,)) * 3]
+    kv.pushpull(0, vals)
+    for v in vals:
+        assert_almost_equal(v, np.full((4,), 4.0))
+
+
+def test_pushpull_with_out():
+    kv = kvstore.create('device')
+    v = mx.np.ones((2, 2))
+    out = mx.np.zeros((2, 2))
+    kv.pushpull('k', v, out=out)
+    assert_almost_equal(out, np.ones((2, 2)))
+
+
+def test_broadcast():
+    kv = kvstore.create('local')
+    outs = [mx.np.zeros((3,)), mx.np.zeros((3,))]
+    kv.broadcast('b', mx.np.array([1., 2., 3.]), outs)
+    for o in outs:
+        assert_almost_equal(o, [1., 2., 3.])
+
+
+def test_updater():
+    kv = kvstore.create('local')
+    kv.init(0, mx.np.ones((2,)))
+
+    def updater(key, grad, weight):
+        weight._rebind((weight - 0.1 * grad)._data)
+
+    kv.set_updater(updater)
+    kv.push(0, mx.np.ones((2,)))
+    out = mx.np.zeros((2,))
+    kv.pull(0, out=out)
+    assert_almost_equal(out, [0.9, 0.9])
+
+
+def test_set_optimizer():
+    kv = kvstore.create('local')
+    kv.init(0, mx.np.ones((2,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.pushpull(0, mx.np.ones((2,)))
+    out = mx.np.zeros((2,))
+    kv.pull(0, out=out)
+    assert_almost_equal(out, [0.5, 0.5])
+
+
+def test_row_sparse_pull_fallback():
+    kv = kvstore.create('local')
+    kv.init('w', mx.np.ones((4, 2)))
+    out = mx.np.zeros((4, 2))
+    kv.row_sparse_pull('w', out=out)
+    assert_almost_equal(out, np.ones((4, 2)))
+
+
+def test_optimizer_states_save_load(tmp_path):
+    kv = kvstore.create('local')
+    kv.init(0, mx.np.ones((2,)))
+    kv.set_optimizer(mx.optimizer.Adam())
+    kv.pushpull(0, mx.np.ones((2,)))
+    f = str(tmp_path / 'opt.states')
+    kv.save_optimizer_states(f)
+    kv.load_optimizer_states(f)
+
+
+def test_barrier_and_dead_nodes():
+    kv = kvstore.create('dist_sync')
+    kv.barrier()
+    assert kv.get_num_dead_node() == 0
+    assert kv.type == 'dist_tpu_sync'

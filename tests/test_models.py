@@ -1,0 +1,116 @@
+"""Model zoo + end-to-end training (reference tests/python/train/ +
+test_gluon_model_zoo.py). MNIST-style E2E uses synthetic data (zero-egress
+CI); the real-data path is exercised by example/mnist.py when data exists.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import get_model, vision
+
+
+def test_resnet18_thumbnail_forward():
+    net = vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize()
+    out = net(mx.np.array(np.random.randn(2, 3, 32, 32).astype('float32')))
+    assert out.shape == (2, 10)
+
+
+def test_resnet_v2_thumbnail_forward():
+    net = vision.resnet18_v2(classes=10, thumbnail=True)
+    net.initialize()
+    out = net(mx.np.array(np.random.randn(2, 3, 32, 32).astype('float32')))
+    assert out.shape == (2, 10)
+
+
+@pytest.mark.parametrize('name', ['mobilenet0.25', 'squeezenet1.1'])
+def test_small_zoo_imagenet_shapes(name):
+    net = get_model(name, classes=7)
+    net.initialize()
+    out = net(mx.np.array(np.random.randn(1, 3, 224, 224).astype('float32')))
+    assert out.shape == (1, 7)
+
+
+def test_get_model_registry():
+    with pytest.raises(ValueError):
+        get_model('not_a_model')
+    net = get_model('resnet18_v1', classes=4, thumbnail=True)
+    assert isinstance(net, vision.ResNetV1)
+
+
+def test_mnist_style_mlp_convergence():
+    """SURVEY §7 P1 gate: LeNet-style MLP, hybridized, trains to high
+    accuracy (synthetic separable data stands in for MNIST)."""
+    np.random.seed(0)
+    n, d, c = 512, 16, 4
+    centers = np.random.randn(c, d).astype('float32') * 3
+    labels = np.random.randint(0, c, n)
+    X = centers[labels] + np.random.randn(n, d).astype('float32') * 0.5
+    data, label = mx.np.array(X), mx.np.array(labels.astype('int32'))
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation='relu'),
+            nn.Dense(32, activation='relu'),
+            nn.Dense(c))
+    net.initialize(init='xavier')
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(60):
+        with autograd.record():
+            l = loss_fn(net(data), label).mean()
+        l.backward()
+        trainer.step(1)
+    pred = net(data).asnumpy().argmax(-1)
+    acc = (pred == labels).mean()
+    assert acc > 0.95, f'accuracy {acc}'
+
+
+def test_lenet_cnn_trains():
+    np.random.seed(0)
+    X = np.random.randn(32, 1, 12, 12).astype('float32')
+    y = (X.mean(axis=(1, 2, 3)) > 0).astype('int32')
+    data, label = mx.np.array(X), mx.np.array(y)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, activation='relu'), nn.MaxPool2D(2),
+            nn.Flatten(), nn.Dense(2))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.02})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    first = None
+    for i in range(30):
+        with autograd.record():
+            l = loss_fn(net(data), label).mean()
+        l.backward()
+        trainer.step(1)
+        if first is None:
+            first = float(l.asnumpy())
+    assert float(l.asnumpy()) < first
+
+
+def test_export_import(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    x = mx.np.ones((1, 3))
+    net(x)
+    net(x)  # build cache
+    prefix = str(tmp_path / 'model')
+    sym_file, param_file = net.export(prefix)
+    import os
+    assert os.path.exists(param_file)
+
+
+def test_deformable_conv_forward():
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    net = DeformableConvolution(4, kernel_size=(3, 3), padding=(1, 1))
+    net.initialize()
+    x = mx.np.array(np.random.randn(1, 3, 8, 8).astype('float32'))
+    out = net(x)
+    assert out.shape == (1, 4, 8, 8)

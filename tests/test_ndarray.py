@@ -1,0 +1,176 @@
+"""NDArray semantics (reference tests/python/unittest/test_ndarray.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, rand_ndarray
+
+
+def test_creation():
+    a = mx.np.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.int32
+    b = mx.np.array([[1.0, 2.0]])
+    assert b.dtype == np.float32
+    z = mx.np.zeros((3, 4))
+    assert z.shape == (3, 4) and z.asnumpy().sum() == 0
+    o = mx.np.ones((2, 2), dtype='float16')
+    assert o.dtype == np.float16
+    f = mx.np.full((2,), 7.0)
+    assert_almost_equal(f, np.full((2,), 7.0))
+    r = mx.np.arange(10)
+    assert_almost_equal(r, np.arange(10))
+    e = mx.np.eye(3)
+    assert_almost_equal(e, np.eye(3))
+    l = mx.np.linspace(0, 1, 5)
+    assert_almost_equal(l, np.linspace(0, 1, 5))
+
+
+def test_arithmetic():
+    a = mx.np.array([[1., 2.], [3., 4.]])
+    b = mx.np.array([[5., 6.], [7., 8.]])
+    assert_almost_equal(a + b, [[6, 8], [10, 12]])
+    assert_almost_equal(a - b, [[-4, -4], [-4, -4]])
+    assert_almost_equal(a * b, [[5, 12], [21, 32]])
+    assert_almost_equal(b / a, [[5, 3], [7 / 3, 2]])
+    assert_almost_equal(a ** 2, [[1, 4], [9, 16]])
+    assert_almost_equal(2 + a, [[3, 4], [5, 6]])
+    assert_almost_equal(2 - a, [[1, 0], [-1, -2]])
+    assert_almost_equal(10 / a, [[10, 5], [10 / 3, 2.5]])
+    assert_almost_equal(-a, [[-1, -2], [-3, -4]])
+    assert_almost_equal(abs(-a), [[1, 2], [3, 4]])
+    assert_almost_equal(a @ b, np.array([[1., 2.], [3., 4.]]) @
+                        np.array([[5., 6.], [7., 8.]]))
+
+
+def test_comparison():
+    a = mx.np.array([1., 2., 3.])
+    b = mx.np.array([3., 2., 1.])
+    assert (a == b).asnumpy().tolist() == [False, True, False]
+    assert (a < b).asnumpy().tolist() == [True, False, False]
+    assert (a >= 2).asnumpy().tolist() == [False, True, True]
+
+
+def test_inplace():
+    a = mx.np.ones((2, 2))
+    orig = a
+    a += 1
+    assert orig.asnumpy().sum() == 8  # same handle mutated
+    a *= 2
+    assert_almost_equal(a, np.full((2, 2), 4.0))
+    a /= 4
+    assert_almost_equal(a, np.ones((2, 2)))
+
+
+def test_indexing():
+    a = mx.np.arange(12).reshape(3, 4)
+    assert a[1, 2].item() == 6
+    assert_almost_equal(a[1], [4, 5, 6, 7])
+    assert_almost_equal(a[:, 1], [1, 5, 9])
+    assert_almost_equal(a[1:, 2:], [[6, 7], [10, 11]])
+    # boolean mask
+    m = a > 5
+    assert a[m].asnumpy().tolist() == [6, 7, 8, 9, 10, 11]
+    # integer array indexing
+    idx = mx.np.array([0, 2])
+    assert_almost_equal(a[idx], [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+
+def test_setitem():
+    a = mx.np.zeros((3, 3))
+    a[1, 1] = 5.0
+    assert a.asnumpy()[1, 1] == 5.0
+    a[0] = 2.0
+    assert_almost_equal(a[0], [2, 2, 2])
+    a[:] = 1.0
+    assert_almost_equal(a, np.ones((3, 3)))
+    a[:, 2] = mx.np.array([7., 8., 9.])
+    assert_almost_equal(a[:, 2], [7, 8, 9])
+
+
+def test_shape_ops():
+    a = mx.np.arange(24).reshape(2, 3, 4)
+    assert a.reshape(6, 4).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.T.shape == (4, 3, 2)
+    assert a.flatten().shape == (24,)
+    assert a.squeeze().shape == (2, 3, 4)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert mx.np.ones((1, 3)).broadcast_to((5, 3)).shape == (5, 3)
+
+
+def test_reductions():
+    x = np.random.uniform(-1, 1, (3, 4)).astype('float32')
+    a = mx.np.array(x)
+    assert_almost_equal(a.sum(), x.sum())
+    assert_almost_equal(a.sum(axis=0), x.sum(0))
+    assert_almost_equal(a.mean(axis=1, keepdims=True), x.mean(1, keepdims=True))
+    assert_almost_equal(a.max(), x.max())
+    assert_almost_equal(a.min(axis=0), x.min(0))
+    assert a.argmax().item() == x.argmax()
+    assert_almost_equal(a.std(), x.std(), rtol=1e-4)
+    assert_almost_equal(a.var(axis=0), x.var(0), rtol=1e-4)
+    assert_almost_equal(a.cumsum(axis=1), x.cumsum(1), rtol=1e-5)
+    assert_almost_equal(a.norm(), np.linalg.norm(x), rtol=1e-5)
+
+
+def test_astype_copy():
+    a = mx.np.array([1.5, 2.5])
+    b = a.astype('int32')
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[:] = 0
+    assert a.asnumpy().sum() != 0  # copy does not alias
+
+
+def test_copyto_context():
+    a = mx.np.array([1., 2.], ctx=mx.cpu())
+    b = a.as_in_context(mx.cpu())
+    assert b is a
+    c = mx.np.zeros((2,))
+    a.copyto(c)
+    assert_almost_equal(c, [1, 2])
+
+
+def test_sync_points():
+    a = mx.np.ones((4,))
+    a.wait_to_read()
+    mx.nd.waitall()
+    assert a.asnumpy().tolist() == [1, 1, 1, 1]
+    assert mx.np.array([3.14]).item() == pytest.approx(3.14)
+    assert mx.np.array(7).asscalar() == 7
+
+
+def test_iter_len_bool():
+    a = mx.np.arange(3)
+    assert len(a) == 3
+    assert [x.item() for x in a] == [0, 1, 2]
+    assert bool(mx.np.array([1]))
+    with pytest.raises(ValueError):
+        bool(a)
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / 'arrs.npz')
+    data = {'w': rand_ndarray((3, 2)), 'b': rand_ndarray((2,))}
+    mx.nd.save(f, data)
+    loaded = mx.nd.load(f)
+    assert set(loaded) == {'w', 'b'}
+    assert_almost_equal(loaded['w'], data['w'])
+    # list save/load
+    f2 = str(tmp_path / 'arrs2.npz')
+    mx.nd.save(f2, [data['w'], data['b']])
+    ll = mx.nd.load(f2)
+    assert isinstance(ll, list) and len(ll) == 2
+
+
+def test_dlpack_numpy_interop():
+    a = mx.np.array([[1., 2.]])
+    n = np.asarray(a)
+    assert n.shape == (1, 2)
+    import jax.numpy as jnp
+    assert jnp.asarray(a._data).shape == (1, 2)

@@ -1,0 +1,153 @@
+"""NumPy parity for mx.np ops (reference
+tests/python/unittest/test_numpy_op.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+UNARY = ['exp', 'log', 'sqrt', 'sin', 'cos', 'tan', 'tanh', 'arctan',
+         'sinh', 'cosh', 'abs', 'sign', 'floor', 'ceil', 'square',
+         'log1p', 'expm1', 'cbrt', 'rint', 'trunc', 'radians', 'degrees']
+
+
+@pytest.mark.parametrize('name', UNARY)
+def test_unary(name):
+    x = np.random.uniform(0.1, 2.0, (3, 4)).astype('float32')
+    got = getattr(mx.np, name)(mx.np.array(x))
+    want = getattr(np, name)(x)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+BINARY = ['add', 'subtract', 'multiply', 'true_divide', 'maximum', 'minimum',
+          'power', 'hypot', 'arctan2', 'logaddexp']
+
+
+@pytest.mark.parametrize('name', BINARY)
+def test_binary(name):
+    a = np.random.uniform(0.5, 2.0, (3, 4)).astype('float32')
+    b = np.random.uniform(0.5, 2.0, (4,)).astype('float32')  # broadcast
+    got = getattr(mx.np, name)(mx.np.array(a), mx.np.array(b))
+    want = getattr(np, name)(a, b)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_manipulation_parity():
+    x = np.random.randn(2, 3, 4).astype('float32')
+    a = mx.np.array(x)
+    assert_almost_equal(mx.np.concatenate([a, a], axis=1),
+                        np.concatenate([x, x], 1))
+    assert_almost_equal(mx.np.stack([a, a], axis=0), np.stack([x, x]))
+    outs = mx.np.split(a, 2, axis=2)
+    assert len(outs) == 2 and outs[0].shape == (2, 3, 2)
+    assert_almost_equal(mx.np.tile(a, (2, 1, 1)), np.tile(x, (2, 1, 1)))
+    assert_almost_equal(mx.np.repeat(a, 2, axis=0), np.repeat(x, 2, 0))
+    assert_almost_equal(mx.np.flip(a, axis=1), np.flip(x, 1))
+    assert_almost_equal(mx.np.roll(a, 1, axis=0), np.roll(x, 1, 0))
+    assert_almost_equal(mx.np.pad(a, ((0, 0), (1, 1), (0, 0))),
+                        np.pad(x, ((0, 0), (1, 1), (0, 0))))
+    assert_almost_equal(mx.np.where(a > 0, a, 0 * a), np.where(x > 0, x, 0))
+    assert_almost_equal(mx.np.tril(mx.np.ones((3, 3))), np.tril(np.ones((3, 3))))
+
+
+def test_linalg_parity():
+    a = np.random.randn(3, 4).astype('float32')
+    b = np.random.randn(4, 5).astype('float32')
+    assert_almost_equal(mx.np.dot(mx.np.array(a), mx.np.array(b)), a @ b,
+                        rtol=1e-4)
+    assert_almost_equal(mx.np.einsum('ij,jk->ik', mx.np.array(a),
+                                     mx.np.array(b)), a @ b, rtol=1e-4)
+    assert_almost_equal(
+        mx.np.tensordot(mx.np.array(a), mx.np.array(b), axes=1), a @ b,
+        rtol=1e-4)
+    sq = np.random.randn(4, 4).astype('float32')
+    sq = sq @ sq.T + 4 * np.eye(4, dtype='float32')
+    assert_almost_equal(mx.np.linalg.inv(mx.np.array(sq)),
+                        np.linalg.inv(sq), rtol=1e-2, atol=1e-3)
+    assert_almost_equal(mx.np.linalg.det(mx.np.array(sq)), np.linalg.det(sq),
+                        rtol=1e-3)
+    L = mx.np.linalg.cholesky(mx.np.array(sq))
+    assert_almost_equal(L._data @ L._data.T, sq, rtol=1e-3, atol=1e-3)
+    # batch_dot
+    x = np.random.randn(2, 3, 4).astype('float32')
+    y = np.random.randn(2, 4, 5).astype('float32')
+    assert_almost_equal(mx.nd.batch_dot(mx.np.array(x), mx.np.array(y)),
+                        x @ y, rtol=1e-4)
+
+
+def test_ordering_ops():
+    x = np.random.randn(4, 6).astype('float32')
+    a = mx.np.array(x)
+    assert_almost_equal(mx.np.sort(a, axis=1), np.sort(x, 1))
+    assert (mx.np.argsort(a, axis=1).asnumpy() == np.argsort(x, 1)).all()
+    vals, idx = mx.nd.topk(a, k=3, axis=1, ret_typ='both', dtype='int32')
+    want = np.sort(x, 1)[:, ::-1][:, :3]
+    assert_almost_equal(vals, want)
+
+
+def test_reduce_special():
+    x = np.random.rand(3, 5).astype('float32')
+    a = mx.np.array(x)
+    assert_almost_equal(mx.np.median(a), np.median(x), rtol=1e-5)
+    assert_almost_equal(mx.np.percentile(a, 30), np.percentile(x, 30),
+                        rtol=1e-3)
+    assert mx.np.count_nonzero(a).item() == np.count_nonzero(x)
+    h1, e1 = mx.np.histogram(a, bins=5, range=(0., 1.))
+    h2, e2 = np.histogram(x, bins=5, range=(0., 1.))
+    assert (h1.asnumpy() == h2).all()
+
+
+def test_take_gather():
+    x = np.random.randn(5, 4).astype('float32')
+    a = mx.np.array(x)
+    idx = mx.np.array([0, 2, 4])
+    assert_almost_equal(mx.np.take(a, idx, axis=0), x[[0, 2, 4]])
+    # gather_nd: pick elements (0,1) and (2,3)
+    indices = mx.np.array([[0, 2], [1, 3]])
+    got = mx.nd.gather_nd(a, indices)
+    assert_almost_equal(got, x[[0, 2], [1, 3]])
+    # one_hot
+    oh = mx.nd.one_hot(mx.np.array([0, 2]), 3)
+    assert_almost_equal(oh, np.eye(3, dtype='float32')[[0, 2]])
+    # pick
+    p = mx.nd.pick(a, mx.np.array([1, 0, 3, 2, 1]), axis=1)
+    assert_almost_equal(p, x[np.arange(5), [1, 0, 3, 2, 1]])
+
+
+def test_random_ops():
+    mx.random.seed(7)
+    u = mx.np.random.uniform(low=0, high=1, size=(1000,))
+    assert 0 <= float(u.min().asnumpy()) and float(u.max().asnumpy()) <= 1
+    assert abs(float(u.mean().asnumpy()) - 0.5) < 0.05
+    n = mx.np.random.normal(loc=2.0, scale=0.5, size=(2000,))
+    assert abs(float(n.mean().asnumpy()) - 2.0) < 0.1
+    r = mx.np.random.randint(0, 10, size=(100,))
+    assert r.dtype == np.int32
+    assert (r.asnumpy() >= 0).all() and (r.asnumpy() < 10).all()
+    # determinism with same seed
+    mx.random.seed(123)
+    a = mx.np.random.uniform(size=(5,)).asnumpy()
+    mx.random.seed(123)
+    b = mx.np.random.uniform(size=(5,)).asnumpy()
+    assert (a == b).all()
+    # multinomial
+    probs = mx.np.array([[0.0, 1.0, 0.0]])
+    s = mx.random.multinomial(probs, shape=4)
+    assert (s.asnumpy() == 1).all()
+
+
+def test_softmax_ops():
+    x = np.random.randn(2, 5).astype('float32')
+    got = mx.npx.softmax(mx.np.array(x), axis=-1)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    want = e / e.sum(-1, keepdims=True)
+    assert_almost_equal(got, want, rtol=1e-5)
+    lg = mx.nd.log_softmax(mx.np.array(x), axis=-1)
+    assert_almost_equal(lg, np.log(want), rtol=1e-4, atol=1e-5)
+    # masked softmax zeroes masked entries
+    mask = np.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]], dtype=bool)
+    ms = mx.nd.masked_softmax(mx.np.array(x), mx.np.array(mask))
+    assert (ms.asnumpy()[0, 2:] == 0).all()
+    assert_almost_equal(ms.asnumpy().sum(-1), np.ones(2), rtol=1e-5)

@@ -1,0 +1,114 @@
+"""Optimizers (reference tests/python/unittest/test_optimizer.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.test_utils import assert_almost_equal
+
+ALL_OPTS = ['sgd', 'nag', 'adam', 'adamw', 'adamax', 'nadam', 'adagrad',
+            'adadelta', 'rmsprop', 'ftrl', 'ftml', 'signum', 'lars', 'lamb',
+            'lans', 'sgld', 'dcasgd']
+
+
+@pytest.mark.parametrize('name', ALL_OPTS)
+def test_optimizer_decreases_quadratic(name):
+    """Each optimizer should reduce f(w) = ||w - target||^2."""
+    target = np.array([1.0, -2.0, 3.0], dtype='float32')
+    w = mx.np.array(np.zeros(3, dtype='float32'))
+    o = opt.create(name)
+    state = o.create_state(0, w)
+    f0 = float(((w.asnumpy() - target) ** 2).sum())
+    for _ in range(50):
+        grad = NDArray((w._data - target) * 2)
+        o.update(0, w, grad, state)
+    f1 = float(((w.asnumpy() - target) ** 2).sum())
+    assert f1 < f0, f'{name} failed to decrease loss ({f0} -> {f1})'
+
+
+def test_sgd_momentum_exact():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    w = mx.np.array([1.0])
+    state = o.create_state(0, w)
+    g = mx.np.array([1.0])
+    o.update(0, w, g, state)
+    # mom = -lr*g = -0.1; w = 1 - 0.1 = 0.9
+    assert_almost_equal(w, [0.9], rtol=1e-6)
+    o.update(0, w, g, state)
+    # mom = 0.9*(-0.1) - 0.1 = -0.19; w = 0.9 - 0.19 = 0.71
+    assert_almost_equal(w, [0.71], rtol=1e-6)
+
+
+def test_adam_bias_correction():
+    o = opt.Adam(learning_rate=0.001)
+    w = mx.np.array([0.0])
+    state = o.create_state(0, w)
+    o.update(0, w, mx.np.array([1.0]), state)
+    # first step of adam moves by ~lr regardless of grad scale
+    assert abs(float(w.asnumpy()) + 0.001) < 1e-5
+
+
+def test_clip_and_rescale():
+    o = opt.SGD(learning_rate=1.0, rescale_grad=0.5, clip_gradient=0.2)
+    w = mx.np.array([0.0])
+    o.update(0, w, mx.np.array([10.0]), None)
+    # g = clip(10*0.5, 0.2) = 0.2 -> w = -0.2
+    assert_almost_equal(w, [-0.2], rtol=1e-5)
+
+
+def test_wd():
+    o = opt.SGD(learning_rate=0.1, wd=0.1)
+    w = mx.np.array([1.0])
+    o.update(0, w, mx.np.array([0.0]), None)
+    assert_almost_equal(w, [1.0 - 0.1 * 0.1], rtol=1e-6)
+
+
+def test_lr_scheduler_integration():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    assert o.learning_rate == 1.0
+    w = mx.np.array([0.0])
+    for _ in range(5):
+        o.update(0, w, mx.np.array([0.0]), None)
+    assert o.learning_rate < 1.0
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=1.0)
+    o.set_lr_mult({0: 0.1})
+    assert o._get_lr(0) == pytest.approx(0.1)
+    assert o._get_lr(1) == pytest.approx(1.0)
+    o.set_wd_mult({1: 2.0})
+    o.wd = 0.01
+    assert o._get_wd(1) == pytest.approx(0.02)
+
+
+def test_updater_states_roundtrip():
+    o = opt.Adam()
+    updater = opt.get_updater(o)
+    w = mx.np.array([1.0, 2.0])
+    updater(0, mx.np.array([0.1, 0.1]), w)
+    blob = updater.get_states()
+    u2 = opt.get_updater(opt.Adam())
+    u2.set_states(blob)
+    assert 0 in u2.states
+
+
+def test_create_by_name_and_registry():
+    for name in ('sgd', 'adam', 'rmsprop'):
+        o = opt.create(name, learning_rate=0.3)
+        assert o.learning_rate == pytest.approx(0.3)
+    with pytest.raises(ValueError):
+        opt.create('nonexistent_optimizer')
+
+
+def test_multi_param_update():
+    o = opt.SGD(learning_rate=0.1)
+    ws = [mx.np.array([1.0]), mx.np.array([2.0])]
+    gs = [mx.np.array([1.0]), mx.np.array([1.0])]
+    states = [None, None]
+    o.update([0, 1], ws, gs, states)
+    assert_almost_equal(ws[0], [0.9], rtol=1e-6)
+    assert_almost_equal(ws[1], [1.9], rtol=1e-6)
