@@ -1,0 +1,74 @@
+"""Headline benchmark: ResNet-50 inference throughput (images/sec).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+Baseline anchor (BASELINE.md): ResNet-50 inference batch 32 on V100 —
+1,076.81 img/s fp32 / 2,085.51 img/s fp16 (reference
+docs/static_site/src/pages/api/faq/perf.md:194,208). We bench bf16 (the
+TPU-native precision) against the reduced-precision V100 number.
+
+Run: python bench.py [--dtype bf16|fp32] [--batch 32] [--model resnet50_v1]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+BASELINES = {'bf16': 2085.51, 'fp32': 1076.81}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='resnet50_v1')
+    parser.add_argument('--batch', type=int, default=32)
+    parser.add_argument('--dtype', default='bf16', choices=['bf16', 'fp32'])
+    parser.add_argument('--iters', type=int, default=50)
+    parser.add_argument('--warmup', type=int, default=5)
+    parser.add_argument('--cpu', action='store_true')
+    args = parser.parse_args()
+
+    if args.cpu:
+        import _cpu_guard
+        _cpu_guard.force_cpu()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    ctx = mx.current_context()
+    dtype = 'bfloat16' if args.dtype == 'bf16' else 'float32'
+    print(f'context: {ctx}, dtype: {dtype}', file=sys.stderr)
+
+    net = getattr(vision, args.model)()
+    net.initialize(ctx=ctx)
+    net(mx.np.ones((1, 3, 224, 224), ctx=ctx))  # materialize params
+    if dtype != 'float32':
+        net.cast(dtype)
+    net.hybridize(static_alloc=True)
+
+    x = mx.np.ones((args.batch, 3, 224, 224), dtype=dtype, ctx=ctx)
+    for _ in range(args.warmup):
+        y = net(x)
+    y.wait_to_read()
+
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(args.iters):
+        outs.append(net(x))
+    for o in outs:
+        o.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    ips = args.batch * args.iters / dt
+    baseline = BASELINES[args.dtype]
+    print(json.dumps({
+        'metric': f'resnet50_inference_{args.dtype}_batch{args.batch}',
+        'value': round(ips, 2),
+        'unit': 'img/s',
+        'vs_baseline': round(ips / baseline, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

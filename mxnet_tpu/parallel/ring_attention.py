@@ -80,12 +80,28 @@ def ring_attention_kernel(q, k, v, axis_name='sp', causal=False):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis_name='sp', causal=False):
+def ring_attention(q, k, v, mesh, axis_name='sp', causal=False, spec=None):
     """Sharded full attention: q/k/v (B, H, S, D) with S sharded over
-    ``axis_name``. Returns output with identical sharding."""
+    ``axis_name``. Returns output with identical sharding.
+
+    ``spec`` may name additional mesh axes on the batch/head dims (e.g.
+    ``P('dp', 'tp', 'sp', None)``) so sequence parallelism composes with
+    data and tensor parallelism in one mesh — those axes are plain local
+    blocks inside the kernel; only ``axis_name`` participates in the ring.
+    """
     from jax.experimental.shard_map import shard_map
 
-    spec = P(None, None, axis_name, None)
+    if spec is None:
+        spec = P(None, None, axis_name, None)
+    else:
+        # check_rep=False disables shard_map's own checks, so a malformed
+        # spec (e.g. axis_name on the head_dim) would be silent corruption.
+        full = tuple(spec) + (None,) * (4 - len(spec))
+        if full[2] != axis_name or full[3] is not None or \
+                axis_name in (full[0], full[1]):
+            raise ValueError(
+                f'spec must shard the sequence dim (dim 2) over '
+                f'{axis_name!r} and leave head_dim unsharded, got {spec}')
     fn = shard_map(
         functools.partial(ring_attention_kernel, axis_name=axis_name,
                           causal=causal),
