@@ -29,6 +29,10 @@ from . import autograd
 from . import random
 from .ndarray.ndarray import NDArray
 
+from . import symbol
+from . import symbol as sym
+from . import _deferred_compute
+
 from . import engine
 from . import initializer
 from . import lr_scheduler

@@ -1,0 +1,209 @@
+"""Deferred-compute graph capture.
+
+Reference: ``python/mxnet/_deferred_compute.py:25-70`` wrapping
+``Imperative::RecordDeferredCompute`` / ``GetDeferredComputeSymbol``
+(include/mxnet/imperative.h:244-250) — the mechanism by which Gluon-2
+``hybridize()`` and ``export()`` capture a Symbol from a plain imperative
+``forward``.
+
+TPU re-design: imperative ops already funnel through
+``ops.registry.invoke``; while capture is active every invoke records a
+serializable node — op name, positional/keyword argument template with
+array placeholders, static attrs — and tags the produced NDArrays with
+``(node, out_index)``. ``get_symbol`` then assembles the reachable subgraph
+into a :class:`mxnet_tpu.symbol.Symbol`. Values still flow (typically jax
+abstract tracers under ``jax.eval_shape``), so shape inference is implicit,
+exactly like the reference where deferred-compute nodes carry shape/dtype.
+"""
+
+import threading
+
+import numpy as _np
+
+_state = threading.local()
+
+
+class _Capture:
+    def __init__(self):
+        self.tagged = {}        # id(NDArray) -> (node, out_index)
+        self.keepalive = []     # NDArrays we tagged (ids must stay valid)
+        self.nodes = []
+        self.aux = {}           # name -> NDArray: hoisted big constants
+
+
+def _stack():
+    if not hasattr(_state, 'stack'):
+        _state.stack = []
+    return _state.stack
+
+
+def is_deferred_compute():
+    """True while capture is active (reference dc.is_deferred_compute)."""
+    return bool(_stack())
+
+
+class context:
+    """Context manager activating capture (reference _deferred_compute.py:44)."""
+
+    def __enter__(self):
+        _stack().append(_Capture())
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+def set_variable(arrays, names, attrs=None):
+    """Tag input NDArrays as symbol variables (reference dc.set_variable).
+
+    ``arrays``/``names`` may be single items or lists.
+    """
+    from .symbol.symbol import _SymNode
+
+    if not isinstance(arrays, (list, tuple)):
+        arrays, names = [arrays], [names]
+    cap = _stack()[-1]
+    for arr, name in zip(arrays, names):
+        node = _SymNode('null', name, None, {}, [])
+        node.attrs['__shape__'] = tuple(arr.shape)
+        node.attrs['__dtype__'] = str(arr.dtype)
+        cap.nodes.append(node)
+        cap.tagged[id(arr)] = (node, 0)
+        cap.keepalive.append(arr)
+
+
+def _is_abstract(raw):
+    import jax
+    return isinstance(raw, jax.core.Tracer)
+
+
+def _entry_for(cap, arr, op_name='<unknown>'):
+    """Entry for an input array; concrete untagged arrays become embedded
+    constants (the reference embeds them as aux params of the symbol)."""
+    ent = cap.tagged.get(id(arr))
+    if ent is not None:
+        return ent
+    if _is_abstract(arr._data):
+        raise RuntimeError(
+            f'deferred-compute input of op {op_name!r} is an untagged '
+            'tracer; arrays used inside a captured forward must be created '
+            'inside it or marked with dc.set_variable (reference raises the '
+            'same invariant in Imperative::RecordDeferredCompute)')
+    from .symbol.symbol import _SymNode
+    if arr.size > 256:
+        # big constant buffers go to the params file, not inline JSON
+        # (the reference stores these as aux params of the symbol)
+        name = f'_const_buf{len(cap.aux)}'
+        node = _SymNode('null', name, None, {}, [])
+        node.attrs.update({'__shape__': tuple(arr.shape),
+                           '__dtype__': str(arr.dtype), '__aux__': True})
+        cap.aux[name] = arr
+    else:
+        node = _SymNode('_constant', None, None,
+                        {'value': _np.asarray(arr.asnumpy()).tolist(),
+                         'dtype': str(arr.dtype)}, [])
+    cap.nodes.append(node)
+    ent = (node, 0)
+    cap.tagged[id(arr)] = ent
+    cap.keepalive.append(arr)
+    return ent
+
+
+def record(op, args, kw_static, kw_arr_keys, arrays, outputs, out_target):
+    """Called by ops.registry.invoke after dispatch while capture is active.
+
+    ``arrays`` is the flat NDArray-slot list (positional slots then keyword
+    slots, matching invoke's closure layout); ``args``/``kw_static`` are the
+    original call with NDArrays still in place.
+    """
+    from .ndarray.ndarray import NDArray
+    from .symbol.symbol import _SymNode
+
+    cap = _stack()[-1]
+    inputs = [_entry_for(cap, a, op.name) for a in arrays]
+
+    slot = iter(range(len(arrays)))
+
+    def spec_of(v):
+        if isinstance(v, NDArray):
+            return {'__arr__': next(slot)}
+        if isinstance(v, (list, tuple)) and any(
+                isinstance(e, NDArray) for e in v):
+            return [spec_of(e) for e in v]
+        return _encode_static(v)
+
+    args_spec = [spec_of(a) for a in args]
+    kwargs = {}
+    for k, v in kw_static.items():
+        if op.stochastic and k == 'key':
+            continue  # re-drawn from the context RNG at replay
+        kwargs[k] = _encode_static(v)
+    for k in kw_arr_keys:
+        kwargs[k] = {'__arr__': next(slot)}
+
+    node = _SymNode(op.name, None, args_spec, kwargs, inputs)
+    outs = outputs if isinstance(outputs, tuple) else (outputs,)
+    node.n_out = len(outs)
+    cap.nodes.append(node)
+    if out_target is not None:
+        outs = (out_target,)
+    for i, o in enumerate(outs):
+        cap.tagged[id(o)] = (node, i)
+        cap.keepalive.append(o)
+
+
+def record_opaque(op, fn, arrays, outputs):
+    """Record a closure-based op (direct apply_op dispatch, e.g. fused RNN).
+
+    The node replays through its captured closure so the symbol stays
+    executable, but it cannot serialize — Symbol.tojson() raises a clear
+    error naming the op instead.
+    """
+    from .symbol.symbol import _SymNode
+
+    cap = _stack()[-1]
+    inputs = [_entry_for(cap, a, op.name) for a in arrays]
+    node = _SymNode('_opaque', None, None, {}, inputs)
+    node.attrs['__opaque_name__'] = op.name
+    node.attrs['__opaque_fn__'] = fn
+    outs = outputs if isinstance(outputs, tuple) else (outputs,)
+    node.n_out = len(outs)
+    cap.nodes.append(node)
+    for i, o in enumerate(outs):
+        cap.tagged[id(o)] = (node, i)
+        cap.keepalive.append(o)
+
+
+def _encode_static(v):
+    """Keep static attrs JSON-serializable (tuples/slices/dtypes survive a
+    tojson round trip via symbol.symbol._attr_to_json)."""
+    if isinstance(v, _np.dtype):
+        return v
+    if isinstance(v, type) and issubclass(v, _np.generic):
+        return _np.dtype(v)
+    if isinstance(v, _np.generic):
+        return v.item()
+    if isinstance(v, _np.ndarray):
+        return v.tolist()
+    return v
+
+
+def get_symbol(outputs):
+    """Assemble the Symbol for the captured outputs
+    (reference dc.get_symbol → GetDeferredComputeSymbol)."""
+    from .ndarray.ndarray import NDArray
+    from .symbol.symbol import Symbol
+
+    cap = _stack()[-1]
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    entries = []
+    for o in outputs:
+        ent = cap.tagged.get(id(o))
+        if ent is None:
+            raise RuntimeError(
+                'output was not produced under deferred compute')
+        entries.append(ent)
+    sym = Symbol(entries)
+    sym._aux.update(cap.aux)
+    return sym

@@ -436,8 +436,11 @@ class HybridBlock(Block):
     def __call__(self, *args, **kwargs):
         for hook in self._forward_pre_hooks:
             hook(self, args)
+        if all(isinstance(a, NDArray) for a in args) and args:
+            self._last_in_specs = [(a.shape, a.dtype) for a in args]
+        from .. import _deferred_compute as _dc
         if self._active and self._cached_graph is not None and \
-                self._first_forward_done:
+                self._first_forward_done and not _dc.is_deferred_compute():
             if kwargs:
                 raise ValueError(
                     'keyword arguments are not supported when a HybridBlock '
@@ -460,65 +463,198 @@ class HybridBlock(Block):
         raise NotImplementedError(
             f'{type(self).__name__} must implement forward')
 
-    def export(self, path, epoch=0, remove_amp_cast=True):
-        """Reference block.py:1299 — serialize compiled graph + params.
+    def _trace_symbol(self, *args):
+        """Capture the (inference-mode) forward graph as a Symbol via
+        deferred compute (≙ _get_graph_v2, reference block.py:959).
 
-        Emits ``{path}-symbol.stablehlo`` (portable StableHLO bytes via
-        jax.export — the role of model-symbol.json) and
-        ``{path}-{epoch:04d}.params.npz``.
+        ``args``: example NDArrays (or shape tuples) for the data inputs.
+        Parameters become symbol variables named by their structural names,
+        so the params file keys match ``symbol.list_arguments()``.
+        """
+        import jax
+
+        from .. import _deferred_compute as dc
+
+        in_specs = []
+        for a in args:
+            if isinstance(a, NDArray):
+                in_specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+            else:
+                in_specs.append(jax.ShapeDtypeStruct(tuple(a), _np.float32))
+        in_names = ['data'] if len(args) == 1 else \
+            [f'data{i}' for i in range(len(args))]
+
+        params = self.collect_params()
+        p_items = list(params.items())
+        p_specs = [jax.ShapeDtypeStruct(p.shape, _np.dtype(p.dtype))
+                   for _, p in p_items]
+        n_in = len(in_specs)
+        captured = {}
+        st = _trace_state()
+
+        def run(*raws):
+            saved = []
+            prev_rec = _tape.set_recording(False)
+            prev_train = _tape.set_training(False)
+            prev_aux = st.aux_writes
+            st.aux_writes = {}
+            try:
+                with dc.context():
+                    nds = [NDArray(r) for r in raws[:n_in]]
+                    dc.set_variable(nds, in_names)
+                    for (name, p), r in zip(p_items, raws[n_in:]):
+                        nd = NDArray(r)
+                        saved.append((p, p._data))
+                        p._data = {c: nd for c in p._data}
+                        dc.set_variable(nd, name)
+                    out = self.forward(*nds)
+                    outs = out if isinstance(out, (list, tuple)) else [out]
+                    captured['sym'] = dc.get_symbol(list(outs))
+                return 0
+            finally:
+                for p, data in saved:
+                    p._data = data
+                _tape.set_recording(prev_rec)
+                _tape.set_training(prev_train)
+                st.aux_writes = prev_aux
+
+        jax.eval_shape(run, *(in_specs + p_specs))
+        return captured['sym']
+
+    def export(self, path, epoch=0, remove_amp_cast=True, input_shapes=None):
+        """Reference block.py:1299 — serialize graph + params for
+        deployment.
+
+        Emits ``{path}-symbol.json`` (the role of model-symbol.json; loads
+        back via :meth:`SymbolBlock.imports`) and
+        ``{path}-{epoch:04d}.params.npz``. Input shapes come from the first
+        compiled-cache entry, or pass ``input_shapes=[(...), ...]``.
         """
         from ..model import save_ndarray_map
         params = self.collect_params()
         save_ndarray_map(f'{path}-{epoch:04d}.params.npz',
                          {k: v.data() for k, v in params.items()})
-        if self._cached_graph and self._cached_graph._compiled:
+        if input_shapes is None:
+            specs = getattr(self, '_last_in_specs', None)
+            if not specs:
+                raise ValueError(
+                    'export() needs input shapes: run a forward first, or '
+                    'pass input_shapes=[...] (the reference has the same '
+                    'run-before-export requirement, block.py:1299)')
+            import jax
+            args = [NDArray(jax.ShapeDtypeStruct(s, d)) for s, d in specs]
+        else:
+            args = list(input_shapes)
+        param_path = f'{path}-{epoch:04d}.params.npz'
+        sym = self._trace_symbol(*args)
+        if not any(n.op == '_opaque' for n in sym._topo()):
+            if sym._aux:  # hoisted constant buffers ride the params file
+                data = dict({k: v.data() for k, v in params.items()},
+                            **sym._aux)
+                save_ndarray_map(param_path, data)
+            sym.save(f'{path}-symbol.json')
+            return f'{path}-symbol.json', param_path
+        # closure-dispatched layers (fused RNN etc.) can't serialize to
+        # JSON — export the compiled graph as portable StableHLO instead
+        return self._export_stablehlo(path, args), param_path
+
+    def _export_stablehlo(self, path, args):
+        """Portable serialized executable via jax.export (the deployment
+        fallback for graphs containing closure-based ops)."""
+        import jax
+        from jax import export as jexport
+
+        items = list(self.collect_params().items())
+        st = _trace_state()
+
+        def fn(in_raws, p_raws):
+            saved = []
+            prev_rec = _tape.set_recording(False)
+            prev_train = _tape.set_training(False)
+            prev_aux = st.aux_writes
+            st.aux_writes = {}
             try:
-                import jax
-                from jax import export as jexport
-                (key, jfn) = next(iter(self._cached_graph._compiled.items()))
-                # serialize with abstract args from the cache key
-                shapes, _ = key
-                main, aux = self._cached_graph._params()
-                args = (jax.ShapeDtypeStruct((2,), _np.uint32),
-                        tuple(jax.ShapeDtypeStruct(s, d) for s, d in shapes),
-                        tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
-                              for p in main),
-                        tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
-                              for p in aux))
-                exp = jexport.export(jax.jit(jfn))(*args)
-                with open(f'{path}-symbol.stablehlo', 'wb') as f:
-                    f.write(exp.serialize())
-            except Exception as e:  # serialization is best-effort
-                import logging
-                logging.warning('StableHLO export skipped: %s', e)
-        return f'{path}-symbol.stablehlo', f'{path}-{epoch:04d}.params.npz'
+                for (_, p), r in zip(items, p_raws):
+                    saved.append((p, p._data))
+                    p._data = {c: NDArray(r) for c in p._data}
+                out = self.forward(*[NDArray(r) for r in in_raws])
+                leaves, _ = jax.tree.flatten(
+                    out, is_leaf=lambda x: isinstance(x, NDArray))
+                return tuple(o._data if isinstance(o, NDArray) else o
+                             for o in leaves)
+            finally:
+                for p, d in saved:
+                    p._data = d
+                _tape.set_recording(prev_rec)
+                _tape.set_training(prev_train)
+                st.aux_writes = prev_aux
+
+        in_specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                         for a in args)
+        p_specs = tuple(jax.ShapeDtypeStruct(p.shape, _np.dtype(p.dtype))
+                        for _, p in items)
+        exp = jexport.export(jax.jit(fn))(in_specs, p_specs)
+        out_path = f'{path}-symbol.stablehlo'
+        with open(out_path, 'wb') as f:
+            f.write(exp.serialize())
+        return out_path
 
 
 class SymbolBlock(HybridBlock):
-    """Run an exported graph as a Block (reference block.py:1485).
+    """Run a Symbol graph as a Block (reference block.py:1485).
 
-    Wraps a deserialized StableHLO executable; parameters load from the
-    params file.
+    Every non-input variable of the symbol becomes a :class:`Parameter`
+    (loaded from the params file or initialized), and ``forward`` replays
+    the graph through the op registry — so autograd and re-hybridization
+    both work on imported models.
     """
 
-    def __init__(self, outputs=None, inputs=None, params=None):
+    def __init__(self, outputs, inputs, params=None):
         super().__init__()
-        self._exported = outputs
+        from ..symbol.symbol import Group, Symbol
+        if not isinstance(outputs, Symbol):
+            outputs = Group(list(outputs))
+        self._sym = outputs
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._input_names = [i if isinstance(i, str) else i.name
+                             for i in inputs]
+        shape_attrs = {n.name: (n.attrs.get('__shape__'),
+                                n.attrs.get('__dtype__', 'float32'))
+                       for n in self._sym._topo() if n.op == 'null'}
+        self._sym_param_names = [n for n in self._sym.list_arguments()
+                                 if n not in self._input_names]
+        params = dict(params or {})
+        for name in self._sym_param_names:
+            shape, dtype = shape_attrs.get(name, (None, 'float32'))
+            p = Parameter(name, shape=shape, dtype=dtype,
+                          allow_deferred_init=True)
+            if name in params:
+                v = params[name]
+                if not isinstance(v, NDArray):
+                    v = array(v)
+                p.dtype = str(v.dtype)
+                p.set_data(v)
+            self._reg_params[name] = p
 
     @staticmethod
-    def imports(symbol_file, input_names=None, param_file=None, ctx=None):
-        from jax import export as jexport
-        with open(symbol_file, 'rb') as f:
-            exp = jexport.deserialize(f.read())
-        block = SymbolBlock(outputs=exp)
-        if param_file:
-            from ..model import load_ndarray_map
-            block._loaded_params = load_ndarray_map(param_file, ctx=ctx)
-        return block
+    def imports(symbol_file, input_names='data', param_file=None, ctx=None):
+        """Load an exported model (reference block.py SymbolBlock.imports)."""
+        from ..model import load_ndarray_map
+        from ..symbol import load as sym_load
+        sym = sym_load(symbol_file)
+        params = load_ndarray_map(param_file) if param_file else {}
+        if ctx is not None:
+            params = {k: v.as_in_context(ctx) for k, v in params.items()}
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        return SymbolBlock(sym, list(input_names), params=params)
 
     def forward(self, *args):
-        raise NotImplementedError(
-            'call the deserialized executable via .call_exported')
-
-    def call_exported(self, *flat_args):
-        return self._exported.call(*flat_args)
+        bindings = {}
+        for name, a in zip(self._input_names, args):
+            bindings[name] = a if isinstance(a, NDArray) else array(a)
+        for name in self._sym_param_names:
+            bindings[name] = self._reg_params[name].data()
+        outs = self._sym._execute(bindings)
+        return outs[0] if len(outs) == 1 else tuple(outs)

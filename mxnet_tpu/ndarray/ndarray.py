@@ -35,6 +35,21 @@ def _is_tracer(x):
     return isinstance(x, jax.core.Tracer)
 
 
+def _is_static_key(key):
+    """True for basic-indexing keys (ints/slices/None/Ellipsis/int lists)
+    that can be baked into a registered op call and serialized."""
+    if isinstance(key, tuple):
+        return all(_is_static_key(k) for k in key)
+    if key is None or key is Ellipsis or isinstance(key, _INT_TYPES):
+        return True
+    if isinstance(key, slice):
+        return all(b is None or isinstance(b, _INT_TYPES)
+                   for b in (key.start, key.stop, key.step))
+    if isinstance(key, list):
+        return all(isinstance(k, _INT_TYPES) for k in key)
+    return False
+
+
 class NDArray:
     """N-dimensional array on a Context, dispatching to XLA.
 
@@ -204,12 +219,26 @@ class NDArray:
         return conv(key)
 
     def __getitem__(self, key):
-        from ..ops.registry import get_op, apply_op
+        from ..ops.registry import get_op, apply_op, invoke
+        if _is_static_key(key):
+            # registered-op path: records under deferred compute / export
+            return invoke(get_op('_npi_getitem'), (self,), {'key': key})
         rkey = self._raw_key(key)
         op = get_op('_slice_like_internal')
         return apply_op(op, [self], lambda x: x[rkey], name='getitem')
 
     def __setitem__(self, key, value):
+        from ..ops.registry import get_op, invoke
+        if _is_static_key(key):
+            invoke(get_op('_npi_setitem'), (self, value),
+                   {'key': key, 'out': self})
+            return
+        from .. import _deferred_compute as _dc
+        if _dc.is_deferred_compute():
+            raise NotImplementedError(
+                'in-place assignment with array/boolean indices cannot be '
+                'recorded for export; use static indices or np.where '
+                'instead (reference deferred compute has the same limit)')
         rkey = self._raw_key(key)
         raw_v = value._data if isinstance(value, NDArray) else jnp.asarray(
             value, dtype=self._data.dtype)

@@ -10,7 +10,13 @@ import jax
 import jax.numpy as jnp
 
 from ..context import Context, current_context
-from .registry import register
+from .registry import get_op, register
+
+# replayable creation ops for symbol execution (named _creation_<jnp name>)
+for _nm in ('zeros', 'ones', 'full', 'arange', 'linspace', 'logspace',
+            'eye', 'tri', 'indices'):
+    register(f'_creation_{_nm}', namespaces=(),
+             differentiable=False)(getattr(jnp, _nm))
 
 
 def _dev(ctx, device=None):
@@ -21,13 +27,24 @@ def _dev(ctx, device=None):
 
 
 def _creator(fn):
-    """Wrap a jnp creation fn into an NDArray-returning frontend."""
+    """Wrap a jnp creation fn into an NDArray-returning frontend.
+
+    Under deferred-compute capture the call records a ``_creation_*`` node
+    (replayable by name, serializable — creation args are always static) so
+    graphs that build fresh arrays inside ``forward`` (e.g. RNN
+    ``begin_state``) export correctly.
+    """
     def wrapper(*args, ctx=None, device=None, **kwargs):
         from ..ndarray.ndarray import NDArray
+        from .. import _deferred_compute as dc
         dev, ctx = _dev(ctx, device)
         with jax.default_device(dev):
             raw = fn(*args, **kwargs)
-        return NDArray(raw, ctx=ctx)
+        out = NDArray(raw, ctx=ctx)
+        if dc.is_deferred_compute():
+            dc.record(get_op(f'_creation_{fn.__name__}'), args, kwargs,
+                      [], [], out, None)
+        return out
     wrapper.__name__ = fn.__name__
     return wrapper
 

@@ -69,7 +69,8 @@ def linalg_norm(x, ord=None, axis=None, keepdims=False):
     return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
 
 
-@register('linalg_svd')
+@register('linalg_svd', n_out=lambda args, kw: 3 if (
+          kw.get('compute_uv', args[2] if len(args) > 2 else True)) else 1)
 def linalg_svd(a, full_matrices=True, compute_uv=True):
     return jnp.linalg.svd(a, full_matrices=full_matrices,
                           compute_uv=compute_uv)
@@ -90,7 +91,7 @@ def linalg_det(a):
     return jnp.linalg.det(a)
 
 
-@register('linalg_slogdet')
+@register('linalg_slogdet', n_out=2)
 def linalg_slogdet(a):
     return jnp.linalg.slogdet(a)
 
@@ -102,12 +103,15 @@ def linalg_cholesky(a, lower=True):
     return L if lower else jnp.swapaxes(L, -1, -2)
 
 
-@register('linalg_qr', aliases=('linalg_gelqf',))
+@register('linalg_qr', aliases=('linalg_gelqf',),
+          n_out=lambda args, kw: 1 if (
+              kw.get('mode', args[1] if len(args) > 1 else 'reduced')
+              == 'r') else 2)
 def linalg_qr(a, mode='reduced'):
     return jnp.linalg.qr(a, mode=mode)
 
 
-@register('linalg_eigh', aliases=('linalg_syevd',))
+@register('linalg_eigh', aliases=('linalg_syevd',), n_out=2)
 def linalg_eigh(a, UPLO='L'):
     return jnp.linalg.eigh(a, UPLO=UPLO)
 
@@ -117,7 +121,7 @@ def linalg_eigvalsh(a, UPLO='L'):
     return jnp.linalg.eigvalsh(a, UPLO=UPLO)
 
 
-@register('linalg_eig', differentiable=False)
+@register('linalg_eig', differentiable=False, n_out=2)
 def linalg_eig(a):
     return jnp.linalg.eig(a)
 
@@ -132,7 +136,7 @@ def linalg_solve(a, b):
     return jnp.linalg.solve(a, b)
 
 
-@register('linalg_lstsq', differentiable=False)
+@register('linalg_lstsq', differentiable=False, n_out=4)
 def linalg_lstsq(a, b, rcond=None):
     return jnp.linalg.lstsq(a, b, rcond=rcond)
 

@@ -109,12 +109,18 @@ def column_stack(*arrays):
     return jnp.column_stack(arrays)
 
 
-@register('split')
+def _split_n_out(args, kwargs):
+    """Symbolic output arity for split-family ops (≙ FNumOutputs)."""
+    ios = args[1] if len(args) > 1 else kwargs.get('indices_or_sections')
+    return ios if isinstance(ios, int) else len(ios) + 1
+
+
+@register('split', n_out=_split_n_out)
 def split(x, indices_or_sections, axis=0):
     return tuple(jnp.split(x, indices_or_sections, axis=axis))
 
 
-@register('array_split')
+@register('array_split', n_out=_split_n_out)
 def array_split(x, indices_or_sections, axis=0):
     return tuple(jnp.array_split(x, indices_or_sections, axis=axis))
 
@@ -236,6 +242,24 @@ def _slice_like_internal(x):
     return x
 
 
+@register('_npi_getitem', namespaces=())
+def _npi_getitem(x, key=None):
+    """Static basic indexing (ints/slices/None/Ellipsis) as a registered op
+    so it records under deferred compute (reference: indexing routes through
+    _npi_slice / matrix_op in src/operator/tensor/indexing_op.cc)."""
+    return x[key]
+
+
+@register('_npi_setitem', namespaces=())
+def _npi_setitem(x, v=0, key=None):
+    """Functional in-place write ``x[key] = v`` (reference NDArray assign;
+    here ``.at[key].set`` keeps it pure so capture/jit see the new value)."""
+    v = jnp.asarray(v, dtype=x.dtype)
+    if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+        return jnp.broadcast_to(v, x.shape)
+    return x.at[key].set(v)
+
+
 @register('where_nd', aliases=())
 def where_nd(cond, x, y):
     return jnp.where(cond, x, y)
@@ -317,12 +341,12 @@ def reverse(x, axis):
     return jnp.flip(x, axis=axis)
 
 
-@register('meshgrid')
+@register('meshgrid', n_out=lambda args, kw: len(args))
 def meshgrid(*xs, indexing='xy'):
     return tuple(jnp.meshgrid(*xs, indexing=indexing))
 
 
-@register('broadcast_arrays')
+@register('broadcast_arrays', n_out=lambda args, kw: len(args))
 def broadcast_arrays(*xs):
     return tuple(jnp.broadcast_arrays(*xs))
 

@@ -433,7 +433,7 @@ def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
     return data / (knorm + alpha / nsize * ssum) ** beta
 
 
-@register('moments')
+@register('moments', n_out=2)
 def moments(data, axes=None, keepdims=False):
     """Reference: src/operator/nn/moments.cc."""
     mean = jnp.mean(data, axis=axes, keepdims=keepdims)

@@ -97,7 +97,10 @@ def argsort(x, axis=-1, is_ascend=True, dtype=None):
     return idx if dtype is None else idx.astype(dtype)
 
 
-@register('topk', differentiable=False)
+@register('topk', differentiable=False,
+          n_out=lambda args, kw: 2 if (
+              kw.get('ret_typ', args[3] if len(args) > 3 else 'indices')
+              == 'both') else 1)
 def topk(x, axis=-1, k=1, ret_typ='indices', is_ascend=False, dtype='float32'):
     """Reference: src/operator/tensor/ordering_op.cc topk.
 
@@ -120,7 +123,9 @@ def topk(x, axis=-1, k=1, ret_typ='indices', is_ascend=False, dtype='float32'):
     raise ValueError(f'unknown ret_typ {ret_typ}')
 
 
-@register('unique', differentiable=False)
+@register('unique', differentiable=False,
+          n_out=lambda args, kw: 1 + sum(bool(kw.get(f)) for f in
+          ('return_index', 'return_inverse', 'return_counts')))
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
            axis=None, size=None):
     return jnp.unique(x, return_index=return_index,
@@ -128,7 +133,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
                       return_counts=return_counts, axis=axis, size=size)
 
 
-@register('histogram', differentiable=False)
+@register('histogram', differentiable=False, n_out=2)
 def histogram(x, bins=10, range=None):
     return jnp.histogram(x, bins=bins, range=range)
 

@@ -20,6 +20,7 @@ import functools
 
 import jax
 
+from .. import _deferred_compute as _dc
 from .. import _rng, _tape
 
 _OPS = {}
@@ -36,10 +37,10 @@ class Op:
     """
 
     __slots__ = ('name', 'fn', 'differentiable', 'stochastic', 'namespaces',
-                 'aliases', 'wrap')
+                 'aliases', 'wrap', 'n_out')
 
     def __init__(self, name, fn, differentiable=True, stochastic=False,
-                 namespaces=('np', 'nd'), aliases=(), wrap=None):
+                 namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
@@ -47,10 +48,13 @@ class Op:
         self.namespaces = namespaces
         self.aliases = aliases
         self.wrap = wrap
+        # output arity for symbolic construction (≙ FNumOutputs in the
+        # reference op registry): int, or callable(args, kwargs) -> int
+        self.n_out = n_out
 
 
 def register(name=None, differentiable=True, stochastic=False,
-             namespaces=('np', 'nd'), aliases=(), wrap=None):
+             namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1):
     """Decorator registering a raw-array function as an operator.
 
     The decorated ``fn`` takes jax arrays (plus static kwargs) and returns a
@@ -63,7 +67,7 @@ def register(name=None, differentiable=True, stochastic=False,
         opname = name or fn.__name__
         op = Op(opname, fn, differentiable=differentiable,
                 stochastic=stochastic, namespaces=namespaces,
-                aliases=aliases, wrap=wrap)
+                aliases=aliases, wrap=wrap, n_out=n_out)
         _OPS[opname] = op
         for a in aliases:
             _OPS[a] = op
@@ -80,13 +84,17 @@ def list_ops():
     return dict(_OPS)
 
 
-def apply_op(op, arrays, fn, n_out=None, name=None):
+def apply_op(op, arrays, fn, n_out=None, name=None, _from_invoke=False):
     """Imperative dispatch of a pure function over NDArray inputs.
 
     ``arrays``: NDArray inputs participating in autograd. ``fn``: closure over
     their raw arrays (constants already baked in). Returns raw output(s);
     the caller wraps them. If autograd is recording and any input is tracked,
     a TapeNode is attached to the outputs (reference: Imperative::RecordOp).
+
+    Under deferred-compute capture, direct apply_op calls (closure-based
+    dispatchers like fused RNN) record an *opaque* node: the captured graph
+    stays executable, but tojson() refuses it with a clear error.
     """
     from ..ndarray.ndarray import NDArray, _wrap_out
 
@@ -108,6 +116,9 @@ def apply_op(op, arrays, fn, n_out=None, name=None):
             out_avals=[jax.typeof(o) for o in out_list], multi=multi)
         for i, w in enumerate(wrapped):
             w._ag = _tape.AGInfo(node=node, index=i)
+    if not _from_invoke and _dc.is_deferred_compute():
+        _dc.record_opaque(op, fn, arrays,
+                          tuple(wrapped) if multi else wrapped[0])
     return tuple(wrapped) if multi else wrapped[0]
 
 
@@ -159,12 +170,25 @@ def invoke(op_name, args, kwargs):
             kw[k] = r
         return fn_raw(*a, **kw)
 
-    res = apply_op(op, arrays, fn, name=op.name)
+    if out is not None:
+        # out= writes drop autograd linkage on rebind anyway (reference
+        # kWriteTo into an existing array) — skip the tape/vjp work
+        prev_rec = _tape.set_recording(False)
+        try:
+            res = apply_op(op, arrays, fn, name=op.name, _from_invoke=True)
+        finally:
+            _tape.set_recording(prev_rec)
+    else:
+        res = apply_op(op, arrays, fn, name=op.name, _from_invoke=True)
     if out is not None:
         if isinstance(res, tuple):
             raise ValueError('out= not supported for multi-output op')
         out._rebind(res._data)
+        if _dc.is_deferred_compute():
+            _dc.record(op, args, kw_static, kw_keys, arrays, res, out)
         return out
+    if _dc.is_deferred_compute():
+        _dc.record(op, args, kw_static, kw_keys, arrays, res, None)
     return res
 
 

@@ -97,8 +97,13 @@ def ring_attention(q, k, v, mesh, axis_name='sp', causal=False, spec=None):
         # check_rep=False disables shard_map's own checks, so a malformed
         # spec (e.g. axis_name on the head_dim) would be silent corruption.
         full = tuple(spec) + (None,) * (4 - len(spec))
-        if full[2] != axis_name or full[3] is not None or \
-                axis_name in (full[0], full[1]):
+
+        def _axes(entry):  # PartitionSpec entries may be axis tuples
+            return entry if isinstance(entry, tuple) else (entry,)
+
+        seq_axes = _axes(full[2])
+        if seq_axes != (axis_name,) or full[3] is not None or \
+                axis_name in _axes(full[0]) + _axes(full[1]):
             raise ValueError(
                 f'spec must shard the sequence dim (dim 2) over '
                 f'{axis_name!r} and leave head_dim unsharded, got {spec}')

@@ -1,0 +1,296 @@
+"""Symbol frontend + export/import tests.
+
+Models the reference's test_symbol.py / test_deferred_compute.py coverage
+(SURVEY §4): compose, infer_shape, tojson round trip, executor bind, and
+the export → SymbolBlock.imports deployment path.
+"""
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import SymbolBlock, nn
+
+
+def test_variable_and_compose():
+    x = mx.sym.var('x')
+    y = mx.sym.var('y')
+    z = x + y * 2.0
+    assert set(z.list_arguments()) == {'x', 'y'}
+    out = z.eval(x=mx.np.ones((2, 2)), y=mx.np.ones((2, 2)))
+    onp.testing.assert_allclose(out[0].asnumpy(), 3 * onp.ones((2, 2)))
+
+
+def test_symbol_op_namespace():
+    x = mx.sym.var('x')
+    y = mx.sym.np.tanh(x) + mx.sym.np.exp(x)
+    (res,) = y.eval(x=mx.np.zeros((3,)))
+    onp.testing.assert_allclose(res.asnumpy(), onp.ones(3))
+
+
+def test_infer_shape():
+    x = mx.sym.var('x')
+    w = mx.sym.var('w')
+    y = mx.sym.np.matmul(x, w)
+    arg_shapes, out_shapes, _ = y.infer_shape(x=(4, 8), w=(8, 3))
+    assert out_shapes == [(4, 3)]
+    assert arg_shapes == [(4, 8), (8, 3)]
+
+
+def test_infer_type():
+    x = mx.sym.var('x', shape=(2, 2))
+    y = mx.sym.np.sum(x)
+    _, out_types, _ = y.infer_type(x='float32')
+    assert out_types[0] == onp.dtype('float32')
+
+
+def test_tojson_roundtrip():
+    x = mx.sym.var('x')
+    y = (x * x).reshape((4,))
+    js = y.tojson()
+    y2 = mx.sym.fromjson(js)
+    a = mx.np.arange(4).reshape((2, 2)).astype('float32')
+    r1 = y.eval(x=a)[0].asnumpy()
+    r2 = y2.eval(x=a)[0].asnumpy()
+    onp.testing.assert_allclose(r1, r2)
+
+
+def test_group_and_getitem():
+    x = mx.sym.var('x')
+    g = mx.sym.Group([x + 1.0, x * 3.0])
+    assert g.num_outputs == 2
+    outs = g.eval(x=mx.np.ones((2,)))
+    onp.testing.assert_allclose(outs[0].asnumpy(), [2, 2])
+    onp.testing.assert_allclose(outs[1].asnumpy(), [3, 3])
+    second = g[1]
+    onp.testing.assert_allclose(
+        second.eval(x=mx.np.ones((2,)))[0].asnumpy(), [3, 3])
+
+
+def test_executor_forward_backward():
+    x = mx.sym.var('x')
+    y = (x * x).sum()
+    exe = y.bind(args={'x': mx.np.array([1.0, 2.0, 3.0])})
+    exe.forward(is_train=True)
+    exe.backward()
+    onp.testing.assert_allclose(exe.grad_dict['x'].asnumpy(), [2, 4, 6])
+
+
+def test_compose_substitution():
+    x = mx.sym.var('x')
+    y = x * 2.0
+    z = mx.sym.var('z')
+    y2 = y.compose(x=z + 1.0)
+    (res,) = y2.eval(z=mx.np.ones((2,)))
+    onp.testing.assert_allclose(res.asnumpy(), [4, 4])
+
+
+def test_trace_symbol_from_block():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, activation='relu'), nn.Dense(2))
+    net.initialize()
+    x = mx.np.ones((3, 4))
+    ref = net(x)
+    sym = net._trace_symbol(x)
+    args = set(sym.list_arguments())
+    assert 'data' in args
+    assert any('weight' in a for a in args)
+    bindings = {'data': x}
+    for name, p in net.collect_params().items():
+        bindings[name] = p.data()
+    out = sym.eval(**bindings)[0]
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5)
+
+
+def test_export_imports_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation='tanh'), nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    x = mx.np.ones((2, 6))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / 'model')
+    sym_file, param_file = net.export(prefix)
+    loaded = SymbolBlock.imports(sym_file, 'data', param_file)
+    out = loaded(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_export_conv_bn_graph(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(), nn.Activation('relu'))
+    net.initialize()
+    x = mx.np.ones((1, 2, 8, 8))
+    net(x)  # materialize params; BN stats in inference mode at export
+    prefix = str(tmp_path / 'conv')
+    sym_file, param_file = net.export(prefix, input_shapes=[x])
+    loaded = SymbolBlock.imports(sym_file, 'data', param_file)
+    ref = net(x).asnumpy()
+    out = loaded(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_imported_block_supports_autograd(tmp_path):
+    net = nn.Dense(2)
+    net.initialize()
+    x = mx.np.ones((3, 4))
+    net(x)
+    net.hybridize()
+    net(x)
+    prefix = str(tmp_path / 'g')
+    sym_file, param_file = net.export(prefix)
+    loaded = SymbolBlock.imports(sym_file, 'data', param_file)
+    xg = mx.np.ones((3, 4))
+    xg.attach_grad()
+    with autograd.record():
+        y = loaded(xg).sum()
+    y.backward()
+    assert xg.grad is not None
+    assert xg.grad.shape == (3, 4)
+
+
+def test_stochastic_op_not_baked(tmp_path):
+    """Dropout keys must be re-drawn at replay, not serialized."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dropout(0.5))
+    net.initialize()
+    x = mx.np.ones((2, 3))
+    net(x)
+    sym = net._trace_symbol(x)
+    js = sym.tojson()
+    assert 'key' not in js or '__arr__' in js  # no raw PRNG key attr
+
+
+def test_setitem_recorded_in_export(tmp_path):
+    """Code-review regression: in-place writes must appear in the graph."""
+
+    class SetBlock(nn.HybridBlock):
+        def forward(self, x):
+            y = x * 2.0
+            y[0] = 99.0
+            return y + 0.0
+
+    net = SetBlock()
+    x = mx.np.ones((2, 2))
+    ref = net(x).asnumpy()
+    assert ref[0, 0] == 99.0
+    sym = net._trace_symbol(x)
+    out = sym.eval(data=x)[0].asnumpy()
+    onp.testing.assert_allclose(out, ref)
+
+
+def test_getitem_recorded_in_export():
+    """Code-review regression: static slicing must capture."""
+
+    class SliceBlock(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = nn.Dense(4)
+
+        def forward(self, x):
+            return self.d(x)[:, :2]
+
+    net = SliceBlock()
+    net.initialize()
+    x = mx.np.ones((3, 5))
+    ref = net(x).asnumpy()
+    sym = net._trace_symbol(x)
+    js = sym.tojson()  # serializable
+    sym2 = mx.sym.fromjson(js)
+    bindings = {'data': x}
+    for name, p in net.collect_params().items():
+        bindings[name] = p.data()
+    out = sym2.eval(**bindings)[0].asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_opaque_op_capture_and_refusal():
+    """Closure-based ops capture as executable opaque nodes but refuse
+    tojson with a clear error (code-review finding)."""
+    from mxnet_tpu.gluon import rnn
+
+    net = rnn.LSTM(4, num_layers=1)
+    net.initialize()
+    x = mx.np.ones((5, 2, 3))
+    net(x)
+    sym = net._trace_symbol(x)
+    with pytest.raises(ValueError, match='cannot be serialized'):
+        sym.tojson()
+
+
+def test_symbol_multi_output_split():
+    x = mx.sym.var('x')
+    parts = mx.sym.np.split(x, 2)
+    assert parts.num_outputs == 2
+    outs = parts.eval(x=mx.np.arange(4.0).reshape(4, 1))
+    assert len(outs) == 2
+    onp.testing.assert_allclose(outs[1].asnumpy(), [[2.0], [3.0]])
+
+
+def test_compose_no_duplicate_shared_nodes():
+    from mxnet_tpu.ops import registry as reg
+    x = mx.sym.var('x')
+    shared = mx.sym.np.matmul(x, x)
+    g = mx.sym.Group([shared + 1.0, shared * 1.0])
+    z = mx.sym.var('z')
+    g2 = g.compose(x=z)
+    matmuls = [n for n in g2._topo() if n.op == 'matmul']
+    assert len(matmuls) == 1
+
+
+def test_big_constant_hoisted_to_params(tmp_path):
+    """Code-review regression: large non-Parameter buffers must not be
+    inlined as JSON — they ride the params file as aux variables."""
+
+    class PosBlock(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.table = mx.np.random.uniform(size=(1, 32, 64))
+
+        def forward(self, x):
+            return x + self.table
+
+    net = PosBlock()
+    x = mx.np.ones((2, 32, 64))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / 'pos')
+    sym_file, param_file = net.export(prefix, input_shapes=[x])
+    import os
+    assert os.path.getsize(sym_file) < 10_000  # not tens of MB of JSON
+    loaded = SymbolBlock.imports(sym_file, 'data', param_file)
+    onp.testing.assert_allclose(loaded(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_export_stablehlo_fallback_for_rnn(tmp_path):
+    """Models with closure-dispatched ops export as StableHLO instead of
+    failing (code-review regression)."""
+    from mxnet_tpu.gluon import rnn
+
+    net = rnn.LSTM(4, num_layers=1)
+    net.initialize()
+    x = mx.np.ones((5, 2, 3))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / 'lstm')
+    graph_file, param_file = net.export(prefix, input_shapes=[x])
+    assert graph_file.endswith('.stablehlo')
+    from jax import export as jexport
+    with open(graph_file, 'rb') as f:
+        exp = jexport.deserialize(f.read())
+    praws = tuple(p.data()._data for _, p in net.collect_params().items())
+    out = exp.call((x._data,), praws)
+    onp.testing.assert_allclose(onp.asarray(out[0]), ref, rtol=1e-5)
+
+
+def test_symbol_qr_positional_mode():
+    a = mx.sym.var('a')
+    r_only = mx.sym.np.linalg_qr(a, 'r')
+    assert r_only.num_outputs == 1
+    qr = mx.sym.np.linalg_qr(a)
+    assert qr.num_outputs == 2
+
+
+def test_topk_positional_ret_typ():
+    x = mx.sym.var('x')
+    both = mx.sym.np.topk(x, -1, 2, 'both')
+    assert both.num_outputs == 2
