@@ -13,6 +13,10 @@ import os
 
 def force_cpu(n_devices=None):
     import jax
+    # pallas registers TPU lowerings at import; it must load while the
+    # 'tpu' platform is still known, or later imports crash
+    import jax.experimental.pallas  # noqa: F401
+    import jax.experimental.pallas.tpu  # noqa: F401
     from jax._src import xla_bridge as _xb
     if n_devices is not None and 'host_platform_device_count' not in \
             os.environ.get('XLA_FLAGS', ''):

@@ -1,14 +1,20 @@
-"""Headline benchmark: ResNet-50 inference throughput (images/sec).
+"""Headline benchmark. Default: ResNet-50 inference throughput (images/sec).
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "...", "vs_baseline": N}
 
-Baseline anchor (BASELINE.md): ResNet-50 inference batch 32 on V100 —
-1,076.81 img/s fp32 / 2,085.51 img/s fp16 (reference
-docs/static_site/src/pages/api/faq/perf.md:194,208). We bench bf16 (the
-TPU-native precision) against the reduced-precision V100 number.
+Baseline anchors (BASELINE.md):
+  * ResNet-50 inference batch 32 on V100 — 1,076.81 img/s fp32 /
+    2,085.51 img/s fp16 (reference docs/.../faq/perf.md:194,208). We bench
+    bf16 (the TPU-native precision) against the reduced-precision number.
+  * BERT-base: no number exists in the reference repo (GluonNLP was a
+    separate project — BASELINE.md last row). vs_baseline anchors to the
+    commonly cited V100 fp16 fine-tune throughput ≈100 samples/s @ seq 128.
 
-Run: python bench.py [--dtype bf16|fp32] [--batch 32] [--model resnet50_v1]
+Run:
+  python bench.py                       # resnet50 inference, bf16, batch 32
+  python bench.py --model bert_base     # BERT-base train step, samples/sec
+  python bench.py --dtype fp32 --batch 64 --cpu
 """
 
 import argparse
@@ -17,23 +23,10 @@ import sys
 import time
 
 BASELINES = {'bf16': 2085.51, 'fp32': 1076.81}
+BERT_BASELINE = 100.0  # V100 fp16 fine-tune anchor; none in-repo
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument('--model', default='resnet50_v1')
-    parser.add_argument('--batch', type=int, default=32)
-    parser.add_argument('--dtype', default='bf16', choices=['bf16', 'fp32'])
-    parser.add_argument('--iters', type=int, default=50)
-    parser.add_argument('--warmup', type=int, default=5)
-    parser.add_argument('--cpu', action='store_true')
-    args = parser.parse_args()
-
-    if args.cpu:
-        import _cpu_guard
-        _cpu_guard.force_cpu()
-
-    import mxnet_tpu as mx
+def bench_resnet(args, mx):
     from mxnet_tpu.gluon.model_zoo import vision
 
     ctx = mx.current_context()
@@ -62,12 +55,94 @@ def main():
 
     ips = args.batch * args.iters / dt
     baseline = BASELINES[args.dtype]
-    print(json.dumps({
+    return {
         'metric': f'resnet50_inference_{args.dtype}_batch{args.batch}',
         'value': round(ips, 2),
         'unit': 'img/s',
         'vs_baseline': round(ips / baseline, 3),
-    }))
+    }
+
+
+def bench_bert(args, mx):
+    """BERT-base MLM training step (fwd+bwd+SGD), samples/sec @ seq len."""
+    import numpy as onp
+
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    ctx = mx.current_context()
+    dtype = 'bfloat16' if args.dtype == 'bf16' else 'float32'
+    seq_len = args.seq_len
+    print(f'context: {ctx}, dtype: {dtype}, seq {seq_len}', file=sys.stderr)
+
+    net = bert.bert_12_768_12(max_length=seq_len, dropout=0.0,
+                              use_classifier=False)
+    net.initialize(ctx=ctx)
+    rng = onp.random.default_rng(0)
+    ids = mx.np.array(rng.integers(0, 30000, (args.batch, seq_len)),
+                      dtype='int32', ctx=ctx)
+    tt = mx.np.zeros((args.batch, seq_len), dtype='int32', ctx=ctx)
+    labels = mx.np.array(rng.integers(0, 30000, (args.batch, seq_len)),
+                         dtype='int32', ctx=ctx)
+    net(ids, tt)  # materialize params
+    if dtype != 'float32':
+        net.cast(dtype)
+    net.hybridize(static_alloc=True)
+
+    params = net.collect_params()
+    trainer = gluon.Trainer(params, 'sgd', {'learning_rate': 1e-5})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def step():
+        with autograd.record():
+            _, _, mlm = net(ids, tt)
+            loss = loss_fn(mlm, labels).mean()
+        loss.backward()
+        trainer.step(args.batch)
+        return loss
+
+    for _ in range(args.warmup):
+        loss = step()
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        loss = step()
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    sps = args.batch * args.iters / dt
+    return {
+        'metric': f'bert_base_train_{args.dtype}_seq{seq_len}'
+                  f'_batch{args.batch}',
+        'value': round(sps, 2),
+        'unit': 'samples/s',
+        'vs_baseline': round(sps / BERT_BASELINE, 3),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='resnet50_v1')
+    parser.add_argument('--batch', type=int, default=32)
+    parser.add_argument('--seq-len', type=int, default=128)
+    parser.add_argument('--dtype', default='bf16', choices=['bf16', 'fp32'])
+    parser.add_argument('--iters', type=int, default=50)
+    parser.add_argument('--warmup', type=int, default=5)
+    parser.add_argument('--cpu', action='store_true')
+    args = parser.parse_args()
+
+    if args.cpu:
+        import _cpu_guard
+        _cpu_guard.force_cpu()
+
+    import mxnet_tpu as mx
+
+    if args.model in ('bert_base', 'bert', 'bert_12_768_12'):
+        result = bench_bert(args, mx)
+    else:
+        result = bench_resnet(args, mx)
+    print(json.dumps(result))
 
 
 if __name__ == '__main__':

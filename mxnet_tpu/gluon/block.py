@@ -440,7 +440,11 @@ class HybridBlock(Block):
             self._last_in_specs = [(a.shape, a.dtype) for a in args]
         from .. import _deferred_compute as _dc
         if self._active and self._cached_graph is not None and \
-                self._first_forward_done and not _dc.is_deferred_compute():
+                self._first_forward_done and not _dc.is_deferred_compute() \
+                and not is_tracing():
+            # is_tracing(): inside a parent's graph capture children inline
+            # into the parent executable (reference: CachedOp inline_limit /
+            # whole-graph capture) instead of nesting compiled calls
             if kwargs:
                 raise ValueError(
                     'keyword arguments are not supported when a HybridBlock '
@@ -532,8 +536,6 @@ class HybridBlock(Block):
         """
         from ..model import save_ndarray_map
         params = self.collect_params()
-        save_ndarray_map(f'{path}-{epoch:04d}.params.npz',
-                         {k: v.data() for k, v in params.items()})
         if input_shapes is None:
             specs = getattr(self, '_last_in_specs', None)
             if not specs:
@@ -548,12 +550,14 @@ class HybridBlock(Block):
         param_path = f'{path}-{epoch:04d}.params.npz'
         sym = self._trace_symbol(*args)
         if not any(n.op == '_opaque' for n in sym._topo()):
-            if sym._aux:  # hoisted constant buffers ride the params file
-                data = dict({k: v.data() for k, v in params.items()},
-                            **sym._aux)
-                save_ndarray_map(param_path, data)
+            # hoisted constant buffers ride the params file beside weights
+            data = dict({k: v.data() for k, v in params.items()},
+                        **sym._aux)
+            save_ndarray_map(param_path, data)
             sym.save(f'{path}-symbol.json')
             return f'{path}-symbol.json', param_path
+        save_ndarray_map(param_path,
+                         {k: v.data() for k, v in params.items()})
         # closure-dispatched layers (fused RNN etc.) can't serialize to
         # JSON — export the compiled graph as portable StableHLO instead
         return self._export_stablehlo(path, args), param_path
@@ -624,7 +628,9 @@ class SymbolBlock(HybridBlock):
                        for n in self._sym._topo() if n.op == 'null'}
         self._sym_param_names = [n for n in self._sym.list_arguments()
                                  if n not in self._input_names]
-        params = dict(params or {})
+        # hoisted constant buffers captured on an in-memory symbol load as
+        # (non-trainable) parameters alongside any explicitly passed params
+        params = dict(outputs._aux, **(params or {}))
         for name in self._sym_param_names:
             shape, dtype = shape_attrs.get(name, (None, 'float32'))
             p = Parameter(name, shape=shape, dtype=dtype,

@@ -1,4 +1,9 @@
-"""``gluon.model_zoo`` (reference python/mxnet/gluon/model_zoo/)."""
+"""``gluon.model_zoo`` (reference python/mxnet/gluon/model_zoo/).
+
+``vision`` mirrors the reference zoo; ``bert`` adds the transformer family
+(the reference kept BERT in the separate GluonNLP repo — SURVEY §6)."""
 
 from . import vision
+from . import bert
 from .vision import get_model
+from .bert import BERTModel, bert_12_768_12, bert_24_1024_16, get_bert_model

@@ -1,0 +1,205 @@
+"""BERT model family.
+
+The reference repo ships no BERT (GluonNLP was a separate project;
+SURVEY §6 notes BERT-base samples/sec must be established fresh as a
+north-star metric). This implementation is TPU-first:
+
+* attention runs through ``npx.multi_head_attention`` → the Pallas flash
+  path (ops/pallas/flash_attention.py) when unmasked/causal, or the
+  XLA-fused masked path for padded batches;
+* GELU/LayerNorm/bias adds are left to XLA fusion (the role of the
+  reference's NVRTC pointwise fusion, src/operator/fusion/);
+* everything is a HybridBlock, so one ``hybridize()`` compiles the whole
+  encoder into a single XLA executable with donated buffers.
+
+API shape follows gluon model_zoo conventions: ``bert_12_768_12`` /
+``bert_24_1024_16`` constructors plus a ``get_bert_model`` factory.
+"""
+
+import math
+
+from ...context import current_context
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .. import nn
+from ... import initializer
+
+
+class BERTLayerNorm(nn.LayerNorm):
+    """LayerNorm with BERT's default epsilon."""
+
+    def __init__(self, in_channels=0, epsilon=1e-12, **kwargs):
+        super().__init__(epsilon=epsilon, in_channels=in_channels, **kwargs)
+
+
+class BERTSelfAttention(HybridBlock):
+    """Multi-head self-attention; QKV in one fused projection (one MXU
+    matmul instead of three — the TPU equivalent of the reference's
+    interleaved QKV layout, transformer.cc:650)."""
+
+    def __init__(self, units, num_heads, dropout=0.0):
+        super().__init__()
+        self._units = units
+        self._num_heads = num_heads
+        self.qkv = nn.Dense(3 * units, flatten=False)
+        self.proj = nn.Dense(units, flatten=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        from ... import npx
+        qkv = self.qkv(x)
+        q, k, v = npx.split(qkv, 3, axis=-1)
+        out = npx.multi_head_attention(q, k, v, self._num_heads, mask=mask)
+        out = self.proj(out)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class BERTEncoderCell(HybridBlock):
+    """Post-LN transformer encoder cell (attention → add&norm → FFN →
+    add&norm), the original BERT arrangement."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0):
+        super().__init__()
+        self.attention = BERTSelfAttention(units, num_heads, dropout)
+        self.ln1 = BERTLayerNorm(in_channels=units)
+        self.ffn1 = nn.Dense(hidden_size, flatten=False)
+        self.act = nn.GELU()
+        self.ffn2 = nn.Dense(units, flatten=False)
+        self.ln2 = BERTLayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        att = self.attention(x, mask)
+        x = self.ln1(x + att)
+        h = self.ffn2(self.act(self.ffn1(x)))
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.ln2(x + h)
+
+
+class BERTEncoder(HybridBlock):
+    """Stack of encoder cells with learned position embeddings."""
+
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 max_length=512, dropout=0.0):
+        super().__init__()
+        self._max_length = max_length
+        self._units = units
+        self.position_weight = Parameter(
+            'position_weight', shape=(max_length, units),
+            init=initializer.Normal(0.02))
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.cells = []
+        for i in range(num_layers):
+            cell = BERTEncoderCell(units, hidden_size, num_heads, dropout)
+            self.register_child(cell, f'cell{i}')
+            self.cells.append(cell)
+
+    def forward(self, x, mask=None):
+        from ... import np as mnp
+        seq_len = x.shape[1]
+        pos = self.position_weight.data()[:seq_len]
+        x = x + mnp.expand_dims(pos, 0)
+        if self.dropout is not None:
+            x = self.dropout(x)
+        for cell in self.cells:
+            x = cell(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """BERT with MLM + NSP heads (reference-free TPU design; API follows
+    gluon model_zoo conventions).
+
+    Inputs: ``token_ids (B, T)``, ``token_types (B, T)``, optional
+    ``valid_length (B,)``. Outputs: sequence encoding (B, T, U); with
+    ``use_decoder`` also MLM logits (B, T, vocab); with ``use_classifier``
+    also NSP logits (B, 2).
+    """
+
+    def __init__(self, vocab_size=30522, token_type_vocab_size=2,
+                 units=768, hidden_size=3072, num_layers=12, num_heads=12,
+                 max_length=512, dropout=0.1, use_pooler=True,
+                 use_decoder=True, use_classifier=True, **kwargs):
+        super().__init__()
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.token_type_embed = nn.Embedding(token_type_vocab_size, units)
+        self.embed_ln = BERTLayerNorm(in_channels=units)
+        self.encoder = BERTEncoder(num_layers, units, hidden_size,
+                                   num_heads, max_length, dropout)
+        self.use_pooler = use_pooler
+        self.use_decoder = use_decoder
+        self.use_classifier = use_classifier
+        if use_pooler:
+            self.pooler = nn.Dense(units, activation='tanh', flatten=False)
+        if use_decoder:
+            # MLM head ties the output projection to the word embedding
+            self.decoder_transform = nn.Dense(units, flatten=False)
+            self.decoder_act = nn.GELU()
+            self.decoder_ln = BERTLayerNorm(in_channels=units)
+            self.decoder_bias = Parameter(
+                'decoder_bias', shape=(vocab_size,),
+                init=initializer.Zero())
+        if use_classifier:
+            self.classifier = nn.Dense(2, flatten=False)
+
+    def _attention_mask(self, token_ids, valid_length):
+        from ... import np as mnp
+        if valid_length is None:
+            return None
+        t = token_ids.shape[1]
+        pos = mnp.arange(t).reshape(1, t)
+        valid = pos < mnp.expand_dims(valid_length, -1)   # (B, T)
+        # (B, 1, Tq, Tk) boolean mask for dot_product_attention
+        return mnp.expand_dims(mnp.expand_dims(valid, 1), 1)
+
+    def forward(self, token_ids, token_types=None, valid_length=None):
+        from ... import np as mnp
+        x = self.word_embed(token_ids)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        x = self.embed_ln(x)
+        mask = self._attention_mask(token_ids, valid_length)
+        seq = self.encoder(x, mask)
+        outputs = [seq]
+        if self.use_pooler:
+            pooled = self.pooler(seq[:, 0, :])
+            outputs.append(pooled)
+        if self.use_decoder:
+            h = self.decoder_ln(self.decoder_act(self.decoder_transform(seq)))
+            # tied projection: logits = h · E^T + b
+            emb = self.word_embed.weight.data()
+            logits = mnp.matmul(h, emb.T) + self.decoder_bias.data()
+            outputs.append(logits)
+        if self.use_classifier and self.use_pooler:
+            outputs.append(self.classifier(pooled))
+        return tuple(outputs) if len(outputs) > 1 else outputs[0]
+
+
+_BERT_CONFIGS = {
+    'bert_12_768_12': dict(units=768, hidden_size=3072, num_layers=12,
+                           num_heads=12),
+    'bert_24_1024_16': dict(units=1024, hidden_size=4096, num_layers=24,
+                            num_heads=16),
+}
+
+
+def get_bert_model(model_name='bert_12_768_12', vocab_size=30522,
+                   max_length=512, dropout=0.1, **kwargs):
+    cfg = dict(_BERT_CONFIGS[model_name])
+    cfg.update(kwargs)
+    return BERTModel(vocab_size=vocab_size, max_length=max_length,
+                     dropout=dropout, **cfg)
+
+
+def bert_12_768_12(**kwargs):
+    """BERT-base (110M params)."""
+    return get_bert_model('bert_12_768_12', **kwargs)
+
+
+def bert_24_1024_16(**kwargs):
+    """BERT-large (340M params)."""
+    return get_bert_model('bert_24_1024_16', **kwargs)

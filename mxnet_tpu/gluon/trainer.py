@@ -23,11 +23,13 @@ class Trainer:
         if not isinstance(params, (list, tuple)):
             raise ValueError('params must be a dict/list of Parameters')
         self._params = []
+        # keyed by id(param): structural names are re-derived by
+        # collect_params() calls and can change under the trainer
         self._param2idx = {}
         for i, param in enumerate(params):
             if not isinstance(param, Parameter):
                 raise ValueError(f'invalid parameter {param}')
-            self._param2idx[param.name] = i
+            self._param2idx[id(param)] = i
             self._params.append(param)
         self._compression_params = compression_params
         self._contexts = self._check_contexts()
@@ -104,7 +106,7 @@ class Trainer:
             if param._deferred_init is not None and param._data is None:
                 params_to_init.append(param)
             elif self._kvstore is not None and param._data is not None:
-                idx = self._param2idx[param.name]
+                idx = self._param2idx[id(param)]
                 vals = param.list_data()
                 self._kvstore.broadcast(idx, vals[0], vals)
         self._params_to_init = params_to_init

@@ -71,20 +71,44 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
     return out.reshape(sq, b, heads * hd)
 
 
+@register('flash_attention')
+def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
+                    block_k=128):
+    """Blockwise fused attention (Pallas on TPU, XLA fallback elsewhere).
+
+    q: (..., T, d); k/v: (..., S, d). New TPU-native capability — the
+    reference's closest assets are the interleaved matmul kernels above
+    (transformer.cc:650-826), which materialize the full score matrix.
+    """
+    from .pallas.flash_attention import flash_attention as _fa
+    return _fa(q, k, v, sm_scale=sm_scale, causal=causal,
+               block_q=block_q, block_k=block_k)
+
+
 @register('multi_head_attention')
 def multi_head_attention(q, k, v, num_heads, mask=None, dropout_p=0.0,
                          causal=False, key=None):
     """Fused scaled-dot-product attention (batch, seq, embed) — the TPU-first
-    replacement for the interleaved-matmul pipeline. Uses
-    jax.nn.dot_product_attention which XLA fuses; see
-    ops/pallas_kernels.py:flash_attention for the long-sequence path."""
+    replacement for the interleaved-matmul pipeline. Unmasked/causal cases
+    take the Pallas flash path (ops/pallas/flash_attention.py); explicit
+    masks use jax.nn.dot_product_attention, which XLA fuses."""
     b, sq, e = q.shape
     hd = e // num_heads
     qh = q.reshape(b, sq, num_heads, hd)
     kh = k.reshape(b, k.shape[1], num_heads, hd)
     vh = v.reshape(b, v.shape[1], num_heads, hd)
-    out = jax.nn.dot_product_attention(
-        qh, kh, vh, mask=mask, is_causal=causal)
+    if mask is None and dropout_p == 0.0:
+        from .pallas.flash_attention import flash_attention as _fa
+        out = _fa(qh.transpose(0, 2, 1, 3), kh.transpose(0, 2, 1, 3),
+                  vh.transpose(0, 2, 1, 3), causal=causal)
+        return out.transpose(0, 2, 1, 3).reshape(b, sq, e)
+    if causal:
+        # explicit bottom-right-aligned causal mask so this branch agrees
+        # with the flash path when T != S (decode with KV cache)
+        sk = k.shape[1]
+        tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)[None, None]
+        mask = tri if mask is None else jnp.logical_and(mask, tri)
+    out = jax.nn.dot_product_attention(qh, kh, vh, mask=mask)
     return out.reshape(b, sq, e)
 
 

@@ -1,0 +1,15 @@
+"""Pallas TPU kernels — the hand-written hot ops.
+
+The reference hand-writes CUDA for its performance-critical fused ops
+(src/operator/contrib/transformer.cc interleaved attention matmuls,
+src/operator/fusion NVRTC codegen). On TPU, XLA fusion covers the long
+tail; this package holds the kernels worth writing by hand (SURVEY §7:
+"Pallas for fused attention, top-k, sparse, RNG-heavy ops").
+
+Kernels fall back to pure-XLA implementations off-TPU (and under
+``interpret=True`` in CPU CI), so the op surface is identical everywhere.
+"""
+
+from .flash_attention import flash_attention
+
+__all__ = ['flash_attention']

@@ -123,9 +123,16 @@ def topk(x, axis=-1, k=1, ret_typ='indices', is_ascend=False, dtype='float32'):
     raise ValueError(f'unknown ret_typ {ret_typ}')
 
 
-@register('unique', differentiable=False,
-          n_out=lambda args, kw: 1 + sum(bool(kw.get(f)) for f in
-          ('return_index', 'return_inverse', 'return_counts')))
+def _unique_n_out(args, kwargs):
+    flags = ('return_index', 'return_inverse', 'return_counts')
+    n = 1
+    for i, f in enumerate(flags):
+        v = kwargs.get(f, args[1 + i] if len(args) > 1 + i else False)
+        n += bool(v)
+    return n
+
+
+@register('unique', differentiable=False, n_out=_unique_n_out)
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
            axis=None, size=None):
     return jnp.unique(x, return_index=return_index,

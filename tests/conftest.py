@@ -11,19 +11,13 @@ initializes. This must run before any test imports mxnet_tpu/jax ops.
 """
 
 import os
+import sys
 
-flags = os.environ.get('XLA_FLAGS', '')
-if 'host_platform_device_count' not in flags:
-    os.environ['XLA_FLAGS'] = (
-        flags + ' --xla_force_host_platform_device_count=8').strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if os.environ.get('MXNET_TEST_DEVICE', 'cpu') == 'cpu':
-    import jax
-    from jax._src import xla_bridge as _xb
-    _xb._backend_factories.pop('axon', None)
-    _xb._backend_factories.pop('tpu', None)
-    os.environ['JAX_PLATFORMS'] = ''
-    jax.config.update('jax_platforms', 'cpu')
+    import _cpu_guard
+    _cpu_guard.force_cpu(8)
 
 import numpy as _np
 import pytest

@@ -1,0 +1,198 @@
+"""Transformer stack tests: Pallas flash attention + BERT model family.
+
+Coverage model (SURVEY §4): numeric checks vs a plain XLA reference for the
+kernel (the role of test_operator.py's numeric checks), end-to-end
+train-step assertions for the model (the role of tests/python/train/).
+"""
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo import bert
+from mxnet_tpu.ops.pallas.flash_attention import (_reference_attention,
+                                                  flash_attention)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('t,s', [(64, 64), (32, 96)])
+def test_flash_kernel_matches_reference(causal, t, s):
+    rng = onp.random.default_rng(0)
+    import jax.numpy as jnp
+    q = jnp.asarray(rng.standard_normal((2, 2, t, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 2, s, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 2, s, 32)), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, interpret=True,
+                          block_q=32, block_k=32)
+    ref = _reference_attention(
+        q.reshape(-1, t, 32), k.reshape(-1, s, 32), v.reshape(-1, s, 32),
+        32 ** -0.5, causal).reshape(q.shape)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_op_and_grad():
+    rng = onp.random.default_rng(1)
+    q = mx.np.array(rng.standard_normal((2, 2, 32, 16)), dtype='float32')
+    q.attach_grad()
+    with autograd.record():
+        out = mx.npx.flash_attention(q, q, q, causal=True)
+        loss = (out ** 2).sum()
+    loss.backward()
+    assert q.grad is not None
+    g = q.grad.asnumpy()
+    assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
+
+
+def test_multi_head_attention_flash_path_matches_masked_path():
+    rng = onp.random.default_rng(2)
+    b, t, e, h = 2, 16, 32, 4
+    q = mx.np.array(rng.standard_normal((b, t, e)), dtype='float32')
+    k = mx.np.array(rng.standard_normal((b, t, e)), dtype='float32')
+    v = mx.np.array(rng.standard_normal((b, t, e)), dtype='float32')
+    out_flash = mx.npx.multi_head_attention(q, k, v, h)
+    full = mx.np.ones((b, 1, t, t), dtype='bool')
+    out_masked = mx.npx.multi_head_attention(q, k, v, h, mask=full)
+    onp.testing.assert_allclose(out_flash.asnumpy(), out_masked.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def _tiny_bert(**kw):
+    cfg = dict(vocab_size=200, num_layers=2, units=32, hidden_size=64,
+               num_heads=4, max_length=32, dropout=0.0)
+    cfg.update(kw)
+    return bert.get_bert_model('bert_12_768_12', **cfg)
+
+
+def test_bert_output_shapes():
+    net = _tiny_bert()
+    net.initialize()
+    ids = mx.np.zeros((2, 12), dtype='int32')
+    tt = mx.np.zeros((2, 12), dtype='int32')
+    seq, pooled, mlm, nsp = net(ids, tt)
+    assert seq.shape == (2, 12, 32)
+    assert pooled.shape == (2, 32)
+    assert mlm.shape == (2, 12, 200)
+    assert nsp.shape == (2, 2)
+
+
+def test_bert_valid_length_masks_padding():
+    net = _tiny_bert(use_decoder=False, use_classifier=False)
+    net.initialize()
+    rng = onp.random.default_rng(3)
+    base = rng.integers(1, 200, (1, 10))
+    ids_a = mx.np.array(base, dtype='int32')
+    # same first 6 tokens, garbage tail
+    tail = base.copy()
+    tail[0, 6:] = rng.integers(1, 200, 4)
+    ids_b = mx.np.array(tail, dtype='int32')
+    vl = mx.np.array([6], dtype='int32')
+    tt = mx.np.zeros((1, 10), dtype='int32')
+    out_a = net(ids_a, tt, vl)[0].asnumpy()
+    out_b = net(ids_b, tt, vl)[0].asnumpy()
+    # valid positions must not see the padded tail
+    onp.testing.assert_allclose(out_a[0, :6], out_b[0, :6],
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_bert_train_step_reduces_loss():
+    net = _tiny_bert(use_classifier=False)
+    net.initialize()
+    rng = onp.random.default_rng(4)
+    ids = mx.np.array(rng.integers(0, 200, (4, 12)), dtype='int32')
+    tt = mx.np.zeros((4, 12), dtype='int32')
+    labels = mx.np.array(rng.integers(0, 200, (4, 12)), dtype='int32')
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            _, _, mlm = net(ids, tt)
+            loss = loss_fn(mlm, labels).mean()
+        loss.backward()
+        trainer.step(4)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_hybridize_matches_eager():
+    net = _tiny_bert(use_classifier=False, use_decoder=False)
+    net.initialize()
+    ids = mx.np.array(onp.arange(24).reshape(2, 12) % 200, dtype='int32')
+    tt = mx.np.zeros((2, 12), dtype='int32')
+    ref = net(ids, tt)[0].asnumpy()
+    net.hybridize()
+    net(ids, tt)
+    out = net(ids, tt)[0].asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_bert_hybridized_train_step():
+    """Full hybridized train step (the bench.py path) must work."""
+    net = _tiny_bert(use_classifier=False)
+    net.initialize()
+    ids = mx.np.zeros((2, 8), dtype='int32')
+    tt = mx.np.zeros((2, 8), dtype='int32')
+    net(ids, tt)
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    labels = mx.np.zeros((2, 8), dtype='int32')
+    for _ in range(2):
+        with autograd.record():
+            _, _, mlm = net(ids, tt)
+            loss = loss_fn(mlm, labels).mean()
+        loss.backward()
+        trainer.step(2)
+    assert onp.isfinite(float(loss.asnumpy()))
+
+
+def test_bert_large_config():
+    cfg = bert._BERT_CONFIGS['bert_24_1024_16']
+    assert cfg['num_layers'] == 24 and cfg['units'] == 1024
+
+
+def test_mha_causal_alignment_consistent_tne_s():
+    """Flash and masked branches must agree on causal alignment when T!=S
+    (code-review regression: KV-cache decode)."""
+    rng = onp.random.default_rng(5)
+    b, t, s, e, h = 1, 2, 6, 16, 2
+    q = mx.np.array(rng.standard_normal((b, t, e)), dtype='float32')
+    k = mx.np.array(rng.standard_normal((b, s, e)), dtype='float32')
+    v = mx.np.array(rng.standard_normal((b, s, e)), dtype='float32')
+    out_flash = mx.npx.multi_head_attention(q, k, v, h, causal=True)
+    full = mx.np.ones((b, 1, t, s), dtype='bool')
+    out_masked = mx.npx.multi_head_attention(q, k, v, h, causal=True,
+                                             mask=full)
+    onp.testing.assert_allclose(out_flash.asnumpy(), out_masked.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_symbolblock_from_traced_symbol_with_aux():
+    """In-memory SymbolBlock(sym, inputs) must resolve hoisted constants
+    (code-review regression)."""
+    from mxnet_tpu.gluon import SymbolBlock, nn
+
+    class PosBlock(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.table = mx.np.random.uniform(size=(1, 32, 16))
+
+        def forward(self, x):
+            return x + self.table
+
+    net = PosBlock()
+    x = mx.np.ones((2, 32, 16))
+    ref = net(x).asnumpy()
+    sym = net._trace_symbol(x)
+    blk = SymbolBlock(sym, 'data')
+    onp.testing.assert_allclose(blk(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_symbol_unique_positional_flags():
+    x = mx.sym.var('x')
+    u = mx.sym.np.unique(x, True)
+    assert u.num_outputs == 2
