@@ -32,6 +32,9 @@ from .ndarray.ndarray import NDArray
 from . import symbol
 from . import symbol as sym
 from . import _deferred_compute
+from . import operator
+from . import library
+from . import rtc
 
 from . import engine
 from . import initializer

@@ -130,6 +130,10 @@ class BERTModel(HybridBlock):
         self.embed_ln = BERTLayerNorm(in_channels=units)
         self.encoder = BERTEncoder(num_layers, units, hidden_size,
                                    num_heads, max_length, dropout)
+        if use_classifier and not use_pooler:
+            raise ValueError(
+                'use_classifier=True requires use_pooler=True (NSP head '
+                'classifies the pooled [CLS] representation)')
         self.use_pooler = use_pooler
         self.use_decoder = use_decoder
         self.use_classifier = use_classifier

@@ -55,8 +55,13 @@ class KVStoreLocal(KVStoreBase):
 
     # ------------------------------------------------------- classic surface
     def init(self, key, value):
+        from ..ndarray import sparse as _sp
         for k, vals in _group(key, value):
-            self._store[k] = NDArray(vals[0]._data, ctx=vals[0]._ctx)
+            v = vals[0]
+            if isinstance(v, _sp.BaseSparseNDArray):
+                self._store[k] = v.copy()   # keep sparse storage
+            else:
+                self._store[k] = NDArray(v._data, ctx=v._ctx)
 
     def push(self, key, value, priority=0):
         for k, vals in _group(key, value):
@@ -105,9 +110,48 @@ class KVStoreLocal(KVStoreBase):
         self.pull(key, out=out, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Sparse pull degrades to dense pull until the sparse module lands
-        (the reference itself falls back widely — src/common/exec_utils.h)."""
-        self.pull(key, out=out, priority=priority)
+        """Pull only the requested rows (reference kvstore.py
+        row_sparse_pull → PullRowSparse, include/mxnet/kvstore.h:221).
+
+        With a RowSparseNDArray stored value, returns/updates the retained
+        rows; dense stored values gather the requested rows into the dense
+        output (the useful TPU form: gather over a sharded embedding axis,
+        SURVEY §5 last row)."""
+        from ..ndarray import sparse as _sp
+        if isinstance(key, (list, tuple)):
+            rids = row_ids if isinstance(row_ids, (list, tuple)) else \
+                [row_ids] * len(key)
+            outs = out if isinstance(out, (list, tuple)) else \
+                [None] * len(key)
+            return [self.row_sparse_pull(k, out=o, priority=priority,
+                                         row_ids=r)
+                    for k, o, r in zip(key, outs, rids)]
+        value = self._store[key]
+        if row_ids is None:
+            self.pull(key, out=out, priority=priority)
+            return out
+        if isinstance(value, _sp.RowSparseNDArray):
+            res = _sp.retain(value, row_ids)
+            if out is not None:
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                for o in outs:
+                    o.data = res.data
+                    o.indices = res.indices
+                    o._invalidate()
+                return out
+            return res
+        import jax.numpy as jnp
+        rows = row_ids._data.astype(jnp.int32) if hasattr(row_ids, '_data') \
+            else jnp.asarray(row_ids, jnp.int32)
+        gathered = value._data.at[rows].get()
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                o._rebind(o._data.at[rows].set(gathered))
+            return out
+        res = jnp.zeros_like(value._data).at[rows].set(gathered)
+        from ..ndarray.ndarray import NDArray
+        return NDArray(res)
 
     # ------------------------------------------------------ optimizer hooks
     def set_updater(self, updater):

@@ -1,0 +1,51 @@
+"""Out-of-tree extension loading.
+
+Reference: ``python/mxnet/library.py`` ``load()`` → ``MXLoadLib`` — load a
+dynamic library implementing custom ops / partitioners / graph passes via
+the self-contained ``include/mxnet/lib_api.h`` ABI (1,313 LoC; examples at
+example/extensions/lib_custom_op).
+
+TPU re-design: the extension unit is a Python module (the registry it must
+talk to — ops.registry, operator.register, symbol passes — lives in
+Python; there is no C ABI boundary to cross). A ``.py`` path is executed
+with the registration API in scope; a ``.so`` path is loaded with ctypes
+and may expose an optional ``mxnet_tpu_lib_init`` entry point (for native
+data-path extensions, e.g. custom RecordIO codecs).
+"""
+
+import ctypes
+import os
+import runpy
+
+_loaded = {}
+
+
+def load(path, verbose=True):
+    """Load an extension library (reference library.py:load).
+
+    Returns the module namespace (``.py``) or the CDLL handle (``.so``).
+    """
+    path = os.path.abspath(path)
+    if path in _loaded:
+        return _loaded[path]
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if path.endswith('.py'):
+        ns = runpy.run_path(path)
+        _loaded[path] = ns
+        if verbose:
+            import logging
+            logging.info('loaded library %s (%d symbols)', path, len(ns))
+        return ns
+    if path.endswith(('.so', '.dylib')):
+        lib = ctypes.CDLL(path, ctypes.RTLD_LOCAL)
+        if hasattr(lib, 'mxnet_tpu_lib_init'):
+            lib.mxnet_tpu_lib_init()
+        _loaded[path] = lib
+        return lib
+    raise ValueError(
+        f'unsupported extension type: {path} (expected .py or .so)')
+
+
+def loaded_libraries():
+    return dict(_loaded)

@@ -46,3 +46,9 @@ def save(fname, data):
 def load(fname):
     from ..model import load_ndarray_map
     return load_ndarray_map(fname)
+
+from . import contrib  # noqa: E402  (mx.nd.contrib.foreach etc.)
+
+from ..operator import Custom, custom  # noqa: E402  (mx.nd.Custom)
+
+from . import sparse  # noqa: E402  (mx.nd.sparse)

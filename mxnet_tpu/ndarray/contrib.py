@@ -1,0 +1,12 @@
+"""``mx.nd.contrib`` — contrib op namespace.
+
+Reference: python/mxnet/ndarray/contrib.py (control flow ops + contrib
+kernels reachable as mx.nd.contrib.*).
+"""
+
+import sys as _sys
+
+from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401
+from . import register as _register
+
+_register.populate(_sys.modules[__name__].__dict__, 'nd')

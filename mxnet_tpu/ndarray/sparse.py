@@ -1,0 +1,309 @@
+"""Sparse NDArray: row_sparse and CSR storage.
+
+Reference: ``python/mxnet/ndarray/sparse.py`` (BaseSparseNDArray,
+RowSparseNDArray, CSRNDArray) over the C++ storage types in
+``include/mxnet/ndarray.h:61-66`` (kRowSparseStorage carries one aux index
+array of present rows; kCSRStorage carries indptr + indices) and the
+sparse kernels in ``src/operator/tensor/dot.cc`` / ``cast_storage``.
+
+TPU re-design (SURVEY §7 hard-part 5): component arrays are plain dense
+``jax.Array``s (indices + values), so every sparse op is a gather/scatter
+or segment-sum that XLA maps well onto TPU; there are no dynamic nnz
+shapes inside jit (nnz is fixed per array instance, like the reference
+where aux shapes are part of the NDArray). Generic ops fall back to dense
+via ``tostype('default')`` exactly like the reference's storage-fallback
+path (src/common/exec_utils.h), while the dedicated ops below
+(``dot``, ``elemwise_add``, ``retain``, ``where``) use the structure.
+"""
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray, array
+
+__all__ = ['BaseSparseNDArray', 'RowSparseNDArray', 'CSRNDArray',
+           'row_sparse_array', 'csr_matrix', 'zeros', 'empty', 'dot',
+           'retain', 'cast_storage', 'add']
+
+
+class BaseSparseNDArray(NDArray):
+    """Common sparse behavior. ``_data`` holds the DENSE equivalent lazily
+    (None until needed) so inherited NDArray methods keep working through
+    the dense-fallback path (reference exec_utils.h storage fallback)."""
+
+    def __init__(self, shape, dtype, ctx=None):
+        super().__init__(None, ctx=ctx)
+        self._shape = tuple(shape)
+        self._dtype = _np.dtype(dtype)
+
+    # dense fallback: materialize on demand
+    @property
+    def _data(self):
+        d = self.__dict__.get('_dense')
+        if d is None:
+            d = self._to_dense_raw()
+            self.__dict__['_dense'] = d
+        return d
+
+    @_data.setter
+    def _data(self, value):
+        self.__dict__['_dense'] = value
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def _invalidate(self):
+        self.__dict__['_dense'] = None
+
+    def _rebind(self, raw):
+        """A write to a sparse array recompresses the new dense value into
+        the component arrays (keeps .data/.indices authoritative — the
+        reference mutates aux arrays in the same situation, ndarray.h:308).
+        Used by KVStore push/updater paths."""
+        self.__dict__['_dense'] = raw
+        fresh = cast_storage(NDArray(raw), self.stype)
+        self._refresh_from(fresh)
+        if self._ag is not None and not self._ag.variable:
+            self._ag = None
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == 'default':
+            return NDArray(self._to_dense_raw(), ctx=self._ctx)
+        return cast_storage(self.todense(), stype)
+
+    def todense(self):
+        return NDArray(self._to_dense_raw(), ctx=self._ctx)
+
+    def asnumpy(self):
+        return _np.asarray(jax.device_get(self._to_dense_raw()))
+
+    def __repr__(self):
+        return (f'<{type(self).__name__} {self.shape} '
+                f'{self._dtype.name}>')
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows-present storage (reference sparse.py RowSparseNDArray;
+    kRowSparseStorage, ndarray.h:63). ``indices``: sorted int64 row ids,
+    ``data``: (len(indices),) + shape[1:] values."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        data = data if isinstance(data, NDArray) else array(data)
+        indices = indices if isinstance(indices, NDArray) else array(
+            _np.asarray(indices, dtype='int64'))
+        super().__init__(shape, data.dtype, ctx)
+        self.data = data
+        self.indices = indices
+
+    @property
+    def stype(self):
+        return 'row_sparse'
+
+    def _to_dense_raw(self):
+        dense = jnp.zeros(self._shape, dtype=self._dtype)
+        idx = self.indices._data.astype(jnp.int32)
+        return dense.at[idx].set(self.data._data)
+
+    def copy(self):
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(),
+                                self._shape, self._ctx)
+
+    def _refresh_from(self, fresh):
+        self.data = fresh.data
+        self.indices = fresh.indices
+
+    def retain(self, rsp_indices):
+        return retain(self, rsp_indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row storage (reference sparse.py CSRNDArray;
+    kCSRStorage, ndarray.h:64)."""
+
+    def __init__(self, data, indptr, indices, shape, ctx=None):
+        data = data if isinstance(data, NDArray) else array(data)
+        super().__init__(shape, data.dtype, ctx)
+        self.data = data
+        self.indptr = indptr if isinstance(indptr, NDArray) else array(
+            _np.asarray(indptr, dtype='int64'))
+        self.indices = indices if isinstance(indices, NDArray) else array(
+            _np.asarray(indices, dtype='int64'))
+
+    @property
+    def stype(self):
+        return 'csr'
+
+    def _to_dense_raw(self):
+        n_rows, n_cols = self._shape
+        indptr = self.indptr._data
+        nnz = self.data.shape[0]
+        # row id per nnz element via searchsorted over indptr
+        pos = jnp.arange(nnz)
+        rows = jnp.searchsorted(indptr, pos, side='right') - 1
+        dense = jnp.zeros(self._shape, dtype=self._dtype)
+        return dense.at[rows, self.indices._data].set(self.data._data)
+
+    def copy(self):
+        return CSRNDArray(self.data.copy(), self.indptr.copy(),
+                          self.indices.copy(), self._shape, self._ctx)
+
+    def _refresh_from(self, fresh):
+        self.data = fresh.data
+        self.indptr = fresh.indptr
+        self.indices = fresh.indices
+
+
+# ------------------------------------------------------------ constructors
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray (reference sparse.py row_sparse_array):
+    either from (data, indices) or by compressing a dense array."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(data, indices, shape, ctx)
+    dense = arg1 if isinstance(arg1, NDArray) else array(arg1, dtype=dtype)
+    return cast_storage(dense, 'row_sparse')
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (reference sparse.py csr_matrix): from
+    (data, indices, indptr) scipy-style or by compressing dense."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indptr, indices, shape, ctx)
+    dense = arg1 if isinstance(arg1, NDArray) else array(arg1, dtype=dtype)
+    return cast_storage(dense, 'csr')
+
+
+def zeros(stype, shape, ctx=None, dtype='float32'):
+    if stype == 'row_sparse':
+        return RowSparseNDArray(
+            array(_np.zeros((0,) + tuple(shape[1:]), dtype=dtype)),
+            array(_np.zeros((0,), dtype='int64')), shape, ctx)
+    if stype == 'csr':
+        return CSRNDArray(array(_np.zeros((0,), dtype=dtype)),
+                          array(_np.zeros((shape[0] + 1,), dtype='int64')),
+                          array(_np.zeros((0,), dtype='int64')), shape, ctx)
+    from ..ops.creation import zeros as dzeros
+    return dzeros(shape, dtype=dtype, ctx=ctx)
+
+
+empty = zeros
+
+
+# ------------------------------------------------------------------- ops
+
+def cast_storage(arr, stype):
+    """Reference: src/operator/tensor/cast_storage.cc. Host-side
+    compression (nnz is data-dependent → not jittable, same as the
+    reference where cast_storage runs as a standalone kernel)."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == 'default':
+        return arr
+    dense = _np.asarray(arr.asnumpy())
+    if stype == 'row_sparse':
+        mask = _np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1)
+        idx = _np.nonzero(mask)[0].astype('int64')
+        return RowSparseNDArray(array(dense[idx]), array(idx),
+                                dense.shape, arr._ctx)
+    if stype == 'csr':
+        if dense.ndim != 2:
+            raise ValueError('csr storage requires 2-D')
+        indptr = [0]
+        indices = []
+        data = []
+        for r in range(dense.shape[0]):
+            nz = _np.nonzero(dense[r])[0]
+            indices.extend(nz.tolist())
+            data.extend(dense[r, nz].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(
+            array(_np.asarray(data, dtype=dense.dtype)),
+            array(_np.asarray(indptr, dtype='int64')),
+            array(_np.asarray(indices, dtype='int64')),
+            dense.shape, arr._ctx)
+    raise ValueError(f'unknown storage type {stype}')
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference src/operator/tensor/dot.cc):
+
+    * csr · dense        → dense   (segment-sum over nnz)
+    * csr^T · dense      → dense   (scatter-add — the embedding-gradient
+                                    pattern)
+    * row_sparse inputs  → dense fallback
+    """
+    if isinstance(lhs, CSRNDArray) and not isinstance(
+            rhs, BaseSparseNDArray):
+        data = lhs.data._data
+        indices = lhs.indices._data.astype(jnp.int32)
+        indptr = lhs.indptr._data
+        nnz = data.shape[0]
+        rows = (jnp.searchsorted(indptr, jnp.arange(nnz), side='right')
+                - 1).astype(jnp.int32)
+        rd = rhs._data
+        if transpose_b:
+            rd = rd.T
+        gathered = rd[indices] * data[:, None]        # (nnz, N)
+        if transpose_a:
+            out = jnp.zeros((lhs.shape[1], rd.shape[1]), dtype=rd.dtype)
+            out = out.at[indices].add(rd[rows] * data[:, None])
+            return NDArray(out)
+        out = jax.ops.segment_sum(gathered, rows,
+                                  num_segments=lhs.shape[0])
+        return NDArray(out)
+    ld = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rd = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    a = ld._data.T if transpose_a else ld._data
+    b = rd._data.T if transpose_b else rd._data
+    return NDArray(jnp.dot(a, b))
+
+
+def retain(rsp, indices):
+    """Keep only the given rows (reference _retain, used by
+    kvstore row_sparse_pull)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError('retain expects a RowSparseNDArray')
+    want = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                       else indices, dtype='int64')
+    have = _np.asarray(rsp.indices.asnumpy(), dtype='int64')
+    keep = _np.isin(have, want)
+    sel = _np.nonzero(keep)[0]
+    return RowSparseNDArray(
+        NDArray(rsp.data._data[jnp.asarray(sel)]),
+        array(have[sel]), rsp.shape, rsp._ctx)
+
+
+def add(lhs, rhs):
+    """elemwise_add with sparse-aware fast path (rsp + rsp → rsp)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(
+            rhs, RowSparseNDArray) and lhs.shape == rhs.shape:
+        li = _np.asarray(lhs.indices.asnumpy(), dtype='int64')
+        ri = _np.asarray(rhs.indices.asnumpy(), dtype='int64')
+        rows = _np.union1d(li, ri)
+        pos = {int(r): i for i, r in enumerate(rows)}
+        out = jnp.zeros((len(rows),) + lhs.shape[1:], dtype=lhs.dtype)
+        if len(li):
+            out = out.at[jnp.asarray([pos[int(r)] for r in li])].add(
+                lhs.data._data)
+        if len(ri):
+            out = out.at[jnp.asarray([pos[int(r)] for r in ri])].add(
+                rhs.data._data)
+        return RowSparseNDArray(NDArray(out), array(rows), lhs.shape,
+                                lhs._ctx)
+    ld = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rd = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return ld + rd
+
+
+elemwise_add = add

@@ -76,3 +76,9 @@ def seed(s):
 
 def softmax(data, axis=-1, **kw):
     return _reg.make_frontend('softmax')(data, axis=axis, **kw)
+
+
+# higher-order control flow (reference src/operator/control_flow.cc via
+# mx.nd.contrib / npx) — these take Python callables, so they are plain
+# functions rather than registry ops
+from ..ops.control_flow import cond, foreach, while_loop  # noqa: E402

@@ -1,0 +1,163 @@
+"""Custom operators written in Python.
+
+Reference: ``python/mxnet/operator.py`` (CustomOp:434, CustomOpProp:487,
+register:710) backed by ``src/operator/custom/custom-inl.h:52-136`` — user
+ops run on a dedicated async worker so arbitrary Python can't stall the
+engine.
+
+TPU re-design: a custom op executes eagerly in-process (JAX's async
+dispatch already keeps the device busy; there is no engine thread to
+stall). Autograd wires ``backward`` in as a custom VJP on the tape — the
+same mechanism as ``autograd.Function``. If the op body is jax-traceable
+it also works under ``hybridize()``; if it calls host code (numpy etc.) it
+stays an eager-only island, matching the reference where custom ops break
+graph fusion (custom-inl.h dedicated worker).
+"""
+
+import numpy as _np
+
+from . import _tape
+from .ndarray.ndarray import NDArray
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference operator.py:434)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad request (reference
+        kWriteTo/kAddTo semantics)."""
+        if req == 'null':
+            return
+        if not isinstance(src, NDArray):
+            src = NDArray(src)
+        if req == 'add':
+            dst._rebind((dst + src)._data)
+        else:  # write / inplace
+            dst._rebind(src._data)
+
+
+class CustomOpProp:
+    """Op metadata provider (reference operator.py:487)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ['data']
+
+    def list_outputs(self):
+        return ['output']
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass (reference operator.py:710)."""
+
+    def deco(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop(name):
+    return _REGISTRY[name]
+
+
+def custom(*args, op_type=None, **kwargs):
+    """Invoke a registered custom op: ``mx.nd.Custom(x, op_type='name')``
+    (reference: the generated `Custom` op calling CustomOperator::Push).
+    """
+    if op_type is None:
+        raise ValueError('op_type= is required')
+    prop = _REGISTRY[op_type](**{k: str(v) for k, v in kwargs.items()})
+
+    in_data = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
+    in_shapes = [list(a.shape) for a in in_data]
+    _, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    in_types = [a.dtype for a in in_data]
+    _, out_types, aux_types = prop.infer_type(in_types)
+
+    from .context import current_context
+    ctx = current_context()
+    op = prop.create_operator(ctx, in_shapes, [str(t) for t in in_types])
+
+    import jax.numpy as jnp
+    out_data = [NDArray(jnp.zeros(tuple(s), dtype=_np.dtype(t)))
+                for s, t in zip(out_shapes, out_types)]
+    aux = [NDArray(jnp.zeros(tuple(s), dtype=_np.dtype(t)))
+           for s, t in zip(aux_shapes, aux_types)]
+
+    recording = _tape.is_recording() and _tape._needs_grad(in_data)
+    is_train = recording and _tape.is_training()
+    prev = _tape.set_recording(False)
+    try:
+        op.forward(is_train=is_train, req=['write'] * len(out_data),
+                   in_data=in_data, out_data=out_data, aux=aux)
+    finally:
+        _tape.set_recording(prev)
+
+    if recording:
+        import jax
+
+        def _fn(*raws):
+            return tuple(o._data for o in out_data)
+
+        node = _tape.TapeNode(
+            _fn, [x._data for x in in_data],
+            [getattr(x, '_ag', None) for x in in_data],
+            len(out_data), f'Custom[{op_type}]',
+            out_avals=[jax.typeof(o._data) for o in out_data],
+            multi=len(out_data) > 1)
+
+        def _custom_vjp(cots):
+            if not isinstance(cots, (tuple, list)):
+                cots = (cots,)
+            in_grad = [NDArray(jnp.zeros(a.shape, dtype=a.dtype))
+                       for a in in_data]
+            prev = _tape.set_recording(False)
+            try:
+                op.backward(req=['write'] * len(in_grad),
+                            out_grad=[NDArray(c) for c in cots],
+                            in_data=in_data, out_data=out_data,
+                            in_grad=in_grad, aux=aux)
+            finally:
+                _tape.set_recording(prev)
+            return tuple(g._data for g in in_grad)
+
+        node.vjp_fn = _custom_vjp
+        for i, o in enumerate(out_data):
+            o._ag = _tape.AGInfo(node=node, index=i)
+
+    return out_data[0] if len(out_data) == 1 else tuple(out_data)
+
+
+Custom = custom

@@ -108,6 +108,22 @@ def multi_head_attention(q, k, v, num_heads, mask=None, dropout_p=0.0,
         sk = k.shape[1]
         tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)[None, None]
         mask = tri if mask is None else jnp.logical_and(mask, tri)
+    if dropout_p > 0.0:
+        if key is None:
+            raise ValueError(
+                'multi_head_attention with dropout_p > 0 needs key= (a '
+                'jax PRNG key); pass one or apply nn.Dropout outside')
+        hd_scale = hd ** -0.5
+        s = jnp.einsum('bqhd,bkhd->bhqk', qh.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * hd_scale
+        if mask is not None:
+            s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+        out = jnp.einsum('bhqk,bkhd->bqhd', p,
+                         vh.astype(jnp.float32)).astype(q.dtype)
+        return out.reshape(b, sq, e)
     out = jax.nn.dot_product_attention(qh, kh, vh, mask=mask)
     return out.reshape(b, sq, e)
 

@@ -1,0 +1,184 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: ``src/operator/control_flow.cc:1096-1262`` — `_foreach`,
+`_while_loop`, `_cond` as higher-order stateful ops executing captured
+subgraphs node-by-node, exposed as ``mx.nd.contrib.foreach`` etc.
+
+TPU re-design (SURVEY §7 hard-part 4): the bodies trace into
+``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` — compiler-friendly
+control flow with no Python loop inside jit, and autograd via the same
+``apply_op`` + jax.vjp path every other op uses. TPU constraint carried
+into the API: ``while_loop`` output buffers have static leading dimension
+``max_iterations``, with rows past the exit step zero-padded (the
+reference pads to max_iterations as well).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import _tape
+from .registry import Op, apply_op
+
+
+def _flatten(x):
+    """Flatten (nested) NDArray structures → leaves + treedef."""
+    from ..ndarray.ndarray import NDArray
+    return jax.tree.flatten(x, is_leaf=lambda a: isinstance(a, NDArray))
+
+
+def _raws(leaves):
+    from ..ndarray.ndarray import NDArray
+    return [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+            for a in leaves]
+
+
+def _wrap(treedef, raw_leaves):
+    from ..ndarray.ndarray import NDArray
+    return jax.tree.unflatten(treedef, [NDArray(r) for r in raw_leaves])
+
+
+def _call_body(fn, *py_args):
+    """Run a user body with the tape off (the body is traced, not
+    recorded op-by-op — one fused node lands on the tape instead, the way
+    the reference records a single _foreach stateful op)."""
+    prev = _tape.set_recording(False)
+    try:
+        return fn(*py_args)
+    finally:
+        _tape.set_recording(prev)
+
+
+def foreach(body, data, init_states, name='foreach'):
+    """Scan ``body(data_slice, states) -> (outputs, new_states)`` over the
+    leading axis of ``data`` (reference control_flow.cc `_foreach`).
+
+    Returns ``(outputs, final_states)`` with per-step outputs stacked on
+    axis 0. Maps to ``lax.scan`` — XLA unrolls/pipelines it on TPU.
+    """
+    data_leaves, data_tree = _flatten(data)
+    st_leaves, st_tree = _flatten(init_states)
+    n_data = len(data_leaves)
+    arrays = [a for a in data_leaves + st_leaves]
+    out_info = {}
+
+    def fn(*raw):
+        xs = list(raw[:n_data])
+        carry0 = list(raw[n_data:])
+
+        def step(carry, x_slice):
+            states = _wrap(st_tree, carry)
+            x = _wrap(data_tree, x_slice)
+            outs, new_states = _call_body(body, x, states)
+            o_leaves, o_tree = _flatten(outs)
+            ns_leaves, _ = _flatten(new_states)
+            out_info['tree'] = o_tree
+            return _raws(ns_leaves), tuple(_raws(o_leaves))
+
+        carry, ys = lax.scan(step, carry0, tuple(xs))
+        return tuple(ys) + tuple(carry)
+
+    op = Op(name, fn, differentiable=True)
+    res = apply_op(op, arrays, fn, name=name)
+    res = res if isinstance(res, tuple) else (res,)
+    n_out = len(res) - len(st_leaves)
+    outputs = jax.tree.unflatten(out_info['tree'], list(res[:n_out]))
+    states = jax.tree.unflatten(st_tree, list(res[n_out:]))
+    return outputs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations, name='while_loop'):
+    """Reference control_flow.cc `_while_loop`.
+
+    ``cond(*loop_vars) -> boolean scalar``; ``func(*loop_vars) ->
+    (step_outputs, new_loop_vars)``. Executes until cond is false or
+    ``max_iterations`` steps. Outputs are stacked into buffers with static
+    leading dim ``max_iterations`` (rows past the exit hold zeros — same
+    padding contract as the reference, which cannot return dynamic shapes
+    either); also returns the final loop vars.
+    """
+    lv_leaves, lv_tree = _flatten(loop_vars)
+    out_info = {}
+
+    def fn(*raw):
+        carry0 = (list(raw), jnp.asarray(True))
+
+        def step(carry, _):
+            vals, active = carry
+            vars_nd = _wrap(lv_tree, vals)
+            keep_going = jnp.logical_and(
+                active, _as_bool(_call_body(cond, *_as_args(vars_nd))))
+
+            def run(vals):
+                vars_nd = _wrap(lv_tree, vals)
+                outs, new_vars = _call_body(func, *_as_args(vars_nd))
+                o_leaves, o_tree = _flatten(outs)
+                nv_leaves, _ = _flatten(new_vars)
+                out_info['tree'] = o_tree
+                return _raws(nv_leaves), tuple(_raws(o_leaves))
+
+            def skip(vals):
+                new_vals, outs = run(vals)  # shapes only; zero the outputs
+                return vals, tuple(jnp.zeros_like(o) for o in outs)
+
+            new_vals, outs = lax.cond(keep_going, run, skip, vals)
+            return (new_vals, keep_going), (outs, keep_going)
+
+        (final_vals, _), (ys, _mask) = lax.scan(
+            step, carry0, None, length=max_iterations)
+        return tuple(ys) + tuple(final_vals)
+
+    op = Op(name, fn, differentiable=True)
+    res = apply_op(op, list(lv_leaves), fn, name=name)
+    res = res if isinstance(res, tuple) else (res,)
+    n_out = len(res) - len(lv_leaves)
+    outputs = jax.tree.unflatten(out_info['tree'], list(res[:n_out]))
+    final_vars = jax.tree.unflatten(lv_tree, list(res[n_out:]))
+    return outputs, final_vars
+
+
+def _as_args(vars_nd):
+    return vars_nd if isinstance(vars_nd, (list, tuple)) else (vars_nd,)
+
+
+def _as_bool(x):
+    from ..ndarray.ndarray import NDArray
+    raw = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    return raw.reshape(()).astype(bool)
+
+
+def cond(pred, then_func, else_func, inputs=(), name='cond'):
+    """Reference control_flow.cc `_cond` → ``lax.cond``.
+
+    ``pred``: boolean scalar NDArray (or callable over inputs). Both
+    branches must produce identically-shaped outputs (XLA requirement; the
+    reference infers a joint shape the same way).
+    """
+    in_leaves, in_tree = _flatten(list(inputs))
+    out_info = {}
+    if callable(pred):
+        pred = _call_body(pred, *jax.tree.unflatten(in_tree, in_leaves))
+    arrays = [pred] + list(in_leaves)
+
+    def fn(praw, *raw):
+        def mk(branch):
+            def run(vals):
+                args = jax.tree.unflatten(in_tree,
+                                          [_nd(v) for v in vals])
+                outs = _call_body(branch, *args)
+                o_leaves, o_tree = _flatten(outs)
+                out_info['tree'] = o_tree
+                return tuple(_raws(o_leaves))
+            return run
+
+        return lax.cond(praw.reshape(()).astype(bool),
+                        mk(then_func), mk(else_func), list(raw))
+
+    def _nd(v):
+        from ..ndarray.ndarray import NDArray
+        return NDArray(v)
+
+    op = Op(name, fn, differentiable=True)
+    res = apply_op(op, arrays, fn, name=name)
+    res = res if isinstance(res, tuple) else (res,)
+    return jax.tree.unflatten(out_info['tree'], list(res))

@@ -136,11 +136,11 @@ def _choose_block(n, preferred):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash3(q, k, v, sm_scale, causal, block_q, block_k):
-    if _on_tpu():
+    # block_q == 0 → XLA path (off-TPU, or shapes the kernel tiles badly);
+    # CI exercises the Pallas kernel via flash_attention(interpret=True)
+    if _on_tpu() and block_q:
         return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                           interpret=False)
-    # off-TPU: pallas interpret mode is slow; CI exercises the kernel
-    # explicitly via flash_attention(..., interpret=True) tests
     return _reference_attention(q, k, v, sm_scale, causal)
 
 
@@ -194,18 +194,27 @@ def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
     qr = q.reshape((-1, t, d))
     kr = k.reshape((-1, s, d))
     vr = v.reshape((-1, s, d))
+    if causal and t > s:
+        # bottom-right causal with more queries than keys leaves fully
+        # masked rows; keep forward/backward consistent via the XLA path
+        # (the kernel's online softmax would emit zeros there)
+        return _reference_attention(qr, kr, vr, sm_scale,
+                                    causal).reshape(q_shape)
     if interpret:
         bq = _choose_block(t, block_q)
         bk = _choose_block(s, block_k)
         out = _flash_fwd(qr, kr, vr, sm_scale, causal, bq, bk,
                          interpret=True)
         return out.reshape(q_shape)
-    if _on_tpu() and (t % block_q == 0) and (s % block_k == 0):
-        out = _flash3(qr, kr, vr, sm_scale, causal, block_q, block_k)
-    elif _on_tpu():
-        bq = _choose_block(t, block_q)
-        bk = _choose_block(s, block_k)
-        out = _flash3(qr, kr, vr, sm_scale, causal, bq, bk)
+    if _on_tpu():
+        bq = block_q if t % block_q == 0 else _choose_block(t, block_q)
+        bk = block_k if s % block_k == 0 else _choose_block(s, block_k)
+        if bq < 32 or bk < 32:
+            # awkward sequence lengths (prime factors < MXU tile) would
+            # degrade to scalar-ish tiles; XLA's fused attention is faster
+            out = _flash3(qr, kr, vr, sm_scale, causal, 0, 0)
+        else:
+            out = _flash3(qr, kr, vr, sm_scale, causal, bq, bk)
     else:
         out = _flash3(qr, kr, vr, sm_scale, causal, block_q, block_k)
     return out.reshape(q_shape)

@@ -1,0 +1,116 @@
+"""Runtime kernel compilation — the user-facing Pallas hook.
+
+Reference: ``python/mxnet/rtc.py:41`` ``CudaModule`` — compile raw CUDA
+source at runtime via NVRTC (src/common/rtc.cc:35-52) and launch with
+NDArray args.
+
+TPU analog (SURVEY §2.1 "RTC" row): users hand a Python source string (or
+module) defining Pallas kernel functions; ``get_kernel`` wraps one into a
+launchable bound to ``pl.pallas_call``. Launch geometry maps CUDA's
+grid/block to the Pallas ``grid`` (blocks are implicit in BlockSpecs).
+Off-TPU the kernel runs in interpreter mode so the same user code works in
+CPU CI.
+"""
+
+import jax
+
+__all__ = ['PallasModule', 'PallasKernel', 'CudaModule']
+
+
+def _exec_namespace(source):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # platform registry already stripped (CPU guard)
+        pltpu = None
+    ns = {'jax': jax, 'jnp': jnp, 'pl': pl, 'pltpu': pltpu}
+    exec(compile(source, '<mx.rtc source>', 'exec'), ns)
+    return ns
+
+
+class PallasKernel:
+    """One launchable kernel (≙ reference rtc.py CudaKernel)."""
+
+    def __init__(self, fn, name):
+        self._fn = fn
+        self._name = name
+
+    def launch(self, args, grid=None, out_shapes=None, out_dtypes=None,
+               in_specs=None, out_specs=None, interpret=None,
+               **pallas_kwargs):
+        """Run the kernel over NDArray/array args.
+
+        ``out_shapes``/``out_dtypes`` describe the outputs (≙ pre-allocated
+        output NDArrays in the reference launch signature); ``grid`` is the
+        Pallas grid (≙ CUDA grid_dims).
+        """
+        from jax.experimental import pallas as pl
+
+        from .ndarray.ndarray import NDArray
+        from .ops.registry import Op, apply_op
+
+        if out_shapes is None:
+            raise ValueError('out_shapes= is required')
+        single = not isinstance(out_shapes, (list, tuple)) or (
+            out_shapes and isinstance(out_shapes[0], int))
+        if single:
+            out_shapes = [tuple(out_shapes)]
+        if out_dtypes is None:
+            out_dtypes = ['float32'] * len(out_shapes)
+        elif not isinstance(out_dtypes, (list, tuple)):
+            out_dtypes = [out_dtypes]
+        if interpret is None:
+            interpret = jax.devices()[0].platform != 'tpu'
+
+        import numpy as _np
+        out_shape = [jax.ShapeDtypeStruct(tuple(s), _np.dtype(d))
+                     for s, d in zip(out_shapes, out_dtypes)]
+        call_kwargs = dict(pallas_kwargs)
+        if grid is not None:
+            call_kwargs['grid'] = tuple(grid)
+        if in_specs is not None:
+            call_kwargs['in_specs'] = in_specs
+        if out_specs is not None:
+            call_kwargs['out_specs'] = out_specs
+
+        launcher = pl.pallas_call(
+            self._fn,
+            out_shape=out_shape if len(out_shape) > 1 else out_shape[0],
+            interpret=interpret, **call_kwargs)
+
+        nds = [a if isinstance(a, NDArray) else NDArray(jax.numpy.asarray(a))
+               for a in args]
+
+        def fn(*raws):
+            return launcher(*raws)
+
+        op = Op(f'rtc_{self._name}', fn, differentiable=False)
+        res = apply_op(op, nds, fn, name=op.name)
+        return res
+
+
+class PallasModule:
+    """Compile kernels from source (≙ reference rtc.py CudaModule).
+
+    ``source``: Python source defining Pallas kernel functions
+    (``def my_kernel(in_ref, out_ref): ...``). ``exports`` optionally
+    restricts which names are kernels.
+    """
+
+    def __init__(self, source, options=(), exports=()):
+        self._ns = _exec_namespace(source)
+        self._exports = tuple(exports)
+
+    def get_kernel(self, name, signature=None):
+        if self._exports and name not in self._exports:
+            raise KeyError(f'{name} not exported from this module')
+        fn = self._ns.get(name)
+        if fn is None or not callable(fn):
+            raise KeyError(f'no kernel {name!r} in module source')
+        return PallasKernel(fn, name)
+
+
+# API-parity alias: code written against mx.rtc.CudaModule keeps working,
+# with Pallas source instead of CUDA C.
+CudaModule = PallasModule

@@ -1,0 +1,78 @@
+"""Control-flow op tests (reference tests cover _foreach/_while_loop/_cond
+semantics in test_operator.py / control_flow tests — SURVEY §4)."""
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_foreach_cumsum():
+    def body(x, states):
+        (acc,) = states
+        acc = acc + x
+        return acc, [acc]
+
+    data = mx.np.array([[1.0], [2.0], [3.0]])
+    init = [mx.np.zeros((1,))]
+    outs, states = mx.npx.foreach(body, data, init)
+    onp.testing.assert_allclose(outs.asnumpy(), [[1], [3], [6]])
+    onp.testing.assert_allclose(states[0].asnumpy(), [6])
+
+
+def test_foreach_grad():
+    data = mx.np.array([[1.0], [2.0], [3.0]])
+    data.attach_grad()
+
+    def body(x, states):
+        (acc,) = states
+        acc = acc + x * x
+        return acc, [acc]
+
+    with autograd.record():
+        outs, states = mx.npx.foreach(body, data, [mx.np.zeros((1,))])
+        loss = states[0].sum()
+    loss.backward()
+    onp.testing.assert_allclose(data.grad.asnumpy(), [[2], [4], [6]])
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return (s, (i + 1, s + i))
+
+    outs, (i_fin, s_fin) = mx.npx.while_loop(
+        cond_fn, func, (mx.np.array(0), mx.np.array(0)), max_iterations=10)
+    assert int(i_fin.asnumpy()) == 5
+    assert int(s_fin.asnumpy()) == 10  # 0+1+2+3+4
+    assert outs.shape[0] == 10  # static buffer (padded past exit)
+
+
+def test_cond():
+    x = mx.np.array([1.0, 2.0])
+    out = mx.npx.cond(mx.np.array(True),
+                      lambda a: a * 2.0, lambda a: a - 1.0, [x])
+    onp.testing.assert_allclose(out.asnumpy(), [2, 4])
+    out = mx.npx.cond(mx.np.array(False),
+                      lambda a: a * 2.0, lambda a: a - 1.0, [x])
+    onp.testing.assert_allclose(out.asnumpy(), [0, 1])
+
+
+def test_cond_callable_pred_and_grad():
+    x = mx.np.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        out = mx.npx.cond(lambda a: (a > 0).sum() > 0,
+                          lambda a: a * a, lambda a: -a, [x])
+        loss = out.sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_nd_contrib_namespace():
+    assert hasattr(mx.nd.contrib, 'foreach')
+    assert hasattr(mx.nd.contrib, 'while_loop')
+    assert hasattr(mx.nd.contrib, 'cond')
+    assert hasattr(mx.nd.contrib, 'box_nms')

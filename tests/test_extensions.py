@@ -1,0 +1,80 @@
+"""Custom ops / mx.library / mx.rtc tests (reference coverage:
+test_operator.py Custom-op tests, rtc tests in tests/python/gpu/)."""
+
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+@mx.operator.register('sigmoid_custom')
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return SigmoidOp()
+
+
+class SigmoidOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        y = 1.0 / (1.0 + mx.np.exp(-x))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+def test_custom_op_forward():
+    x = mx.np.array([0.0, 1.0, -1.0])
+    y = mx.nd.Custom(x, op_type='sigmoid_custom')
+    onp.testing.assert_allclose(
+        y.asnumpy(), 1 / (1 + onp.exp(-x.asnumpy())), rtol=1e-6)
+
+
+def test_custom_op_backward():
+    x = mx.np.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type='sigmoid_custom')
+        loss = y.sum()
+    loss.backward()
+    s = 1 / (1 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_library_load_python_extension(tmp_path):
+    ext = tmp_path / 'myext.py'
+    ext.write_text(
+        'from mxnet_tpu.ops.registry import register\n'
+        'import jax.numpy as jnp\n'
+        "@register('myext_triple')\n"
+        'def myext_triple(x):\n'
+        '    return 3 * x\n')
+    mx.library.load(str(ext))
+    from mxnet_tpu.ops.registry import get_op, invoke
+    out = invoke(get_op('myext_triple'), (mx.np.array([1.0, 2.0]),), {})
+    onp.testing.assert_allclose(out.asnumpy(), [3, 6])
+
+
+def test_rtc_pallas_module():
+    src = '''
+def double_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+'''
+    mod = mx.rtc.PallasModule(src)
+    kern = mod.get_kernel('double_kernel')
+    x = mx.np.array(onp.arange(8.0, dtype='float32').reshape(8, 1))
+    (out,) = [kern.launch([x], out_shapes=(8, 1))]
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy() * 2)
+
+
+def test_rtc_unknown_kernel():
+    mod = mx.rtc.PallasModule('def k(a_ref, o_ref):\n    o_ref[...] = a_ref[...]\n')
+    with pytest.raises(KeyError):
+        mod.get_kernel('nope')

@@ -1,0 +1,143 @@
+"""Sparse NDArray tests (reference: test_sparse_ndarray.py /
+test_sparse_operator.py coverage model, SURVEY §4)."""
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def _dense_with_zero_rows():
+    d = onp.zeros((5, 3), dtype='float32')
+    d[1] = [1, 2, 3]
+    d[3] = [4, 0, 6]
+    return d
+
+
+def test_row_sparse_roundtrip():
+    d = _dense_with_zero_rows()
+    rsp = sparse.row_sparse_array(mx.np.array(d))
+    assert rsp.stype == 'row_sparse'
+    onp.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 3])
+    onp.testing.assert_allclose(rsp.asnumpy(), d)
+    assert rsp.tostype('default').stype == 'default'
+
+
+def test_row_sparse_from_components():
+    rsp = sparse.row_sparse_array(
+        (onp.ones((2, 3), dtype='float32'), [0, 4]), shape=(6, 3))
+    dense = rsp.asnumpy()
+    assert dense[0].sum() == 3 and dense[4].sum() == 3
+    assert dense[1:4].sum() == 0 and dense[5].sum() == 0
+
+
+def test_csr_roundtrip():
+    d = _dense_with_zero_rows()
+    csr = sparse.csr_matrix(mx.np.array(d))
+    assert csr.stype == 'csr'
+    onp.testing.assert_allclose(csr.asnumpy(), d)
+    # scipy-style component constructor
+    csr2 = sparse.csr_matrix(
+        (csr.data.asnumpy(), csr.indices.asnumpy(), csr.indptr.asnumpy()),
+        shape=(5, 3))
+    onp.testing.assert_allclose(csr2.asnumpy(), d)
+
+
+def test_csr_dot_dense():
+    rng = onp.random.default_rng(0)
+    d = rng.standard_normal((6, 4)).astype('float32')
+    d[d < 0.3] = 0
+    w = rng.standard_normal((4, 2)).astype('float32')
+    csr = sparse.csr_matrix(mx.np.array(d))
+    out = sparse.dot(csr, mx.np.array(w))
+    onp.testing.assert_allclose(out.asnumpy(), d @ w, rtol=1e-5, atol=1e-6)
+
+
+def test_csr_dot_transpose():
+    rng = onp.random.default_rng(1)
+    d = rng.standard_normal((6, 4)).astype('float32')
+    d[abs(d) < 0.5] = 0
+    w = rng.standard_normal((6, 3)).astype('float32')
+    csr = sparse.csr_matrix(mx.np.array(d))
+    out = sparse.dot(csr, mx.np.array(w), transpose_a=True)
+    onp.testing.assert_allclose(out.asnumpy(), d.T @ w, rtol=1e-4, atol=1e-5)
+
+
+def test_retain():
+    rsp = sparse.row_sparse_array(
+        (onp.arange(6, dtype='float32').reshape(3, 2), [1, 3, 5]),
+        shape=(6, 2))
+    kept = sparse.retain(rsp, mx.np.array([1, 5]))
+    onp.testing.assert_array_equal(kept.indices.asnumpy(), [1, 5])
+    onp.testing.assert_allclose(kept.asnumpy()[1], [0, 1])
+    onp.testing.assert_allclose(kept.asnumpy()[5], [4, 5])
+    assert kept.asnumpy()[3].sum() == 0
+
+
+def test_sparse_add():
+    a = sparse.row_sparse_array((onp.ones((1, 2), 'float32'), [0]),
+                                shape=(3, 2))
+    b = sparse.row_sparse_array((onp.ones((2, 2), 'float32'), [0, 2]),
+                                shape=(3, 2))
+    c = sparse.add(a, b)
+    assert c.stype == 'row_sparse'
+    onp.testing.assert_allclose(c.asnumpy(), [[2, 2], [0, 0], [1, 1]])
+
+
+def test_sparse_zeros():
+    z = sparse.zeros('row_sparse', (4, 2))
+    assert z.stype == 'row_sparse' and z.asnumpy().sum() == 0
+    zc = sparse.zeros('csr', (4, 2))
+    assert zc.stype == 'csr' and zc.asnumpy().sum() == 0
+
+
+def test_dense_fallback_ops():
+    """Generic NDArray ops work on sparse inputs via dense fallback
+    (reference exec_utils.h storage-fallback semantics)."""
+    rsp = sparse.row_sparse_array(mx.np.array(_dense_with_zero_rows()))
+    out = (rsp * 2.0).asnumpy()
+    onp.testing.assert_allclose(out, _dense_with_zero_rows() * 2)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kvstore.create('local')
+    rsp = sparse.row_sparse_array(
+        (onp.arange(4, dtype='float32').reshape(2, 2), [1, 3]),
+        shape=(5, 2))
+    kv.init('emb', rsp)
+    pulled = kv.row_sparse_pull('emb', row_ids=mx.np.array([3]))
+    onp.testing.assert_allclose(pulled.asnumpy()[3], [2, 3])
+    assert pulled.asnumpy()[1].sum() == 0
+
+
+def test_kvstore_row_sparse_pull_dense_backing():
+    kv = mx.kvstore.create('local')
+    w = mx.np.array(onp.arange(10, dtype='float32').reshape(5, 2))
+    kv.init('w', w)
+    pulled = kv.row_sparse_pull('w', row_ids=mx.np.array([0, 4]))
+    onp.testing.assert_allclose(pulled.asnumpy()[4], [8, 9])
+    assert pulled.asnumpy()[2].sum() == 0
+
+
+def test_kvstore_sparse_push_updates_components():
+    """Code-review regression: push to a sparse key must refresh
+    .data/.indices so row_sparse_pull sees the new value."""
+    kv = mx.kvstore.create('local')
+    rsp = sparse.row_sparse_array(
+        (onp.ones((2, 2), dtype='float32'), [1, 3]), shape=(5, 2))
+    kv.init('emb', rsp)
+    grad = mx.np.array(onp.full((5, 2), 10.0, dtype='float32'))
+    kv.push('emb', grad)
+    pulled = kv.row_sparse_pull('emb', row_ids=mx.np.array([1]))
+    onp.testing.assert_allclose(pulled.asnumpy()[1], [11, 11])
+
+
+def test_kvstore_row_sparse_pull_list_keys():
+    kv = mx.kvstore.create('local')
+    kv.init('a', mx.np.array(onp.arange(4, dtype='float32').reshape(2, 2)))
+    kv.init('b', mx.np.array(onp.arange(4, 8, dtype='float32').reshape(2, 2)))
+    res = kv.row_sparse_pull(['a', 'b'],
+                             row_ids=[mx.np.array([0]), mx.np.array([1])])
+    onp.testing.assert_allclose(res[0].asnumpy()[0], [0, 1])
+    onp.testing.assert_allclose(res[1].asnumpy()[1], [6, 7])

@@ -196,3 +196,39 @@ def test_symbol_unique_positional_flags():
     x = mx.sym.var('x')
     u = mx.sym.np.unique(x, True)
     assert u.num_outputs == 2
+
+
+def test_flash_causal_more_queries_than_keys_matches_reference():
+    """Code-review regression: T > S causal must agree with the XLA path."""
+    import jax.numpy as jnp
+    rng = onp.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((1, 1, 4, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 1, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 1, 2, 8)), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, interpret=True,
+                          block_q=2, block_k=2)
+    ref = _reference_attention(q.reshape(-1, 4, 8), k.reshape(-1, 2, 8),
+                               v.reshape(-1, 2, 8), 8 ** -0.5,
+                               True).reshape(q.shape)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_mha_dropout_requires_key_and_masks():
+    rng = onp.random.default_rng(8)
+    x = mx.np.array(rng.standard_normal((2, 8, 16)), dtype='float32')
+    with pytest.raises(ValueError, match='key'):
+        mx.npx.multi_head_attention(x, x, x, 4, dropout_p=0.5)
+    import jax
+    out = mx.npx.multi_head_attention(x, x, x, 4, dropout_p=0.5,
+                                      key=jax.random.PRNGKey(0))
+    assert out.shape == (2, 8, 16)
+    base = mx.npx.multi_head_attention(x, x, x, 4)
+    assert abs(out.asnumpy() - base.asnumpy()).max() > 1e-4  # masked
+
+
+def test_bert_classifier_requires_pooler():
+    with pytest.raises(ValueError, match='use_pooler'):
+        bert.BERTModel(vocab_size=10, units=8, hidden_size=16,
+                       num_layers=1, num_heads=2, use_pooler=False,
+                       use_classifier=True)
