@@ -1,0 +1,171 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference implements its data path, allocators, and runtime in C++
+(SURVEY §2.1). The TPU build keeps native code where it pays: the RecordIO
+codec + threaded prefetcher live in ``src_native/recordio.cc`` (the role of
+dmlc-core recordio + src/io/iter_prefetcher.h), compiled on first use with
+the baked-in g++ toolchain and cached beside this package. Pure-Python
+fallbacks exist for every native entry point, so a missing toolchain only
+costs speed.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), 'src_native',
+    'recordio.cc')
+_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    'librecordio.so')
+
+
+def _build():
+    cmd = ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', '-o', _OUT,
+           _SRC, '-lpthread']
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def get_lib():
+    """Load (building if needed) the native library; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_OUT) or (
+                    os.path.exists(_SRC) and
+                    os.path.getmtime(_SRC) > os.path.getmtime(_OUT)):
+                _build()
+            lib = ctypes.CDLL(_OUT)
+        except Exception as e:  # toolchain missing / build failure
+            logging.info('native recordio unavailable (%s); '
+                         'using pure-Python path', e)
+            return None
+        c = ctypes
+        lib.rio_open_reader.restype = c.c_void_p
+        lib.rio_open_reader.argtypes = [c.c_char_p]
+        lib.rio_build_index.restype = c.c_int64
+        lib.rio_build_index.argtypes = [c.c_void_p]
+        lib.rio_num_records.restype = c.c_int64
+        lib.rio_num_records.argtypes = [c.c_void_p]
+        lib.rio_record_length.restype = c.c_int64
+        lib.rio_record_length.argtypes = [c.c_void_p, c.c_int64]
+        lib.rio_read_record.restype = c.c_int64
+        lib.rio_read_record.argtypes = [c.c_void_p, c.c_int64,
+                                        c.c_char_p, c.c_int64]
+        lib.rio_close_reader.argtypes = [c.c_void_p]
+        lib.rio_open_writer.restype = c.c_void_p
+        lib.rio_open_writer.argtypes = [c.c_char_p]
+        lib.rio_write_record.restype = c.c_int64
+        lib.rio_write_record.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        lib.rio_close_writer.argtypes = [c.c_void_p]
+        lib.rio_prefetch_create.restype = c.c_void_p
+        lib.rio_prefetch_create.argtypes = [
+            c.c_void_p, c.POINTER(c.c_int64), c.c_int64, c.c_int32,
+            c.c_int32]
+        lib.rio_prefetch_next.restype = c.c_int64
+        lib.rio_prefetch_next.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                                          c.POINTER(c.c_int64)]
+        lib.rio_prefetch_peek_length.restype = c.c_int64
+        lib.rio_prefetch_peek_length.argtypes = [c.c_void_p]
+        lib.rio_prefetch_destroy.argtypes = [c.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeIndexedReader:
+    """Random-access RecordIO reader over the C++ codec."""
+
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError('native recordio library unavailable')
+        self._lib = lib
+        self._h = lib.rio_open_reader(path.encode())
+        if not self._h:
+            raise IOError(f'cannot open {path}')
+        self._n = lib.rio_build_index(self._h)
+
+    def __len__(self):
+        return self._n
+
+    def read(self, i):
+        n = self._lib.rio_record_length(self._h, i)
+        if n < 0:
+            raise IndexError(i)
+        buf = ctypes.create_string_buffer(n)
+        got = self._lib.rio_read_record(self._h, i, buf, n)
+        if got < 0:
+            raise IOError(f'corrupt record {i}')
+        return buf.raw[:got]
+
+    def prefetch_iter(self, order=None, num_threads=4, capacity=64):
+        """Iterate payloads in ``order`` with background read-ahead
+        (≙ PrefetcherIter double buffering, src/io/iter_prefetcher.h)."""
+        import numpy as np
+        if order is None:
+            order = np.arange(self._n, dtype=np.int64)
+        else:
+            order = np.asarray(order, dtype=np.int64)
+        arr = order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        p = self._lib.rio_prefetch_create(self._h, arr, len(order),
+                                          num_threads, capacity)
+        lib = self._lib
+        try:
+            rec_id = ctypes.c_int64()
+            while True:
+                n = lib.rio_prefetch_peek_length(p)
+                if n < 0:
+                    break
+                buf = ctypes.create_string_buffer(max(n, 1))
+                got = lib.rio_prefetch_next(p, buf, n, ctypes.byref(rec_id))
+                if got < 0:
+                    break
+                yield rec_id.value, buf.raw[:got]
+        finally:
+            lib.rio_prefetch_destroy(p)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_close_reader(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeWriter:
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError('native recordio library unavailable')
+        self._lib = lib
+        self._h = lib.rio_open_writer(path.encode())
+        if not self._h:
+            raise IOError(f'cannot open {path}')
+
+    def write(self, data):
+        if self._lib.rio_write_record(self._h, data, len(data)) < 0:
+            raise IOError('write failed')
+
+    def close(self):
+        if self._h:
+            self._lib.rio_close_writer(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -123,13 +123,26 @@ class RecordFileDataset(Dataset):
     def __init__(self, filename):
         self.idx_file = os.path.splitext(filename)[0] + '.idx'
         self.filename = filename
-        from ...recordio import MXIndexedRecordIO
-        self._record = MXIndexedRecordIO(self.idx_file, self.filename, 'r')
+        self._native = None
+        if not os.path.exists(self.idx_file):
+            # no .idx sidecar: the C++ reader builds the index by scanning
+            # (src_native/recordio.cc, ≙ dmlc InputSplit indexing)
+            from ... import _native
+            if _native.get_lib() is not None:
+                self._native = _native.NativeIndexedReader(filename)
+        if self._native is None:
+            from ...recordio import MXIndexedRecordIO
+            self._record = MXIndexedRecordIO(self.idx_file, self.filename,
+                                             'r')
 
     def __getitem__(self, idx):
+        if self._native is not None:
+            return self._native.read(idx)
         return self._record.read_idx(self._record.keys[idx])
 
     def __len__(self):
+        if self._native is not None:
+            return len(self._native)
         return len(self._record.keys)
 
 
