@@ -38,7 +38,8 @@ _UNARY_NONDIFF = ['isnan', 'isinf', 'isfinite', 'isposinf', 'isneginf',
 
 def _reg_simple(names, nondiff=False, aliases_fn=None):
     for nm in names:
-        fn = getattr(jnp, nm)
+        # jnp.fix is deprecated in favor of the identical jnp.trunc
+        fn = jnp.trunc if nm == 'fix' else getattr(jnp, nm)
         aliases = aliases_fn(nm) if aliases_fn else ()
         register(nm, differentiable=not nondiff, aliases=aliases)(
             _capture(fn))

@@ -7,7 +7,32 @@ parallelism. Built on jax.sharding + pjit/shard_map; collectives ride ICI
 within a slice and DCN across slices.
 """
 
+import os as _os
+
 from .mesh import (MeshConfig, make_mesh, data_parallel_mesh,
                    split_and_load, local_devices)
 from .sharded import shard_params, replicate, make_sharded_train_step
 from . import ring_attention
+
+
+def init_distributed(coordinator=None, num_processes=None, process_id=None):
+    """Join the multi-host SPMD world.
+
+    TPU-native replacement for the reference's ps-lite rendezvous
+    (``DMLC_PS_ROOT_URI``/``DMLC_ROLE`` env protocol, kvstore_dist.h:50-70):
+    every host runs the same script and calls this once; arguments default
+    to the ``MX_COORDINATOR``/``MX_NPROC``/``MX_PROC_ID`` env that
+    ``tools/launch.py`` sets. No-op for single-process runs.
+    """
+    import jax
+
+    coordinator = coordinator or _os.environ.get('MX_COORDINATOR')
+    num_processes = num_processes or int(_os.environ.get('MX_NPROC', '1'))
+    process_id = process_id if process_id is not None else \
+        int(_os.environ.get('MX_PROC_ID', '0'))
+    if num_processes <= 1 or coordinator is None:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True

@@ -1,0 +1,133 @@
+"""Tools suite (reference tools/: im2rec, launch, parse_log, diagnose,
+bandwidth/measure)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, 'tools')
+
+sys.path.insert(0, TOOLS)
+
+
+def _make_image_tree(root, n_per_class=3, classes=('cat', 'dog')):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for cls in classes:
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(n_per_class):
+            arr = rng.randint(0, 255, (48, 64, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f'{cls}_{i}.jpg'))
+
+
+def test_im2rec_roundtrip(tmp_path):
+    import im2rec
+    from mxnet_tpu import recordio
+
+    img_root = tmp_path / 'images'
+    _make_image_tree(str(img_root))
+    prefix = str(tmp_path / 'data')
+    assert im2rec.main([prefix, str(img_root), '--list', '--recursive']) == 0
+    assert os.path.exists(prefix + '.lst')
+    assert im2rec.main([prefix, str(img_root), '--resize', '32',
+                        '--num-thread', '2']) == 0
+    assert os.path.exists(prefix + '.rec')
+    assert os.path.exists(prefix + '.idx')
+
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'r')
+    assert len(rec.keys) == 6
+    labels = set()
+    for k in rec.keys:
+        header, img = recordio.unpack_img(rec.read_idx(k))
+        labels.add(float(header.label))
+        assert img.shape[0] >= 32 and img.shape[1] >= 32
+    rec.close()
+    assert labels == {0.0, 1.0}
+
+
+def test_im2rec_pass_through(tmp_path):
+    import im2rec
+    from mxnet_tpu import recordio
+
+    img_root = tmp_path / 'images'
+    _make_image_tree(str(img_root), n_per_class=2, classes=('a',))
+    prefix = str(tmp_path / 'raw')
+    im2rec.main([prefix, str(img_root), '--list', '--recursive'])
+    im2rec.main([prefix, str(img_root), '--pass-through'])
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'r')
+    header, blob = recordio.unpack(rec.read_idx(rec.keys[0]))
+    assert blob[:2] == b'\xff\xd8'  # JPEG magic: raw bytes, not re-encoded
+    rec.close()
+
+
+def test_parse_log(tmp_path):
+    import parse_log
+
+    log = '\n'.join([
+        'INFO Epoch[0] Batch [20]\tSpeed: 1000.00 samples/sec\taccuracy=0.50',
+        'INFO Epoch[0] Batch [40]\tSpeed: 3000.00 samples/sec\taccuracy=0.60',
+        'INFO Epoch[0] Validation-accuracy=0.700000',
+        'INFO Epoch[1] Batch [20]\tSpeed: 2000.00 samples/sec\taccuracy=0.80',
+    ])
+    epochs = parse_log.parse(log.splitlines())
+    assert epochs[0]['speed'] == [1000.0, 3000.0]
+    assert epochs[0]['train']['accuracy'] == pytest.approx(0.6)
+    assert epochs[0]['val']['accuracy'] == pytest.approx(0.7)
+    csv = parse_log.render(epochs, 'csv')
+    assert csv.splitlines()[1].startswith('0,2000.00')
+    md = parse_log.render(epochs, 'markdown')
+    assert md.count('\n') >= 3
+
+
+def test_launch_local_env_plumbing(tmp_path):
+    out = tmp_path / 'ranks'
+    out.mkdir()
+    script = tmp_path / 'worker.py'
+    script.write_text(
+        'import os\n'
+        'rank = os.environ["MX_PROC_ID"]\n'
+        'open(os.path.join(%r, rank), "w").write(\n'
+        '    os.environ["MX_NPROC"] + " " + os.environ["MX_COORDINATOR"]\n'
+        '    + " " + os.environ["DMLC_WORKER_ID"])\n' % str(out))
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, 'launch.py'), '-n', '3',
+         '--launcher', 'local', '--env', 'FOO=bar', '--',
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    ranks = sorted(os.listdir(out))
+    assert ranks == ['0', '1', '2']
+    body = (out / '1').read_text().split()
+    assert body[0] == '3' and body[2] == '1'
+
+
+def test_diagnose_runs():
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    r = subprocess.run([sys.executable, os.path.join(TOOLS, 'diagnose.py')],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr
+    assert 'Python Info' in r.stdout
+    assert 'mxnet_tpu    : 2.0.0' in r.stdout
+
+
+def test_bandwidth_measure_uniform():
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['XLA_FLAGS'] = (env.get('XLA_FLAGS', '') +
+                        ' --xla_force_host_platform_device_count=4').strip()
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, 'bandwidth', 'measure.py'),
+         '--network', 'uniform', '--size-mb', '4', '--num-keys', '4',
+         '--num-batches', '3', '--kv-store', 'device'],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr
+    import json
+    result = json.loads(r.stdout.strip().splitlines()[-1])
+    assert result['metric'] == 'kvstore_pushpull_bandwidth'
+    assert result['value'] > 0

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Measure KVStore push/pull bandwidth (reference analog:
+``tools/bandwidth/measure.py`` — allreduce bandwidth of model-sized
+gradients through the KVStore).
+
+The TPU path being measured is the jitted XLA allreduce that replaced the
+reference's ps-lite/NCCL transports. Reports per-iteration time and the
+algorithmic bandwidth 2·S·(n-1)/n / t (the standard allreduce cost model)
+over the aggregate gradient bytes of the chosen model.
+
+Usage:
+    python tools/bandwidth/measure.py --network resnet50_v1 --num-batches 10
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import kvstore  # noqa: E402
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description='KVStore bandwidth bench')
+    parser.add_argument('--network', type=str, default='resnet50_v1',
+                        help='model whose gradient sizes to simulate, or '
+                             '"uniform" for --size-mb equal chunks')
+    parser.add_argument('--kv-store', type=str, default='device')
+    parser.add_argument('--num-batches', type=int, default=10)
+    parser.add_argument('--warmup', type=int, default=2)
+    parser.add_argument('--size-mb', type=float, default=100.0,
+                        help='total MB when --network uniform')
+    parser.add_argument('--num-keys', type=int, default=50,
+                        help='key count when --network uniform')
+    parser.add_argument('--disp-batches', type=int, default=1)
+    return parser.parse_args(argv)
+
+
+def grad_shapes(args):
+    if args.network == 'uniform':
+        per = int(args.size_mb * 1e6 / 4 / args.num_keys)
+        return [(per,)] * args.num_keys
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = getattr(vision, args.network)()
+    net.initialize()
+    net(mx.np.ones((1, 3, 224, 224)))
+    return [p.data().shape for p in net.collect_params().values()]
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    shapes = grad_shapes(args)
+    total_bytes = sum(4 * int(np.prod(s)) for s in shapes)
+    import jax
+    n_dev = jax.local_device_count()
+    print(f'{len(shapes)} keys, {total_bytes / 1e6:.1f} MB total, '
+          f'{n_dev} devices, kvstore={args.kv_store}', file=sys.stderr)
+
+    kv = kvstore.create(args.kv_store)
+    rng = np.random.RandomState(0)
+    grads = [mx.np.array(rng.uniform(-1, 1, s).astype('float32'))
+             for s in shapes]
+    for i, g in enumerate(grads):
+        kv.init(i, g)
+
+    times = []
+    for it in range(args.warmup + args.num_batches):
+        outs = [mx.np.zeros(g.shape) for g in grads]
+        for o in outs:
+            o.wait_to_read()
+        t0 = time.perf_counter()
+        for i, g in enumerate(grads):
+            kv.pushpull(i, g, out=outs[i], priority=-i)
+        for o in outs:
+            o.wait_to_read()
+        dt = time.perf_counter() - t0
+        if it >= args.warmup:
+            times.append(dt)
+            if (it - args.warmup) % args.disp_batches == 0:
+                print(f'iter {it - args.warmup}: {dt * 1e3:.2f} ms',
+                      file=sys.stderr)
+
+    mean_t = sum(times) / len(times)
+    # standard allreduce cost model: each byte crosses the link 2(n-1)/n times
+    algbw = 2 * total_bytes * (n_dev - 1) / max(n_dev, 1) / mean_t if n_dev > 1 \
+        else total_bytes / mean_t
+    import json
+    print(json.dumps({'metric': 'kvstore_pushpull_bandwidth',
+                      'value': round(algbw / 1e9, 3), 'unit': 'GB/s',
+                      'mean_ms': round(mean_t * 1e3, 3),
+                      'total_mb': round(total_bytes / 1e6, 1)}))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

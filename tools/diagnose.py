@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Diagnose the environment (reference analog: tools/diagnose.py —
+prints platform, library versions, network checks, env vars).
+
+TPU build: reports Python/OS/numpy/jax versions, visible XLA devices +
+platform, the framework's feature flags (``mx.runtime.Features``), and every
+``MX_*``/``XLA_*``/``JAX_*``/``DMLC_*`` environment variable.
+
+Usage: python tools/diagnose.py
+"""
+
+import os
+import platform
+import sys
+
+
+def check_python():
+    print('----------Python Info----------')
+    print('Version      :', platform.python_version())
+    print('Compiler     :', platform.python_compiler())
+    print('Build        :', platform.python_build())
+
+
+def check_os():
+    print('----------System Info----------')
+    print('Platform     :', platform.platform())
+    print('system       :', platform.system())
+    print('node         :', platform.node())
+    print('release      :', platform.release())
+    print('machine      :', platform.machine())
+    try:
+        print('cpu count    :', os.cpu_count())
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def check_deps():
+    print('----------Library Info----------')
+    import numpy
+    print('numpy        :', numpy.__version__)
+    try:
+        import jax
+        print('jax          :', jax.__version__)
+        import jaxlib
+        print('jaxlib       :', jaxlib.__version__)
+    except ImportError as e:
+        print('jax          : MISSING —', e)
+        return
+    try:
+        devices = jax.devices()
+        print('backend      :', jax.default_backend())
+        print('device count :', jax.device_count(),
+              f'({jax.local_device_count()} local)')
+        for d in devices[:16]:
+            print('  -', d)
+    except Exception as e:  # noqa: BLE001 — no accelerator attached is a finding, not a crash
+        print('devices      : ERROR —', e)
+
+
+def check_framework():
+    print('----------Framework Info----------')
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        import mxnet_tpu as mx
+        print('mxnet_tpu    :', mx.__version__)
+        from mxnet_tpu.runtime import Features
+        feats = Features()
+        enabled = [f for f in feats if feats.is_enabled(f)]
+        print('features     :', ', '.join(sorted(enabled)))
+        from mxnet_tpu._native import get_lib
+        print('native lib   :', 'loaded' if get_lib() is not None else 'absent')
+    except Exception as e:  # noqa: BLE001
+        print('mxnet_tpu    : ERROR —', e)
+
+
+def check_env():
+    print('----------Environment----------')
+    for key in sorted(os.environ):
+        if key.startswith(('MX_', 'MXNET_', 'XLA_', 'JAX_', 'DMLC_',
+                           'TPU_', 'LIBTPU_')):
+            print(f'{key}={os.environ[key]}')
+
+
+def main():
+    check_python()
+    check_os()
+    check_deps()
+    check_framework()
+    check_env()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Pack a directory of images into RecordIO.
+
+Reference analog: ``tools/im2rec.py`` (OpenCV decode; multiprocessing
+read/write workers). TPU build: PIL for decode/resize (no OpenCV in the
+image), a thread pool for encode, and the native C++ RecordIO writer
+(``src_native/recordio.cc``) underneath ``MXIndexedRecordIO``.
+
+Two phases, same CLI shape as the reference:
+    python tools/im2rec.py data/train data/images --list --recursive
+    python tools/im2rec.py data/train data/images --resize 256 --num-thread 8
+"""
+
+import argparse
+import io
+import os
+import random
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) with label = folder index (sorted),
+    matching the reference's labeling rule (im2rec.py:list_image)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                if os.path.splitext(fname)[1].lower() in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            if os.path.isfile(fpath) and \
+                    os.path.splitext(fname)[1].lower() in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, 'w') as f:
+        for item in image_list:
+            line = '%d\t' % item[0]
+            for j in item[2:]:
+                line += '%f\t' % j
+            line += '%s\n' % item[1]
+            f.write(line)
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    image_list = [(it[0], it[1], it[2]) for it in image_list]
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    if n == 0:
+        raise SystemExit(f'no images found under {args.root}')
+    chunks = max(args.chunks, 1)
+    chunk_size = (n + chunks - 1) // chunks
+    for c in range(chunks):
+        chunk = image_list[c * chunk_size:(c + 1) * chunk_size]
+        suffix = '_%d' % c if chunks > 1 else ''
+        sep_train = int(len(chunk) * args.train_ratio)
+        sep_test = int(len(chunk) * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + suffix + '.lst', chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + suffix + '_test.lst',
+                           chunk[:sep_test])
+            write_list(args.prefix + suffix + '_train.lst',
+                       chunk[sep_test:sep_test + sep_train])
+            if sep_test + sep_train < len(chunk):
+                write_list(args.prefix + suffix + '_val.lst',
+                           chunk[sep_test + sep_train:])
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for lineno, line in enumerate(f):
+            parts = line.strip().split('\t')
+            if len(parts) < 3:
+                print(f'lst line {lineno} malformed, skipped', file=sys.stderr)
+                continue
+            idx = int(parts[0])
+            relpath = parts[-1]
+            labels = [float(x) for x in parts[1:-1]]
+            yield (idx, relpath, labels)
+
+
+def encode_item(args, item):
+    """Read one image, resize/crop, return (idx, packed_record or None)."""
+    from PIL import Image
+
+    idx, relpath, labels = item
+    fpath = os.path.join(args.root, relpath)
+    if len(labels) == 1 and not args.pack_label:
+        header = recordio.IRHeader(0, labels[0], idx, 0)
+    else:
+        header = recordio.IRHeader(1, labels, idx, 0)
+    if args.pass_through:
+        try:
+            with open(fpath, 'rb') as f:
+                return idx, recordio.pack(header, f.read())
+        except Exception as e:  # noqa: BLE001 — skip unreadable files like the reference
+            print(f'pack_img error on {fpath}: {e}', file=sys.stderr)
+            return idx, None
+    try:
+        img = Image.open(fpath)
+        if args.color == 0:
+            img = img.convert('L')
+        elif args.color == 1:
+            img = img.convert('RGB')
+        # --color -1: keep the image's own mode (reference IMREAD_UNCHANGED)
+        if args.center_crop:
+            w, h = img.size
+            s = min(w, h)
+            img = img.crop(((w - s) // 2, (h - s) // 2,
+                            (w + s) // 2, (h + s) // 2))
+        if args.resize:
+            w, h = img.size
+            if min(w, h) != args.resize:
+                if w < h:
+                    size = (args.resize, int(h * args.resize / w))
+                else:
+                    size = (int(w * args.resize / h), args.resize)
+                img = img.resize(size, Image.BILINEAR)
+        buf = io.BytesIO()
+        fmt = 'JPEG' if args.encoding == '.jpg' else 'PNG'
+        img.save(buf, format=fmt, quality=args.quality)
+        return idx, recordio.pack(header, buf.getvalue())
+    except Exception as e:  # noqa: BLE001
+        print(f'imread error on {fpath}: {e}', file=sys.stderr)
+        return idx, None
+
+
+def make_rec(args, lst_path):
+    prefix = os.path.splitext(lst_path)[0]
+    record = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    items = list(read_list(lst_path))
+    tic = time.time()
+    count = 0
+    with ThreadPoolExecutor(max_workers=max(args.num_thread, 1)) as pool:
+        for idx, packed in pool.map(lambda it: encode_item(args, it), items):
+            if packed is None:
+                continue
+            record.write_idx(idx, packed)
+            count += 1
+            if count % 1000 == 0:
+                print(f'{count} images packed, '
+                      f'{time.time() - tic:.1f}s', file=sys.stderr)
+    record.close()
+    print(f'wrote {count} records to {prefix}.rec', file=sys.stderr)
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Create an image list and/or pack images into RecordIO.')
+    parser.add_argument('prefix', help='prefix of .lst/.rec output files')
+    parser.add_argument('root', help='folder containing images')
+    cgroup = parser.add_argument_group('list creation')
+    cgroup.add_argument('--list', action='store_true')
+    cgroup.add_argument('--exts', nargs='+',
+                        default=['.jpeg', '.jpg', '.png'])
+    cgroup.add_argument('--chunks', type=int, default=1)
+    cgroup.add_argument('--train-ratio', type=float, default=1.0)
+    cgroup.add_argument('--test-ratio', type=float, default=0)
+    cgroup.add_argument('--recursive', action='store_true')
+    cgroup.add_argument('--no-shuffle', dest='shuffle', action='store_false')
+    rgroup = parser.add_argument_group('record creation')
+    rgroup.add_argument('--pass-through', action='store_true',
+                        help='write raw bytes, skip decode/re-encode')
+    rgroup.add_argument('--resize', type=int, default=0)
+    rgroup.add_argument('--center-crop', action='store_true')
+    rgroup.add_argument('--quality', type=int, default=95)
+    rgroup.add_argument('--num-thread', type=int, default=1)
+    rgroup.add_argument('--color', type=int, default=1, choices=[-1, 0, 1])
+    rgroup.add_argument('--encoding', type=str, default='.jpg',
+                        choices=['.jpg', '.png'])
+    rgroup.add_argument('--pack-label', action='store_true')
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    args.prefix = os.path.abspath(args.prefix)
+    args.root = os.path.abspath(args.root)
+    if args.list:
+        make_list(args)
+        return 0
+    workdir = os.path.dirname(args.prefix)
+    base = os.path.basename(args.prefix)
+    lsts = [os.path.join(workdir, f) for f in os.listdir(workdir)
+            if f.startswith(base) and f.endswith('.lst')]
+    if not lsts:
+        raise SystemExit(f'no .lst file with prefix {args.prefix}; '
+                         'run with --list first')
+    for lst in sorted(lsts):
+        print(f'Creating .rec for {lst}', file=sys.stderr)
+        make_rec(args, lst)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

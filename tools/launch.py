@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Distributed job launcher for the TPU-native framework.
+
+Reference analog: ``tools/launch.py`` (dmlc-tracker: forks scheduler + N
+servers + N workers with ``DMLC_*`` rendezvous env). The TPU design has no
+parameter servers — one JAX process per host joins a single SPMD world via
+``jax.distributed.initialize``, so the launcher's job collapses to:
+
+* ``--launcher local``  — fork N processes on this machine. Each gets
+  ``MX_COORDINATOR/MX_PROC_ID/MX_NPROC`` env (consumed by
+  ``mxnet_tpu.parallel.init_distributed``). With ``--cpu-mesh`` each process
+  additionally simulates ``--cpu-devices`` XLA host devices — the CI pattern
+  from the reference's ``tests/nightly/test_distributed_training-gpu.sh:27-34``
+  (local multi-process cluster on one box).
+* ``--launcher ssh``    — one process per host in ``--hostfile`` (the TPU-pod
+  topology: every TPU VM runs the same script; rendezvous at host 0).
+
+Usage:
+    python tools/launch.py -n 4 --launcher local python train.py
+    python tools/launch.py -H hosts.txt --launcher ssh python train.py
+"""
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Launch a distributed TPU training job.')
+    parser.add_argument('-n', '--num-workers', type=int, default=1,
+                        help='number of worker processes (local launcher)')
+    parser.add_argument('-H', '--hostfile', type=str,
+                        help='hostfile: one host per line (ssh launcher)')
+    parser.add_argument('--launcher', type=str, default='local',
+                        choices=['local', 'ssh'])
+    parser.add_argument('--port', type=int, default=49875,
+                        help='coordinator port on host 0')
+    parser.add_argument('--env', action='append', default=[],
+                        help='KEY=VALUE to propagate to every worker')
+    parser.add_argument('--cpu-mesh', action='store_true',
+                        help='simulate TPU devices with XLA host devices '
+                             '(CI mode, no real chips needed)')
+    parser.add_argument('--cpu-devices', type=int, default=1,
+                        help='host devices per process under --cpu-mesh')
+    parser.add_argument('command', nargs=argparse.REMAINDER,
+                        help='the training command to run')
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error('no command given')
+    if args.command[0] == '--':
+        args.command = args.command[1:]
+    return args
+
+
+def _worker_env(args, proc_id, nproc, coordinator):
+    env = dict(os.environ)
+    for kv in args.env:
+        key, _, value = kv.partition('=')
+        env[key] = value
+    env['MX_COORDINATOR'] = coordinator
+    env['MX_PROC_ID'] = str(proc_id)
+    env['MX_NPROC'] = str(nproc)
+    # Reference-compatible names so ported scripts keep working
+    # (kvstore_dist.h rendezvous used DMLC_* env).
+    env['DMLC_ROLE'] = 'worker'
+    env['DMLC_NUM_WORKER'] = str(nproc)
+    env['DMLC_WORKER_ID'] = str(proc_id)
+    if args.cpu_mesh:
+        flags = env.get('XLA_FLAGS', '')
+        env['XLA_FLAGS'] = (
+            f'{flags} --xla_force_host_platform_device_count='
+            f'{args.cpu_devices}').strip()
+        env['JAX_PLATFORMS'] = 'cpu'
+    return env
+
+
+def _first_failure(codes):
+    """0 if all succeeded, else the first nonzero code (negative = signal)."""
+    return next((c for c in codes if c != 0), 0)
+
+
+def launch_local(args):
+    coordinator = f'127.0.0.1:{args.port}'
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            env = _worker_env(args, rank, args.num_workers, coordinator)
+            procs.append(subprocess.Popen(args.command, env=env))
+        return _first_failure([p.wait() for p in procs])
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        for p in procs:
+            p.wait()
+        return 130
+    except Exception:
+        # a failed spawn must not leave earlier ranks blocked at rendezvous
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait()
+        raise
+
+
+def launch_ssh(args):
+    if not args.hostfile:
+        raise SystemExit('--launcher ssh requires --hostfile')
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip() and not h.startswith('#')]
+    coordinator = f'{hosts[0]}:{args.port}'
+    cmd = ' '.join(shlex.quote(c) for c in args.command)
+    procs = []
+    for rank, host in enumerate(hosts):
+        env = _worker_env(args, rank, len(hosts), coordinator)
+        keys = ['MX_COORDINATOR', 'MX_PROC_ID', 'MX_NPROC',
+                'DMLC_ROLE', 'DMLC_NUM_WORKER', 'DMLC_WORKER_ID']
+        if args.cpu_mesh:
+            keys += ['XLA_FLAGS', 'JAX_PLATFORMS']
+        exports = ' '.join(f'{k}={shlex.quote(env[k])}'
+                           for k in keys if k in env)
+        for kv in args.env:
+            exports += f' {shlex.quote(kv)}'
+        remote = f'cd {shlex.quote(os.getcwd())} && env {exports} {cmd}'
+        procs.append(subprocess.Popen(['ssh', '-o', 'BatchMode=yes',
+                                       host, remote]))
+    return _first_failure([p.wait() for p in procs])
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.launcher == 'local':
+        return launch_local(args)
+    return launch_ssh(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference analog: tools/parse_log.py).
+
+Understands the ``Speedometer``/``LogValidationMetricsCallback`` format
+emitted by ``mxnet_tpu.callback``:
+
+    Epoch[0] Batch [20]   Speed: 3521.12 samples/sec  accuracy=0.91
+    Epoch[0] Validation-accuracy=0.93
+
+Usage: python tools/parse_log.py train.log [--format markdown|csv]
+"""
+
+import argparse
+import re
+import sys
+
+SPEED_RE = re.compile(
+    r'Epoch\[(\d+)\].*?Speed:\s*([\d.]+)\s*samples/sec(.*)')
+TRAIN_METRIC_RE = re.compile(r'(\w[\w-]*)=([\d.eE+-]+)')
+VAL_RE = re.compile(r'Epoch\[(\d+)\]\s*Validation-(\w[\w-]*)=([\d.eE+-]+)')
+
+
+def parse(lines):
+    """Return {epoch: {'speed': [..], 'train': {m: v}, 'val': {m: v}}}."""
+    epochs = {}
+
+    def rec(epoch):
+        return epochs.setdefault(epoch, {'speed': [], 'train': {},
+                                         'val': {}})
+
+    for line in lines:
+        m = SPEED_RE.search(line)
+        if m:
+            epoch, speed, rest = int(m.group(1)), float(m.group(2)), m.group(3)
+            r = rec(epoch)
+            r['speed'].append(speed)
+            for name, value in TRAIN_METRIC_RE.findall(rest):
+                r['train'][name] = float(value)
+            continue
+        m = VAL_RE.search(line)
+        if m:
+            rec(int(m.group(1)))['val'][m.group(2)] = float(m.group(3))
+    return epochs
+
+
+def render(epochs, fmt='markdown'):
+    metrics = sorted({m for r in epochs.values()
+                      for m in list(r['train']) + list(r['val'])})
+    header = ['epoch', 'speed(samples/s)'] + \
+        [f'train-{m}' for m in metrics] + [f'val-{m}' for m in metrics]
+    rows = []
+    for epoch in sorted(epochs):
+        r = epochs[epoch]
+        speed = sum(r['speed']) / len(r['speed']) if r['speed'] else float('nan')
+        row = [str(epoch), f'{speed:.2f}']
+        row += [f"{r['train'].get(m, float('nan')):.6f}" for m in metrics]
+        row += [f"{r['val'].get(m, float('nan')):.6f}" for m in metrics]
+        rows.append(row)
+    if fmt == 'csv':
+        return '\n'.join(','.join(r) for r in [header] + rows)
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+    lines = ['| ' + ' | '.join(h.ljust(w) for h, w in zip(header, widths)) + ' |',
+             '|' + '|'.join('-' * (w + 2) for w in widths) + '|']
+    for r in rows:
+        lines.append('| ' + ' | '.join(c.ljust(w) for c, w in zip(r, widths)) + ' |')
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument('logfile')
+    parser.add_argument('--format', default='markdown',
+                        choices=['markdown', 'csv'])
+    args = parser.parse_args(argv)
+    with open(args.logfile) as f:
+        print(render(parse(f), args.format))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
