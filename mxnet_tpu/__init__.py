@@ -54,6 +54,7 @@ from . import io
 from . import image
 from . import parallel
 from . import amp
+from . import quantization
 from . import test_utils
 from . import util
 from . import callback

@@ -1,0 +1,51 @@
+"""Quantization ops (reference src/operator/quantization/{quantize_v2,
+dequantize,requantize}.cc). Symmetric per-tensor int8; see
+mxnet_tpu/quantization.py for calibration + the net-rewrite pass."""
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def range_to_scale(min_range, max_range, dtype='int8'):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    qmax = 127.0 if dtype == 'int8' else 255.0
+    return jnp.where(amax > 0, amax / qmax, 1.0)
+
+
+@register('quantize_v2', differentiable=False, namespaces=('nd',), n_out=3)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type='int8'):
+    """float → int8/uint8 with calibrated or data-derived ranges; returns
+    (quantized, min_range, max_range). uint8 uses the unsigned [0, max]
+    scheme (post-relu activations) like the reference quantize_v2.cc."""
+    if min_calib_range is None or max_calib_range is None:
+        min_r = jnp.min(data)
+        max_r = jnp.max(data)
+    else:
+        min_r = jnp.asarray(min_calib_range, jnp.float32)
+        max_r = jnp.asarray(max_calib_range, jnp.float32)
+    if out_type in ('int8', 'auto'):
+        scale = range_to_scale(min_r, max_r)
+        q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+    elif out_type == 'uint8':
+        scale = range_to_scale(min_r, max_r, 'uint8')
+        q = jnp.clip(jnp.round(data / scale), 0, 255).astype(jnp.uint8)
+    else:
+        raise ValueError(f'unsupported out_type {out_type!r}')
+    return q, min_r, max_r
+
+
+@register('dequantize', differentiable=False, namespaces=('nd',))
+def dequantize(data, min_range, max_range, out_type='float32'):
+    qtype = 'uint8' if data.dtype == jnp.uint8 else 'int8'
+    scale = range_to_scale(min_range, max_range, qtype)
+    return data.astype(jnp.float32) * scale
+
+
+@register('requantize', differentiable=False, namespaces=('nd',), n_out=3)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator → int8 under the (possibly calibrated) range."""
+    real = dequantize(data, min_range, max_range)
+    return quantize_v2(real, min_calib_range, max_calib_range)

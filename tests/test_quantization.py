@@ -1,0 +1,171 @@
+"""INT8 PTQ (reference src/operator/quantization/ + calibrate.cc +
+quantize_graph_pass.cc; python test model: test_quantization.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import quantization
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.np.array(np.random.RandomState(0).uniform(-3, 5, (4, 16))
+                    .astype('float32'))
+    q, lo, hi = mx.nd.quantize_v2(x)
+    assert q.dtype == np.int8
+    back = mx.nd.dequantize(q, lo, hi)
+    # symmetric int8: max error is one quantization step
+    step = max(abs(float(lo.asnumpy())), abs(float(hi.asnumpy()))) / 127
+    assert np.max(np.abs(back.asnumpy() - x.asnumpy())) <= step + 1e-6
+
+
+def test_quantize_with_calib_range():
+    x = mx.np.array(np.array([[-10.0, 0.5, 9.0]], dtype='float32'))
+    q, lo, hi = mx.nd.quantize_v2(x, min_calib_range=-1.0,
+                                  max_calib_range=1.0)
+    # out-of-range values saturate
+    qn = q.asnumpy()
+    assert qn[0, 0] == -127 and qn[0, 2] == 127
+
+
+def test_requantize():
+    acc = mx.np.array(np.array([[1000, -2000, 30000]], dtype='int32'))
+    q, lo, hi = mx.nd.requantize(acc, mx.np.array(-40000.0),
+                                 mx.np.array(40000.0),
+                                 min_calib_range=-10.0,
+                                 max_calib_range=10.0)
+    assert q.dtype == np.int8
+
+
+def _collector_for(data):
+    c = quantization._HistogramCollector()
+    c.collect(data)
+    return c
+
+
+def test_calibration_modes():
+    rng = np.random.RandomState(1)
+    data = rng.normal(0, 1, 20000).astype('float32')
+    data[0] = 40.0  # one huge outlier
+    c = _collector_for(data)
+    lo_n, hi_n = c.naive()
+    assert hi_n == pytest.approx(40.0)
+    lo_p, hi_p = c.percentile(99.9)
+    assert hi_p < 10.0  # percentile clips the outlier
+    lo_e, hi_e = c.entropy()
+    assert 0 < hi_e < 40.0  # entropy threshold clips it too
+
+
+def test_quantized_dense_accuracy():
+    rng = np.random.RandomState(2)
+    net = nn.Dense(8, in_units=16)
+    net.initialize()
+    x = mx.np.array(rng.uniform(-1, 1, (32, 16)).astype('float32'))
+    ref = net(x).asnumpy()
+    qnet = quantization.quantize_net(net, calib_data=[x],
+                                     calib_mode='naive')
+    assert isinstance(qnet, quantization.QuantizedDense)  # root swap
+    out = qnet(x).asnumpy()
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() / scale < 0.05
+
+
+def test_quantize_hybridized_net():
+    rng = np.random.RandomState(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8))
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(rng.uniform(-1, 1, (4, 8)).astype('float32'))
+    ref = net(x).asnumpy()  # warm the compiled cache
+    qnet = quantization.quantize_net(net, calib_data=[x],
+                                     calib_mode='naive')
+    out = qnet(x).asnumpy()
+    assert isinstance(list(qnet._children.values())[0],
+                      quantization.QuantizedDense)
+    assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.05
+
+
+def test_quantize_uint8():
+    x = mx.np.array(np.array([[0.0, 0.5, 1.0, 2.0]], dtype='float32'))
+    q, lo, hi = mx.nd.quantize_v2(x, min_calib_range=0.0,
+                                  max_calib_range=1.0, out_type='uint8')
+    assert q.dtype == np.uint8
+    qn = q.asnumpy()
+    assert qn[0, 3] == 255  # saturates
+    back = mx.nd.dequantize(q, lo, hi).asnumpy()
+    assert abs(back[0, 1] - 0.5) < 1 / 255 + 1e-6
+    with pytest.raises(ValueError):
+        mx.nd.quantize_v2(x, out_type='int4')
+
+
+def test_unexercised_layer_stays_float():
+    class Gated(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.main = nn.Dense(4, in_units=4)
+            self.aux = nn.Dense(4, in_units=4)  # never called
+
+        def forward(self, x):
+            return self.main(x)
+
+    net = Gated()
+    net.initialize()
+    x = mx.np.ones((2, 4))
+    quantization.quantize_net(net, calib_data=[x], calib_mode='naive')
+    assert isinstance(net.main, quantization.QuantizedDense)
+    assert isinstance(net.aux, nn.Dense)  # left in float, no KeyError
+
+
+def test_quantize_net_mlp_swaps_layers():
+    rng = np.random.RandomState(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation='relu', in_units=20))
+    net.add(nn.Dense(10, in_units=32))
+    net.initialize()
+    calib = [mx.np.array(rng.uniform(-1, 1, (16, 20)).astype('float32'))
+             for _ in range(4)]
+    ref = net(calib[0]).asnumpy()
+    quantization.quantize_net(net, calib_data=calib, calib_mode='entropy')
+    flat = []
+
+    def walk(b):
+        for ch in b._children.values():
+            flat.append(ch)
+            walk(ch)
+    walk(net)
+    assert any(isinstance(b, quantization.QuantizedDense) for b in flat)
+    out = net(calib[0]).asnumpy()
+    assert np.argmax(out, 1).tolist() == np.argmax(ref, 1).tolist() or \
+        np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.1
+
+
+def test_quantized_conv_accuracy():
+    rng = np.random.RandomState(4)
+    x = mx.np.array(rng.uniform(-1, 1, (2, 4, 8, 8)).astype('float32'))
+    seq = nn.HybridSequential()
+    conv = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=4)
+    seq.add(conv)
+    seq.initialize()
+    ref = seq(x).asnumpy()
+    quantization.quantize_net(seq, calib_data=[x], calib_mode='naive')
+    assert isinstance(list(seq._children.values())[0],
+                      quantization.QuantizedConv2D)
+    out = seq(x).asnumpy()
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() / scale < 0.05
+
+
+def test_exclude_layers():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4))
+    net.add(nn.Dense(2, in_units=8))
+    net.initialize()
+    x = mx.np.ones((2, 4))
+    quantization.quantize_net(net, calib_data=[x], calib_mode='naive',
+                              exclude_layers=['0'])
+    kids = list(net._children.values())
+    assert not isinstance(kids[0], quantization.QuantizedDense)
+    assert isinstance(kids[1], quantization.QuantizedDense)
