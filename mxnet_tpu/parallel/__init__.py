@@ -13,6 +13,10 @@ from .mesh import (MeshConfig, make_mesh, data_parallel_mesh,
                    split_and_load, local_devices)
 from .sharded import shard_params, replicate, make_sharded_train_step
 from . import ring_attention
+from . import pipeline
+from . import moe
+from .pipeline import pipeline_apply, stack_stage_params
+from .moe import moe_ffn
 
 
 def init_distributed(coordinator=None, num_processes=None, process_id=None):

@@ -28,6 +28,19 @@ def local_devices():
     return jax.local_devices()
 
 
+def _shard_map(**kw):
+    """jax.shard_map across versions (0.8 renamed check_rep→check_vma).
+
+    Replication checking stays off: our kernels produce replicated outputs
+    by explicit masked-psum, which the checker can't see through.
+    """
+    import functools as _ft
+    if hasattr(jax, 'shard_map'):
+        return _ft.partial(jax.shard_map, check_vma=False, **kw)
+    from jax.experimental.shard_map import shard_map  # pragma: no cover
+    return _ft.partial(shard_map, check_rep=False, **kw)
+
+
 def make_mesh(config=None, devices=None, **axes):
     """Build a jax Mesh from axis sizes, e.g. make_mesh(dp=2, tp=4)."""
     if config is not None:

@@ -1,0 +1,124 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+NEW capability over the reference (SURVEY §2.3: EP absent in MXNet).
+TPU-native design (Switch/GShard lineage): tokens are routed by a learned
+gate, dispatched into fixed-capacity expert slots with one-hot einsums
+(static shapes — XLA/MXU friendly, no scatter), exchanged between devices
+with ``lax.all_to_all`` over the expert axis (ICI), run through the local
+experts as one batched matmul, and combined back with the gate weights.
+
+Everything is differentiable; the router uses the standard load-balancing
+auxiliary loss (Shazeer et al.) returned alongside the output.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+from .mesh import _shard_map
+
+
+def top2_gating(logits, capacity, key=None, noise_std=0.0):
+    """Top-2 token routing with fixed expert capacity.
+
+    logits: (T, E). Returns (dispatch (T, E, C) one-hot, combine (T, E, C)
+    weights, aux_loss scalar).
+    """
+    T, E = logits.shape
+    if noise_std and key is not None:
+        logits = logits + noise_std * jax.random.normal(key, logits.shape)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    gate1 = jnp.argmax(probs, axis=-1)                       # (T,)
+    mask1 = jax.nn.one_hot(gate1, E, dtype=probs.dtype)
+    probs2 = probs * (1.0 - mask1)
+    gate2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(gate2, E, dtype=probs.dtype)
+
+    # load-balancing aux loss: E * sum_e (frac tokens to e) * (mean prob e)
+    density = mask1.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux_loss = E * jnp.sum(density * density_proxy)
+
+    # positions within each expert's buffer, first-come-first-served
+    pos1 = (jnp.cumsum(mask1, axis=0) - mask1)               # (T, E)
+    mask1 = mask1 * (pos1 < capacity)
+    pos2 = (jnp.cumsum(mask2, axis=0) - mask2) + mask1.sum(0, keepdims=True)
+    mask2 = mask2 * (pos2 < capacity)
+
+    w1 = (probs * mask1).sum(-1)                             # (T,)
+    w2 = (probs * mask2).sum(-1)
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    cap1 = jax.nn.one_hot((pos1 * mask1).sum(-1).astype(jnp.int32),
+                          capacity, dtype=probs.dtype)
+    cap2 = jax.nn.one_hot((pos2 * mask2).sum(-1).astype(jnp.int32),
+                          capacity, dtype=probs.dtype)
+    dispatch = (mask1[..., None] * cap1[:, None, :] +
+                mask2[..., None] * cap2[:, None, :])         # (T, E, C)
+    combine = (w1[:, None, None] * mask1[..., None] * cap1[:, None, :] +
+               w2[:, None, None] * mask2[..., None] * cap2[:, None, :])
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn_kernel(x, wg, w_in, w_out, axis_name, n_experts,
+                   capacity_factor=1.25, activation=jax.nn.gelu):
+    """Per-device MoE FFN body — call inside shard_map over ``axis_name``.
+
+    x: (T_local, D) this device's token shard.
+    wg: (D, E) router (replicated).
+    w_in: (E_local, D, F), w_out: (E_local, F, D) local expert weights.
+    Returns (y (T_local, D), aux_loss).
+    """
+    ep = lax.psum(1, axis_name) if not isinstance(axis_name, str) else \
+        lax.axis_size(axis_name)
+    T, D = x.shape
+    E = n_experts
+    C = int(capacity_factor * T * 2 / E) + 1  # top-2 → 2 slots per token
+
+    logits = x @ wg                                          # (T, E)
+    dispatch, combine, aux = top2_gating(logits, C)
+
+    # (T, E, C) x (T, D) -> (E, C, D): gather tokens into expert slots
+    slots = jnp.einsum('tec,td->ecd', dispatch, x)
+    # exchange: every device sends each expert-shard its slots.
+    # (E, C, D) -> (ep, E_local, C, D) -> a2a -> (ep, E_local, C, D)
+    slots = slots.reshape(ep, E // ep, C, D)
+    slots = lax.all_to_all(slots, axis_name, split_axis=0, concat_axis=0,
+                           tiled=False)
+    # local experts see (E_local, ep * C, D)
+    slots = slots.transpose(1, 0, 2, 3).reshape(E // ep, ep * C, D)
+    h = activation(jnp.einsum('ecd,edf->ecf', slots, w_in))
+    y = jnp.einsum('ecf,efd->ecd', h, w_out)                 # (E_l, ep*C, D)
+    # send results back to the token owners
+    y = y.reshape(E // ep, ep, C, D).transpose(1, 0, 2, 3)
+    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                       tiled=False)
+    y = y.reshape(E, C, D)
+    out = jnp.einsum('tec,ecd->td', combine, y)
+    return out, lax.pmean(aux, axis_name)
+
+
+def moe_ffn(x, wg, w_in, w_out, mesh, axis_name='ep',
+            capacity_factor=1.25, activation=jax.nn.gelu):
+    """Expert-parallel MoE feed-forward over a token-sharded batch.
+
+    x: (T, D) tokens, sharded over ``axis_name``. w_in/w_out: (E, D, F) /
+    (E, F, D) expert weights, expert dim sharded over ``axis_name``.
+    Returns (y (T, D) same sharding as x, load-balancing aux loss).
+    """
+    E = w_in.shape[0]
+    fn = _shard_map()(
+        functools.partial(moe_ffn_kernel, axis_name=axis_name,
+                          n_experts=E, capacity_factor=capacity_factor,
+                          activation=activation),
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P(), P(axis_name, None, None),
+                  P(axis_name, None, None)),
+        out_specs=(P(axis_name, None), P()))
+    return fn(x, wg, w_in, w_out)

@@ -1,0 +1,104 @@
+"""Pipeline parallelism: GPipe-style microbatched schedule over a 'pp' axis.
+
+NEW capability over the reference (SURVEY §2.3: PP absent in MXNet — its
+async engine gives only *implicit* cross-device pipelining). TPU-native
+design: every pipeline stage runs the SAME program (SPMD), stage weights
+are stacked along a leading axis sharded over mesh axis 'pp', and
+activations flow stage-to-stage with ``lax.ppermute`` (neighbor ICI hop).
+The fill/drain schedule is a ``lax.scan`` over ``n_micro + n_stages - 1``
+ticks, so the whole pipeline is ONE XLA program — no host round-trips
+between microbatches, and reverse-mode AD through the scan + ppermute gives
+the backward pipeline for free.
+
+Constraints (standard for collective pipelining): every stage maps
+activations of one fixed shape/dtype to the same shape/dtype (true for
+transformer blocks), and the number of microbatches is static.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _shift_right(x, axis_name, axis_size):
+    """Send this device's value to the next pipeline stage (ring hop)."""
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def pipeline_kernel(stage_fn, params, xs, axis_name, axis_size,
+                    extra=None):
+    """Per-device GPipe schedule body — call inside shard_map.
+
+    ``params``: this stage's weights (leading stage axis already sliced
+    away by the shard_map in_spec, i.e. leaves have a leading dim of 1
+    which is squeezed here).
+    ``xs``: (n_micro, mb, ...) microbatched inputs, identical on every
+    stage (replicated in_spec).
+    Returns (n_micro, mb, ...) stage-``axis_size - 1`` outputs, replicated
+    to every device via a masked psum so the loss can be computed SPMD.
+    """
+    params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+    idx = lax.axis_index(axis_name)
+    n_micro = xs.shape[0]
+    last = axis_size - 1
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 pulls microbatch t from the feed; later stages consume
+        # the activation ppermuted from their predecessor.
+        feed = lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        x_in = jnp.where(idx == 0, feed, buf)
+        y = stage_fn(params, x_in) if extra is None else \
+            stage_fn(params, x_in, extra)
+        # the last stage retires microbatch t - (n_stages - 1) at tick t.
+        w = t - last
+        wc = jnp.clip(w, 0, n_micro - 1)
+        cur = lax.dynamic_index_in_dim(outs, wc, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(w >= 0, y, cur), wc, 0)
+        buf = _shift_right(y, axis_name, axis_size)
+        return (buf, outs), None
+
+    buf0 = jnp.zeros(xs.shape[1:], xs.dtype)
+    outs0 = jnp.zeros(xs.shape, xs.dtype)
+    (_, outs), _ = lax.scan(tick, (buf0, outs0),
+                            jnp.arange(n_micro + last))
+    # only the last stage holds real outputs; replicate across 'pp'.
+    outs = jnp.where(idx == last, outs, jnp.zeros_like(outs))
+    return lax.psum(outs, axis_name)
+
+
+def pipeline_apply(stage_fn, stage_params, xs, mesh, axis_name='pp'):
+    """Run ``n_stages`` copies of ``stage_fn`` as a GPipe pipeline.
+
+    ``stage_fn(params, x) -> y`` — one stage, shape-preserving.
+    ``stage_params`` — pytree whose leaves have leading dim ``n_stages``
+    (stage i's weights), placed/sharded over mesh axis ``axis_name``.
+    ``xs`` — (n_micro, microbatch, ...) inputs, replicated.
+
+    Returns (n_micro, microbatch, ...) outputs, replicated over ``pp``.
+    Differentiable: ``jax.grad`` through this builds the 1F1B-equivalent
+    backward sweep from the scan transpose.
+    """
+    from .mesh import _shard_map
+
+    axis_size = mesh.shape[axis_name]
+    pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    fn = _shard_map()(
+        functools.partial(pipeline_kernel, stage_fn,
+                          axis_name=axis_name, axis_size=axis_size),
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P())
+    return fn(stage_params, xs)
+
+
+def stack_stage_params(param_list):
+    """Stack a list of per-stage param pytrees along a new leading axis
+    (the 'pp'-sharded stage axis)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)

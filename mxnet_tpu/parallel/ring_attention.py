@@ -89,7 +89,7 @@ def ring_attention(q, k, v, mesh, axis_name='sp', causal=False, spec=None):
     data and tensor parallelism in one mesh — those axes are plain local
     blocks inside the kernel; only ``axis_name`` participates in the ring.
     """
-    from jax.experimental.shard_map import shard_map
+    from .mesh import _shard_map
 
     if spec is None:
         spec = P(None, None, axis_name, None)
@@ -107,9 +107,8 @@ def ring_attention(q, k, v, mesh, axis_name='sp', causal=False, spec=None):
             raise ValueError(
                 f'spec must shard the sequence dim (dim 2) over '
                 f'{axis_name!r} and leave head_dim unsharded, got {spec}')
-    fn = shard_map(
+    fn = _shard_map()(
         functools.partial(ring_attention_kernel, axis_name=axis_name,
                           causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -110,3 +110,111 @@ def test_clip_global_norm():
     total = clip_global_norm(arrs, 1.0)
     assert total == pytest.approx(5.0)
     assert_almost_equal(arrs[0], [0.6, 0.8], rtol=1e-4)
+
+
+def test_pipeline_matches_sequential():
+    """GPipe pipeline over 'pp' == running the stages sequentially."""
+    from mxnet_tpu.parallel.pipeline import (pipeline_apply,
+                                             stack_stage_params)
+    np.random.seed(1)
+    n_stages, n_micro, mb, D = 4, 6, 3, 8
+    mesh = parallel.make_mesh(pp=n_stages)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p['w'] + p['b'])
+
+    stages = [{'w': jnp.asarray(np.random.randn(D, D).astype('f') * 0.3),
+               'b': jnp.zeros((D,), 'float32')} for _ in range(n_stages)]
+    params = stack_stage_params(stages)
+    xs = jnp.asarray(np.random.randn(n_micro, mb, D).astype('f'))
+
+    out = pipeline_apply(stage_fn, params, xs, mesh)
+    want = xs
+    for p in stages:
+        want = stage_fn(p, want)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_grad():
+    """Reverse-mode AD through the pipeline schedule (backward pipeline)."""
+    from mxnet_tpu.parallel.pipeline import (pipeline_apply,
+                                             stack_stage_params)
+    np.random.seed(2)
+    n_stages, n_micro, mb, D = 2, 4, 2, 4
+    mesh = parallel.make_mesh(pp=n_stages)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p['w'])
+
+    stages = [{'w': jnp.asarray(np.random.randn(D, D).astype('f') * 0.5)}
+              for _ in range(n_stages)]
+    params = stack_stage_params(stages)
+    xs = jnp.asarray(np.random.randn(n_micro, mb, D).astype('f'))
+
+    def loss_pipe(p):
+        return jnp.sum(pipeline_apply(stage_fn, p, xs, mesh) ** 2)
+
+    def loss_seq(ps):
+        h = xs
+        for p in ps:
+            h = stage_fn(p, h)
+        return jnp.sum(h ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(params)
+    g_seq = jax.grad(loss_seq)(stages)
+    for i in range(n_stages):
+        np.testing.assert_allclose(np.asarray(g_pipe['w'][i]),
+                                   np.asarray(g_seq[i]['w']),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_moe_expert_parallel():
+    """Expert-parallel MoE: runs, preserves shape, routes to experts, and
+    matches the single-device (ep=1) result."""
+    from mxnet_tpu.parallel.moe import moe_ffn
+    np.random.seed(3)
+    T, D, F, E = 64, 8, 16, 8
+    x = jnp.asarray(np.random.randn(T, D).astype('f'))
+    wg = jnp.asarray(np.random.randn(D, E).astype('f') * 0.1)
+    w_in = jnp.asarray(np.random.randn(E, D, F).astype('f') * 0.2)
+    w_out = jnp.asarray(np.random.randn(E, F, D).astype('f') * 0.2)
+
+    mesh = parallel.make_mesh(ep=8)
+    y, aux = moe_ffn(x, wg, w_in, w_out, mesh)
+    assert y.shape == (T, D)
+    assert np.isfinite(np.asarray(y)).all()
+    assert float(aux) > 0
+
+    # cross-device reference: each ep shard routes its own T/ep tokens
+    # independently with capacity computed from the local count, so the
+    # ep=8 output must equal running each token shard through a 1-device
+    # mesh — this pins the all_to_all dispatch/return round trip.
+    mesh1 = parallel.make_mesh(ep=1, devices=jax.devices()[:1])
+    shards = []
+    for i in range(8):
+        xi = x[i * (T // 8):(i + 1) * (T // 8)]
+        yi, _ = moe_ffn(xi, wg, w_in, w_out, mesh1)
+        shards.append(np.asarray(yi))
+    np.testing.assert_allclose(np.asarray(y), np.concatenate(shards),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_moe_grad_finite():
+    from mxnet_tpu.parallel.moe import moe_ffn
+    np.random.seed(4)
+    T, D, F, E = 32, 4, 8, 4
+    mesh = parallel.make_mesh(ep=4)
+    x = jnp.asarray(np.random.randn(T, D).astype('f'))
+    wg = jnp.asarray(np.random.randn(D, E).astype('f') * 0.1)
+    w_in = jnp.asarray(np.random.randn(E, D, F).astype('f') * 0.2)
+    w_out = jnp.asarray(np.random.randn(E, F, D).astype('f') * 0.2)
+
+    def loss(w_in, w_out, wg):
+        y, aux = moe_ffn(x, wg, w_in, w_out, mesh)
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(w_in, w_out, wg)
+    for gi in g:
+        assert np.isfinite(np.asarray(gi)).all()
+        assert float(jnp.abs(gi).sum()) > 0
