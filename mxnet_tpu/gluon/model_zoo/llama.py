@@ -1,0 +1,262 @@
+"""Llama model family (decoder-only causal LM).
+
+NEW capability over the reference (its model zoo is vision-only,
+python/mxnet/gluon/model_zoo/vision/, and its longest-sequence asset is the
+single-device fused attention ops, src/operator/contrib/transformer.cc:650).
+This is the long-context flagship of the TPU build:
+
+* pre-norm blocks with RMSNorm (``npx.rms_norm``), rotary position
+  embeddings, grouped-query attention and SwiGLU MLP — the Llama-2/3
+  architecture family;
+* attention runs through the Pallas flash kernel
+  (ops/pallas/flash_attention.py) — causal, no materialized score matrix;
+* ``llama_partition_rules()`` gives Megatron-style PartitionSpecs for
+  ``mx.parallel.shard_params`` so the same Block trains tensor-parallel
+  over a mesh 'tp' axis, and sequence-parallel via
+  ``mx.parallel.ring_attention`` at the SPMD layer;
+* everything is a HybridBlock: one ``hybridize()`` compiles the whole
+  decoder into a single XLA executable.
+"""
+
+import math
+
+from jax.sharding import PartitionSpec as P
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .. import nn
+from ... import initializer
+
+__all__ = ['LlamaConfig', 'LlamaModel', 'LlamaForCausalLM', 'llama_tiny',
+           'llama2_7b', 'llama3_8b', 'get_llama', 'llama_partition_rules']
+
+
+class LlamaConfig:
+    """Architecture hyperparameters. ``rope_theta`` is 1e4 for Llama-2
+    lineage, 5e5 for Llama-3 (long-context)."""
+
+    def __init__(self, vocab_size=32000, units=4096, num_layers=32,
+                 num_heads=32, num_kv_heads=None, hidden_size=11008,
+                 max_length=4096, rope_theta=10000.0, rms_norm_eps=1e-5,
+                 tie_word_embeddings=False):
+        self.vocab_size = vocab_size
+        self.units = units
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.hidden_size = hidden_size
+        self.max_length = max_length
+        self.rope_theta = rope_theta
+        self.rms_norm_eps = rms_norm_eps
+        self.tie_word_embeddings = tie_word_embeddings
+        assert units % num_heads == 0
+        assert self.num_heads % self.num_kv_heads == 0
+
+
+class RMSNorm(HybridBlock):
+    """Root-mean-square norm (no mean subtraction, no bias)."""
+
+    def __init__(self, units, epsilon=1e-5):
+        super().__init__()
+        self._eps = epsilon
+        self.weight = Parameter('weight', shape=(units,),
+                                init=initializer.One())
+
+    def forward(self, x):
+        from ... import npx
+        return npx.rms_norm(x, self.weight.data(), eps=self._eps)
+
+
+def _rope(x, theta, offset=0):
+    """Apply rotary position embeddings to (B, S, H, Dh) — interleaved
+    even/odd-pair convention (NOT HuggingFace's rotate-half: converting HF
+    checkpoints requires their q/k weight permutation). Pure function of
+    shape: folds into the jit as constants."""
+    import jax.numpy as jnp
+    _, S, _, Dh = x.shape
+    inv = 1.0 / (theta ** (jnp.arange(0, Dh, 2, dtype=jnp.float32) / Dh))
+    pos = jnp.arange(offset, offset + S, dtype=jnp.float32)
+    ang = pos[:, None] * inv[None, :]                  # (S, Dh/2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    xf1 = x1.astype(jnp.float32)
+    xf2 = x2.astype(jnp.float32)
+    r1 = xf1 * cos - xf2 * sin
+    r2 = xf2 * cos + xf1 * sin
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(HybridBlock):
+    """Grouped-query attention with RoPE; causal flash kernel.
+
+    num_kv_heads < num_heads shares each K/V head across a group of Q
+    heads (the Llama-2-70B / Llama-3 memory-bandwidth optimization); KV
+    heads are broadcast to the full head count right before the kernel —
+    XLA keeps the broadcast virtual."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self._h = cfg.num_heads
+        self._kv = cfg.num_kv_heads
+        self._dh = cfg.units // cfg.num_heads
+        self._theta = cfg.rope_theta
+        self.q_proj = nn.Dense(self._h * self._dh, use_bias=False,
+                               flatten=False)
+        self.k_proj = nn.Dense(self._kv * self._dh, use_bias=False,
+                               flatten=False)
+        self.v_proj = nn.Dense(self._kv * self._dh, use_bias=False,
+                               flatten=False)
+        self.o_proj = nn.Dense(cfg.units, use_bias=False, flatten=False)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ...ndarray.ndarray import NDArray
+        from ...ops.pallas.flash_attention import flash_attention
+
+        B, S, _ = x.shape
+        q = self.q_proj(x)._data.reshape(B, S, self._h, self._dh)
+        k = self.k_proj(x)._data.reshape(B, S, self._kv, self._dh)
+        v = self.v_proj(x)._data.reshape(B, S, self._kv, self._dh)
+        q = _rope(q, self._theta)
+        k = _rope(k, self._theta)
+        if self._kv != self._h:
+            rep = self._h // self._kv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        out = flash_attention(q.transpose(0, 2, 1, 3),
+                              k.transpose(0, 2, 1, 3),
+                              v.transpose(0, 2, 1, 3), causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, self._h * self._dh)
+        return self.o_proj(NDArray(out))
+
+
+class LlamaMLP(HybridBlock):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.gate_proj = nn.Dense(cfg.hidden_size, use_bias=False,
+                                  flatten=False)
+        self.up_proj = nn.Dense(cfg.hidden_size, use_bias=False,
+                                flatten=False)
+        self.down_proj = nn.Dense(cfg.units, use_bias=False, flatten=False)
+
+    def forward(self, x):
+        from ... import npx
+        return self.down_proj(npx.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaBlock(HybridBlock):
+    """Pre-norm decoder block."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.input_layernorm = RMSNorm(cfg.units, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.units, cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(HybridBlock):
+    """Token embedding + decoder stack + final norm → hidden states."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.units)
+        self.layers = []
+        for i in range(cfg.num_layers):
+            blk = LlamaBlock(cfg)
+            self.register_child(blk, f'layers{i}')
+            self.layers.append(blk)
+        self.norm = RMSNorm(cfg.units, cfg.rms_norm_eps)
+
+    def forward(self, token_ids):
+        x = self.embed_tokens(token_ids)
+        for blk in self.layers:
+            x = blk(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(HybridBlock):
+    """Decoder LM head: (B, S) int tokens → (B, S, vocab) logits."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                    flatten=False)
+
+    def forward(self, token_ids):
+        from ... import np as mnp
+        h = self.model(token_ids)
+        if self.cfg.tie_word_embeddings:
+            emb = self.model.embed_tokens.weight.data()
+            return mnp.matmul(h, emb.T)
+        return self.lm_head(h)
+
+
+def llama_partition_rules(axis='tp'):
+    """(predicate, PartitionSpec) rules for ``mx.parallel.shard_params``:
+    Megatron layout — q/k/v/gate/up sharded on the output (head) dim,
+    o/down on the input dim, embeddings on the vocab dim, norms replicated.
+    gluon Dense stores weight as (units_out, units_in), so the output dim
+    is axis 0."""
+    def col(name, shape):   # output-dim (column-parallel) kernels
+        return any(t in name for t in
+                   ('q_proj', 'k_proj', 'v_proj', 'gate_proj', 'up_proj'))
+
+    def row(name, shape):   # input-dim (row-parallel) kernels
+        return any(t in name for t in ('o_proj', 'down_proj'))
+
+    def embed(name, shape):
+        return 'embed_tokens' in name or 'lm_head' in name
+
+    return [
+        (col, P(axis, None)),
+        (row, P(None, axis)),
+        (embed, P(axis, None)),
+    ]
+
+
+_LLAMA_CONFIGS = {
+    # test-scale config (CI, unit tests)
+    'llama_tiny': dict(vocab_size=256, units=64, num_layers=2, num_heads=4,
+                       num_kv_heads=2, hidden_size=128, max_length=128,
+                       rope_theta=10000.0),
+    'llama2_7b': dict(vocab_size=32000, units=4096, num_layers=32,
+                      num_heads=32, num_kv_heads=32, hidden_size=11008,
+                      max_length=4096, rope_theta=10000.0),
+    'llama3_8b': dict(vocab_size=128256, units=4096, num_layers=32,
+                      num_heads=32, num_kv_heads=8, hidden_size=14336,
+                      max_length=8192, rope_theta=500000.0),
+}
+
+
+def get_llama(name, **kwargs):
+    cfg = dict(_LLAMA_CONFIGS[name])
+    cfg.update(kwargs)
+    return LlamaForCausalLM(LlamaConfig(**cfg))
+
+
+def llama_tiny(**kwargs):
+    """2-layer test-scale Llama (unit tests / smoke runs)."""
+    return get_llama('llama_tiny', **kwargs)
+
+
+def llama2_7b(**kwargs):
+    """Llama-2-7B shapes."""
+    return get_llama('llama2_7b', **kwargs)
+
+
+def llama3_8b(**kwargs):
+    """Llama-3-8B shapes (GQA 32/8, 500k rope theta)."""
+    return get_llama('llama3_8b', **kwargs)

@@ -22,15 +22,13 @@ from jax.sharding import PartitionSpec as P
 from .mesh import _shard_map
 
 
-def top2_gating(logits, capacity, key=None, noise_std=0.0):
+def top2_gating(logits, capacity):
     """Top-2 token routing with fixed expert capacity.
 
     logits: (T, E). Returns (dispatch (T, E, C) one-hot, combine (T, E, C)
     weights, aux_loss scalar).
     """
     T, E = logits.shape
-    if noise_std and key is not None:
-        logits = logits + noise_std * jax.random.normal(key, logits.shape)
     probs = jax.nn.softmax(logits, axis=-1)
 
     gate1 = jnp.argmax(probs, axis=-1)                       # (T,)
@@ -75,8 +73,7 @@ def moe_ffn_kernel(x, wg, w_in, w_out, axis_name, n_experts,
     w_in: (E_local, D, F), w_out: (E_local, F, D) local expert weights.
     Returns (y (T_local, D), aux_loss).
     """
-    ep = lax.psum(1, axis_name) if not isinstance(axis_name, str) else \
-        lax.axis_size(axis_name)
+    ep = lax.axis_size(axis_name)   # static; accepts a name or name-tuple
     T, D = x.shape
     E = n_experts
     C = int(capacity_factor * T * 2 / E) + 1  # top-2 → 2 slots per token

@@ -29,8 +29,7 @@ def _shift_right(x, axis_name, axis_size):
     return lax.ppermute(x, axis_name, perm)
 
 
-def pipeline_kernel(stage_fn, params, xs, axis_name, axis_size,
-                    extra=None):
+def pipeline_kernel(stage_fn, params, xs, axis_name, axis_size):
     """Per-device GPipe schedule body — call inside shard_map.
 
     ``params``: this stage's weights (leading stage axis already sliced
@@ -53,8 +52,7 @@ def pipeline_kernel(stage_fn, params, xs, axis_name, axis_size,
         feed = lax.dynamic_index_in_dim(
             xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
         x_in = jnp.where(idx == 0, feed, buf)
-        y = stage_fn(params, x_in) if extra is None else \
-            stage_fn(params, x_in, extra)
+        y = stage_fn(params, x_in)
         # the last stage retires microbatch t - (n_stages - 1) at tick t.
         w = t - last
         wc = jnp.clip(w, 0, n_micro - 1)

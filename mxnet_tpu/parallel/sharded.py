@@ -29,8 +29,12 @@ def shard_params(params, mesh, rules=None):
       - qkv/ffn-in kernels: shard output dim over 'tp'
       - proj/ffn-out kernels: shard input dim over 'tp'
     """
+    from ..gluon.parameter import Parameter
+
     out = {}
     for name, value in params.items():
+        if isinstance(value, Parameter):   # accept collect_params() dicts
+            value = value.data()
         spec = P()
         for pred, s in (rules or []):
             if pred(name, value.shape):

@@ -1,0 +1,137 @@
+"""Llama family: RoPE, GQA attention, causality, training, TP sharding."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, parallel
+from mxnet_tpu.gluon.model_zoo import llama
+
+
+def _tiny(**kw):
+    net = llama.llama_tiny(**kw)
+    net.initialize()
+    return net
+
+
+def test_forward_shape():
+    net = _tiny()
+    tok = mx.np.array(np.random.randint(0, 256, (2, 16)), dtype='int32')
+    out = net(tok)
+    assert out.shape == (2, 16, 256)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_rope_is_rotation():
+    """RoPE preserves pairwise norms and is identity at position 0."""
+    x = jnp.asarray(np.random.randn(1, 4, 2, 8).astype('f'))
+    y = llama._rope(x, 10000.0)
+    # norm of each (even, odd) pair preserved
+    nx = x[..., ::2] ** 2 + x[..., 1::2] ** 2
+    ny = y[..., ::2] ** 2 + y[..., 1::2] ** 2
+    np.testing.assert_allclose(np.asarray(nx), np.asarray(ny), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(x[:, 0]),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_rope_relative_shift():
+    """Scores q_m·k_n depend only on m-n: shifting both positions by the
+    same offset leaves the dot products unchanged."""
+    q = jnp.asarray(np.random.randn(1, 6, 1, 8).astype('f'))
+    k = jnp.asarray(np.random.randn(1, 6, 1, 8).astype('f'))
+    s0 = jnp.einsum('bqhd,bkhd->bqk', llama._rope(q, 1e4, offset=0),
+                    llama._rope(k, 1e4, offset=0))
+    s5 = jnp.einsum('bqhd,bkhd->bqk', llama._rope(q, 1e4, offset=5),
+                    llama._rope(k, 1e4, offset=5))
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s5), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    net = _tiny()
+    tok = np.random.randint(0, 256, (1, 12)).astype('int32')
+    out1 = net(mx.np.array(tok)).asnumpy()
+    tok2 = tok.copy()
+    tok2[0, -1] = (tok2[0, -1] + 7) % 256
+    out2 = net(mx.np.array(tok2)).asnumpy()
+    np.testing.assert_allclose(out1[0, :-1], out2[0, :-1], rtol=1e-4,
+                               atol=1e-5)
+    assert np.abs(out1[0, -1] - out2[0, -1]).max() > 1e-4
+
+
+def test_gqa_heads():
+    """num_kv_heads < num_heads shrinks k/v projections accordingly."""
+    net = _tiny()
+    attn = net.model.layers[0].self_attn
+    assert attn.q_proj.weight.shape[0] == 64
+    assert attn.k_proj.weight.shape[0] == 32     # 2 kv heads * dh 16
+    assert attn.v_proj.weight.shape[0] == 32
+
+
+def test_train_step_reduces_loss():
+    net = _tiny()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 5e-3})
+    tok = mx.np.array(np.random.randint(0, 256, (4, 16)), dtype='int32')
+    losses = []
+    for _ in range(20):
+        with autograd.record():
+            logits = net(tok[:, :-1])
+            l = loss_fn(logits, tok[:, 1:]).mean()
+        l.backward()
+        trainer.step(1)
+        losses.append(float(l.asnumpy()))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_hybridize_matches_eager():
+    net = _tiny()
+    tok = mx.np.array(np.random.randint(0, 256, (2, 8)), dtype='int32')
+    eager = net(tok).asnumpy()
+    net.hybridize()
+    hybrid = net(tok).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_tied_embeddings():
+    net = _tiny(tie_word_embeddings=True)
+    tok = mx.np.array(np.random.randint(0, 256, (1, 8)), dtype='int32')
+    out = net(tok)
+    assert out.shape == (1, 8, 256)
+    assert not hasattr(net, 'lm_head')
+
+
+def test_partition_rules():
+    rules = llama.llama_partition_rules('tp')
+    from jax.sharding import PartitionSpec as P
+    net = _tiny()
+    tok = mx.np.array(np.random.randint(0, 256, (1, 8)), dtype='int32')
+    net(tok)
+
+    def spec_for(name, shape):
+        for pred, s in rules:
+            if pred(name, shape):
+                return s
+        return P()
+
+    params = net.collect_params()
+    specs = {n: spec_for(n, p.shape) for n, p in params.items()}
+    qs = [s for n, s in specs.items() if 'q_proj' in n]
+    assert qs and all(s == P('tp', None) for s in qs)
+    os_ = [s for n, s in specs.items() if 'o_proj' in n]
+    assert os_ and all(s == P(None, 'tp') for s in os_)
+    norms = [s for n, s in specs.items() if 'layernorm' in n or
+             n.endswith('norm.weight')]
+    assert norms and all(s == P() for s in norms)
+
+    # params place on a real tp mesh with these rules
+    mesh = parallel.make_mesh(tp=8)
+    sharded = parallel.shard_params(params, mesh, rules=rules)
+    qname = next(n for n in sharded if 'q_proj' in n)
+    assert len(sharded[qname].sharding.device_set) == 8
