@@ -22,4 +22,5 @@ from . import linalg        # noqa: F401
 from . import random_ops    # noqa: F401
 from . import nn            # noqa: F401
 from . import contrib       # noqa: F401
+from . import optimizer_ops  # noqa: F401
 from . import quantization_ops  # noqa: F401

@@ -258,3 +258,72 @@ def count_sketch(data, h, s, out_dim):
     signed = data * s
     out = jnp.zeros(data.shape[:-1] + (out_dim,), dtype=data.dtype)
     return out.at[..., idx].add(signed)
+
+
+@register('bipartite_matching', differentiable=False, n_out=2)
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
+    """Greedy bipartite matching (reference
+    src/operator/contrib/bounding_box.cc _contrib_bipartite_matching).
+
+    data: (..., N, M) pairwise scores. Returns (row→col match, col→row
+    match), -1 for unmatched. The greedy loop over min(N, M) rounds is a
+    ``lax.scan`` masking out matched rows/cols each round — fixed trip
+    count, so XLA compiles it to one fused loop.
+    """
+    scores = data.astype(jnp.float32)
+    N, M = scores.shape[-2], scores.shape[-1]
+    batch = scores.shape[:-2]
+    s = scores.reshape((-1, N, M))
+    sign = 1.0 if is_ascend else -1.0
+    key_ = sign * s  # minimize key_
+    BIG = jnp.float32(3.4e38)
+    rounds = min(N, M) if topk < 0 else min(topk, min(N, M))
+    ok = (s > threshold) if not is_ascend else (s < threshold)
+
+    def body(carry, _):
+        kmat, rmatch, cmatch = carry
+        flat = kmat.reshape(kmat.shape[0], -1)
+        idx = jnp.argmin(flat, axis=1)
+        r, c = idx // M, idx % M
+        valid = jnp.take_along_axis(flat, idx[:, None], 1)[:, 0] < BIG
+        b = jnp.arange(kmat.shape[0])
+        good = valid & ok[b, r, c]
+        rmatch = rmatch.at[b, r].set(jnp.where(good, c, rmatch[b, r]))
+        cmatch = cmatch.at[b, c].set(jnp.where(good, r, cmatch[b, c]))
+        kmat = kmat.at[b, r, :].set(jnp.where(valid[:, None], BIG,
+                                              kmat[b, r, :]))
+        kmat = kmat.at[b, :, c].set(jnp.where(valid[:, None], BIG,
+                                              kmat[b, :, c]))
+        return (kmat, rmatch, cmatch), None
+
+    rmatch0 = jnp.full((s.shape[0], N), -1.0)
+    cmatch0 = jnp.full((s.shape[0], M), -1.0)
+    (_, rmatch, cmatch), _ = lax.scan(body, (key_, rmatch0, cmatch0),
+                                      None, length=rounds)
+    return (rmatch.reshape(batch + (N,)), cmatch.reshape(batch + (M,)))
+
+
+@register('sparse_embedding', aliases=('SparseEmbedding',))
+def sparse_embedding(data, weight, input_dim=None, output_dim=None,
+                     dtype=None, sparse_grad=False):
+    """Reference: src/operator/tensor/indexing_op.cc _contrib_SparseEmbedding.
+    On TPU the row-sparse gradient path is an XLA scatter-add over the dense
+    table (same dispatch the dense embedding uses), so this is an alias."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register('group_adagrad_update', n_out=2)
+def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Reference: src/operator/contrib/optimizer_op.cc
+    _contrib_group_adagrad_update (per-row accumulated squared-norm
+    AdaGrad, the row_sparse-friendly variant). Returns (weight, history).
+    """
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    hist = history + jnp.mean(g * g, axis=axes, keepdims=True) \
+        if g.ndim > 1 else history + g * g
+    w = weight - lr * g / (jnp.sqrt(hist) + epsilon)
+    return w, hist

@@ -127,3 +127,8 @@ FRONTEND_CREATORS = {
     'arange': arange, 'linspace': linspace, 'logspace': logspace, 'eye': eye,
     'identity': identity, 'tri': tri, 'indices': indices,
 }
+
+
+@register('vander')
+def vander(x, N=None, increasing=False):
+    return jnp.vander(x, N=N, increasing=increasing)

@@ -440,3 +440,67 @@ def unravel_index(indices, shape):
 def ravel_multi_index(multi_index, shape):
     idx = tuple(multi_index[i] for i in range(multi_index.shape[0]))
     return jnp.ravel_multi_index(idx, shape, mode='clip')
+
+
+@register('unwrap')
+def unwrap(p, discont=None, axis=-1, period=6.283185307179586):
+    return jnp.unwrap(p, discont=discont, axis=axis, period=period)
+
+
+@register('convolve')
+def convolve(a, v, mode='full'):
+    return jnp.convolve(a, v, mode=mode)
+
+
+@register('correlate')
+def correlate(a, v, mode='valid'):
+    return jnp.correlate(a, v, mode=mode)
+
+
+@register('cov')
+def cov(m, y=None, rowvar=True, bias=False, ddof=None, fweights=None,
+        aweights=None):
+    return jnp.cov(m, y=y, rowvar=rowvar, bias=bias, ddof=ddof,
+                   fweights=fweights, aweights=aweights)
+
+
+@register('corrcoef')
+def corrcoef(x, y=None, rowvar=True):
+    return jnp.corrcoef(x, y=y, rowvar=rowvar)
+
+
+@register('depth_to_space')
+def depth_to_space(data, block_size):
+    """Reference: src/operator/tensor/matrix_op.cc depth_to_space (NCHW,
+    DCR order) — pure reshape/transpose, fused away by XLA."""
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register('space_to_depth')
+def space_to_depth(data, block_size):
+    """Reference: src/operator/tensor/matrix_op.cc space_to_depth (inverse
+    of depth_to_space)."""
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register('arange_like', differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, ctx=None, axis=None):
+    """Reference: src/operator/tensor/init_op.cc _contrib_arange_like —
+    arange shaped like ``data`` (or its ``axis`` extent)."""
+    if axis is None:
+        n = 1
+        for d in data.shape:
+            n *= d
+        idx = jnp.arange(n) // repeat          # each value repeated `repeat`×
+        return (start + step * idx.astype(data.dtype)).reshape(data.shape)
+    n = data.shape[axis]
+    idx = jnp.arange(n) // repeat
+    return start + step * idx.astype(data.dtype)

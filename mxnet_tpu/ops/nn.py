@@ -559,3 +559,199 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     m = jnp.maximum(last, last2)
     ll = m + jnp.log(jnp.exp(last - m) + jnp.exp(last2 - m))
     return -ll
+
+
+# ------------------------------------------------------------------ fused rnn
+
+def _rnn_gates(mode):
+    return {'rnn_relu': 1, 'rnn_tanh': 1, 'lstm': 4, 'gru': 3}[mode]
+
+
+def _rnn_unpack(parameters, mode, input_size, state_size, num_layers, dirs):
+    """Unpack the cuDNN-canonical flat parameter vector.
+
+    Layout matches the reference's fused RNN op (src/operator/rnn-inl.h
+    GetRnnParamSize / cuDNN canonical order): all weights first — per layer,
+    per direction: i2h (G*H, I_l) then h2h (G*H, H) — then all biases in the
+    same order (b_i2h, b_h2h each G*H). Gate order: LSTM [i, f, g, o],
+    GRU [r, z, n] (cuDNN order, as the reference's kernels use).
+    """
+    G, H = _rnn_gates(mode), state_size
+    ws, bs, off = [], [], 0
+    for layer in range(num_layers):
+        il = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            wi = parameters[off:off + G * H * il].reshape(G * H, il)
+            off += G * H * il
+            wh = parameters[off:off + G * H * H].reshape(G * H, H)
+            off += G * H * H
+            ws.append((wi, wh))
+    for _ in range(num_layers * dirs):
+        bi = parameters[off:off + G * H]
+        off += G * H
+        bh = parameters[off:off + G * H]
+        off += G * H
+        bs.append((bi, bh))
+    return ws, bs
+
+
+def _rnn_layer_scan(mode, x, h0, c0, wi, wh, bi, bh, reverse):
+    """One direction of one layer. x: (T, B, I). Returns (T, B, H), hT, cT.
+
+    The input projection for the whole sequence is one big MXU matmul
+    (T*B, I)·(I, G*H); the scan carries only the (B, H) recurrence.
+    """
+    H = h0.shape[-1]
+
+    if mode in ('rnn_relu', 'rnn_tanh'):
+        xg = jnp.einsum('tbi,gi->tbg', x, wi) + bi + bh  # (T, B, G*H)
+        act = jax.nn.relu if mode == 'rnn_relu' else jnp.tanh
+
+        def step(h, xg_t):
+            h = act(xg_t + h @ wh.T)
+            return h, h
+
+        hT, ys = lax.scan(step, h0, xg, reverse=reverse)
+        return ys, hT, None
+
+    if mode == 'lstm':
+        xg = jnp.einsum('tbi,gi->tbg', x, wi) + bi + bh  # (T, B, G*H)
+
+        def step(carry, xg_t):
+            h, c = carry
+            g = xg_t + h @ wh.T
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        (hT, cT), ys = lax.scan(step, (h0, c0), xg, reverse=reverse)
+        return ys, hT, cT
+
+    # gru — cuDNN formulation: n = tanh(x_n + b_n + r * (h @ Whn + bhn));
+    # the h2h part of the n gate is gated by r *before* adding the input
+    # part, so recompute it inside the scan from the raw recurrence.
+    wir, wiz, win = jnp.split(wi, 3, axis=0)
+    whr, whz, whn = jnp.split(wh, 3, axis=0)
+    bir, biz, bin_ = jnp.split(bi, 3)
+    bhr, bhz, bhn = jnp.split(bh, 3)
+    xr = jnp.einsum('tbi,gi->tbg', x, wir) + bir
+    xz = jnp.einsum('tbi,gi->tbg', x, wiz) + biz
+    xn = jnp.einsum('tbi,gi->tbg', x, win) + bin_
+    xg = jnp.concatenate([xr, xz, xn], axis=-1)
+
+    def step(h, xg_t):
+        xr_t, xz_t, xn_t = jnp.split(xg_t, 3, axis=-1)
+        r = jax.nn.sigmoid(xr_t + h @ whr.T + bhr)
+        z = jax.nn.sigmoid(xz_t + h @ whz.T + bhz)
+        n = jnp.tanh(xn_t + r * (h @ whn.T + bhn))
+        h = (1 - z) * n + z * h
+        return h, h
+
+    hT, ys = lax.scan(step, h0, xg, reverse=reverse)
+    return ys, hT, None
+
+
+def _rnn_n_out(args, kw):
+    mode = kw.get('mode', 'lstm')
+    if not kw.get('state_outputs', False):
+        return 1
+    return 3 if mode == 'lstm' else 2
+
+
+@register('rnn', aliases=('RNN',), n_out=_rnn_n_out)
+def rnn(data, parameters, state, state_cell=None, mode='lstm',
+        state_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=False, key=None):
+    """Fused multi-layer (bi)directional RNN/LSTM/GRU.
+
+    Reference: src/operator/rnn.cc (`_npx_rnn`, cuDNN fused kernels +
+    native rnn-inl.h). TPU design: per layer, the input projection is one
+    batched MXU matmul over the whole sequence; only the (B, H) recurrence
+    lives in a ``lax.scan``, which XLA compiles to a single fused loop.
+
+    data: (T, B, I); state: (L*dirs, B, H); state_cell (lstm): same.
+    Returns output (T, B, H*dirs) [+ hy (+ cy) if state_outputs].
+    Inter-layer dropout ``p`` applies between layers in training graphs when
+    a PRNG ``key`` is supplied (the op is registered non-stochastic so eager
+    inference stays deterministic; Gluon passes the key when training).
+    """
+    dirs = 2 if bidirectional else 1
+    T, B, I = data.shape
+    H = state_size if state_size is not None else state.shape[-1]
+    ws, bs = _rnn_unpack(parameters, mode, I, H, num_layers, dirs)
+
+    x = data
+    hys, cys = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            wi, wh = ws[idx]
+            bi, bh = bs[idx]
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else None
+            ys, hT, cT = _rnn_layer_scan(mode, x, h0, c0, wi, wh, bi, bh,
+                                         reverse=(d == 1))
+            outs.append(ys)
+            hys.append(hT)
+            if cT is not None:
+                cys.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and key is not None and layer < num_layers - 1:
+            sub = jax.random.fold_in(key, layer)
+            keep = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0)
+
+    if not state_outputs:
+        return x
+    hy = jnp.stack(hys)
+    if mode == 'lstm':
+        return x, hy, jnp.stack(cys)
+    return x, hy
+
+
+# ------------------------------------------------------------- im2col/col2im
+
+def _im2col_raw(data, kernel, stride, dilate, pad):
+    nd = len(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    padding = [(p, p) for p in pad]
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=kernel, window_strides=stride, padding=padding,
+        rhs_dilation=dilate)
+    # (N, C*prod(kernel), *out_spatial), channel-major — same row order as
+    # the reference's im2col (src/operator/nn/im2col.h)
+    n, ck = patches.shape[:2]
+    return patches.reshape(n, ck, -1)
+
+
+@register('im2col')
+def im2col(data, kernel=None, stride=None, dilate=None, pad=None):
+    """Reference: src/operator/nn/im2col.h (_npx_im2col). data: (N, C, *S)
+    → (N, C*prod(kernel), prod(out_spatial))."""
+    kernel = tuple(kernel)
+    return _im2col_raw(data, kernel, stride and tuple(stride),
+                       dilate and tuple(dilate), pad and tuple(pad))
+
+
+@register('col2im')
+def col2im(data, output_size=None, kernel=None, stride=None, dilate=None,
+           pad=None):
+    """Adjoint of im2col (reference src/operator/nn/im2col.h col2im):
+    overlapping patches sum back into the image. Implemented as the linear
+    transpose of ``im2col`` — XLA turns it into the same gather/scatter it
+    uses for conv input gradients."""
+    kernel = tuple(kernel)
+    output_size = tuple(output_size)
+    n = data.shape[0]
+    c = data.shape[1] // int(_np.prod(kernel))
+    img_shape = (n, c) + output_size
+    zero = jnp.zeros(img_shape, data.dtype)
+    _, vjp = jax.vjp(
+        lambda x: _im2col_raw(x, kernel, stride and tuple(stride),
+                              dilate and tuple(dilate), pad and tuple(pad)),
+        zero)
+    return vjp(data)[0]

@@ -1,0 +1,297 @@
+"""Tests for the op-gap batch: fused RNN, im2col/col2im, space/depth ops,
+numpy misc (cov/corrcoef/convolve/...), contrib matching/embedding, and the
+optimizer update kernels (reference src/operator/optimizer_op.cc surface)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+npx = mx.npx
+
+
+# ------------------------------------------------------------------ fused rnn
+
+def _pack_rnn_params(wi_list, wh_list, bi_list, bh_list):
+    parts = []
+    for wi, wh in zip(wi_list, wh_list):
+        parts.extend([wi.ravel(), wh.ravel()])
+    for bi, bh in zip(bi_list, bh_list):
+        parts.extend([bi, bh])
+    return np.concatenate(parts).astype('float32')
+
+
+def test_rnn_lstm_matches_manual():
+    T, B, I, H = 5, 3, 4, 6
+    rng = np.random.default_rng(0)
+    wi = rng.standard_normal((4 * H, I), dtype='f') * 0.3
+    wh = rng.standard_normal((4 * H, H), dtype='f') * 0.3
+    bi = rng.standard_normal(4 * H).astype('f') * 0.1
+    bh = rng.standard_normal(4 * H).astype('f') * 0.1
+    x = rng.standard_normal((T, B, I), dtype='f')
+    h0 = np.zeros((1, B, H), 'f')
+    c0 = np.zeros((1, B, H), 'f')
+    params = _pack_rnn_params([wi], [wh], [bi], [bh])
+
+    out, hy, cy = npx.rnn(mx.np.array(x), mx.np.array(params),
+                          mx.np.array(h0), mx.np.array(c0), mode='lstm',
+                          state_size=H, num_layers=1, state_outputs=True)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    h, c = h0[0], c0[0]
+    outs = []
+    for t in range(T):
+        g = x[t] @ wi.T + bi + h @ wh.T + bh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    want = np.stack(outs)
+    assert_almost_equal(out, want, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(hy, h[None], rtol=1e-4, atol=1e-5)
+    assert_almost_equal(cy, c[None], rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_gru_matches_manual():
+    T, B, I, H = 4, 2, 3, 5
+    rng = np.random.default_rng(1)
+    wi = rng.standard_normal((3 * H, I), dtype='f') * 0.3
+    wh = rng.standard_normal((3 * H, H), dtype='f') * 0.3
+    bi = rng.standard_normal(3 * H).astype('f') * 0.1
+    bh = rng.standard_normal(3 * H).astype('f') * 0.1
+    x = rng.standard_normal((T, B, I), dtype='f')
+    h0 = np.zeros((1, B, H), 'f')
+    params = _pack_rnn_params([wi], [wh], [bi], [bh])
+
+    out, hy = npx.rnn(mx.np.array(x), mx.np.array(params), mx.np.array(h0),
+                      mode='gru', state_size=H, num_layers=1,
+                      state_outputs=True)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    wir, wiz, win = np.split(wi, 3, 0)
+    whr, whz, whn = np.split(wh, 3, 0)
+    bir, biz, bin_ = np.split(bi, 3)
+    bhr, bhz, bhn = np.split(bh, 3)
+    h = h0[0]
+    outs = []
+    for t in range(T):
+        r = sig(x[t] @ wir.T + bir + h @ whr.T + bhr)
+        z = sig(x[t] @ wiz.T + biz + h @ whz.T + bhz)
+        n = np.tanh(x[t] @ win.T + bin_ + r * (h @ whn.T + bhn))
+        h = (1 - z) * n + z * h
+        outs.append(h)
+    assert_almost_equal(out, np.stack(outs), rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_bidirectional_multilayer_shapes():
+    T, B, I, H, L = 6, 2, 4, 3, 2
+    rng = np.random.default_rng(2)
+    dirs = 2
+    wi_list, wh_list, bi_list, bh_list = [], [], [], []
+    for layer in range(L):
+        il = I if layer == 0 else H * dirs
+        for _ in range(dirs):
+            wi_list.append(rng.standard_normal((4 * H, il), dtype='f') * .2)
+            wh_list.append(rng.standard_normal((4 * H, H), dtype='f') * .2)
+            bi_list.append(np.zeros(4 * H, 'f'))
+            bh_list.append(np.zeros(4 * H, 'f'))
+    params = _pack_rnn_params(wi_list, wh_list, bi_list, bh_list)
+    x = rng.standard_normal((T, B, I), dtype='f')
+    h0 = np.zeros((L * dirs, B, H), 'f')
+    c0 = np.zeros((L * dirs, B, H), 'f')
+    out, hy, cy = npx.rnn(mx.np.array(x), mx.np.array(params),
+                          mx.np.array(h0), mx.np.array(c0), mode='lstm',
+                          state_size=H, num_layers=L, bidirectional=True,
+                          state_outputs=True)
+    assert out.shape == (T, B, H * dirs)
+    assert hy.shape == (L * dirs, B, H)
+    assert cy.shape == (L * dirs, B, H)
+
+
+def test_rnn_grad_flows():
+    T, B, I, H = 3, 2, 3, 4
+    rng = np.random.default_rng(3)
+    nparams = 4 * H * I + 4 * H * H + 2 * 4 * H
+    params = mx.np.array(rng.standard_normal(nparams, dtype='f') * 0.1)
+    x = mx.np.array(rng.standard_normal((T, B, I), dtype='f'))
+    h0 = mx.np.zeros((1, B, H))
+    c0 = mx.np.zeros((1, B, H))
+    params.attach_grad()
+    with mx.autograd.record():
+        out = npx.rnn(x, params, h0, c0, mode='lstm', state_size=H,
+                      num_layers=1)
+        loss = (out * out).sum()
+    loss.backward()
+    g = params.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+# ------------------------------------------------------------- im2col/col2im
+
+def test_im2col_matches_naive():
+    N, C, Hh, W = 2, 3, 5, 5
+    k, s, p = (3, 3), (1, 1), (1, 1)
+    x = np.random.uniform(-1, 1, (N, C, Hh, W)).astype('f')
+    got = npx.im2col(mx.np.array(x), kernel=k, stride=s, pad=p).asnumpy()
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    oh = ow = 5
+    want = np.zeros((N, C * 9, oh * ow), 'f')
+    for c in range(C):
+        for ki in range(3):
+            for kj in range(3):
+                row = c * 9 + ki * 3 + kj
+                patch = xp[:, c, ki:ki + oh, kj:kj + ow]
+                want[:, row, :] = patch.reshape(N, -1)
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_col2im_is_adjoint_of_im2col():
+    N, C, Hh, W = 1, 2, 4, 4
+    k, s = (2, 2), (2, 2)
+    x = np.random.uniform(-1, 1, (N, C, Hh, W)).astype('f')
+    cols = npx.im2col(mx.np.array(x), kernel=k, stride=s)
+    y = np.random.uniform(-1, 1, cols.shape).astype('f')
+    back = npx.col2im(mx.np.array(y), output_size=(Hh, W), kernel=k,
+                      stride=s)
+    # <im2col(x), y> == <x, col2im(y)> (adjoint identity)
+    lhs = float((cols.asnumpy() * y).sum())
+    rhs = float((x * back.asnumpy()).sum())
+    assert abs(lhs - rhs) < 1e-3
+
+
+# --------------------------------------------------------- depth/space, misc
+
+def test_depth_space_roundtrip():
+    x = np.arange(2 * 8 * 3 * 3, dtype='f').reshape(2, 8, 3, 3)
+    d = mx.np.array(x)
+    up = npx.depth_to_space(d, 2)
+    assert up.shape == (2, 2, 6, 6)
+    back = npx.space_to_depth(up, 2)
+    assert_almost_equal(back, x)
+
+
+def test_arange_like():
+    x = mx.np.zeros((2, 3))
+    out = npx.arange_like(x, start=1.0, step=0.5)
+    assert out.shape == (2, 3)
+    assert_almost_equal(out, 1.0 + 0.5 * np.arange(6).reshape(2, 3))
+    row = npx.arange_like(x, axis=1)
+    assert_almost_equal(row, np.arange(3, dtype='f'))
+    rep = npx.arange_like(x, repeat=2)
+    assert rep.shape == (2, 3)
+    assert_almost_equal(rep, np.array([[0, 0, 1], [1, 2, 2]], 'f'))
+    rep_ax = npx.arange_like(x, axis=1, repeat=3)
+    assert_almost_equal(rep_ax, np.zeros(3, 'f'))
+
+
+@pytest.mark.parametrize('name,args', [
+    ('vander', (np.array([1., 2., 3.]),)),
+    ('unwrap', (np.array([0., 0.5, 6.5, 7.0]),)),
+    ('convolve', (np.array([1., 2., 3.]), np.array([0., 1., 0.5]))),
+    ('correlate', (np.array([1., 2., 3.]), np.array([0., 1., 0.5]))),
+    ('cov', (np.random.uniform(size=(3, 8)).astype('f'),)),
+    ('corrcoef', (np.random.uniform(size=(3, 8)).astype('f'),)),
+])
+def test_numpy_misc_parity(name, args):
+    got = getattr(mx.np, name)(*[mx.np.array(a) for a in args])
+    want = getattr(np, name)(*args)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------------- contrib
+
+def test_bipartite_matching():
+    score = np.array([[[0.9, 0.1], [0.8, 0.7]]], 'f')
+    row, col = npx.bipartite_matching(mx.np.array(score), threshold=0.5)
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7
+    assert row.asnumpy().tolist() == [[0.0, 1.0]]
+    assert col.asnumpy().tolist() == [[0.0, 1.0]]
+
+
+def test_sparse_embedding():
+    W = np.random.uniform(size=(10, 4)).astype('f')
+    idx = np.array([[1, 3], [5, 0]], 'f')
+    out = npx.sparse_embedding(mx.np.array(idx), mx.np.array(W))
+    assert_almost_equal(out, W[idx.astype(int)])
+
+
+# ---------------------------------------------------------- optimizer kernels
+
+def test_sgd_and_momentum_update():
+    w = np.array([1.0, 2.0], 'f')
+    g = np.array([0.5, -0.5], 'f')
+    out = npx.sgd_update(mx.np.array(w), mx.np.array(g), lr=0.1, wd=0.0)
+    assert_almost_equal(out, w - 0.1 * g)
+    m = np.zeros(2, 'f')
+    w2, m2 = npx.sgd_mom_update(mx.np.array(w), mx.np.array(g),
+                                mx.np.array(m), lr=0.1, momentum=0.9)
+    assert_almost_equal(m2, -0.1 * g)
+    assert_almost_equal(w2, w - 0.1 * g)
+
+
+def test_adam_update_matches_reference_formula():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal(5).astype('f')
+    g = rng.standard_normal(5).astype('f')
+    mean = np.zeros(5, 'f')
+    var = np.zeros(5, 'f')
+    w2, m2, v2 = npx.adam_update(mx.np.array(w), mx.np.array(g),
+                                 mx.np.array(mean), mx.np.array(var),
+                                 lr=0.01)
+    em = 0.1 * g
+    ev = 0.001 * g * g
+    assert_almost_equal(m2, em, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(v2, ev, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(w2, w - 0.01 * em / (np.sqrt(ev) + 1e-8),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    w = np.ones(3, 'f')
+    g = np.zeros(3, 'f')
+    w2, _, _ = npx.adamw_update(mx.np.array(w), mx.np.array(g),
+                                mx.np.zeros(3), mx.np.zeros(3),
+                                lr=0.1, wd=0.01, eta=1.0)
+    assert_almost_equal(w2, w - 0.01 * w, rtol=1e-5, atol=1e-7)
+
+
+def test_multi_sgd_and_sum_sq():
+    ws = [np.array([1.0], 'f'), np.array([2.0, 3.0], 'f')]
+    gs = [np.array([0.1], 'f'), np.array([0.2, 0.3], 'f')]
+    arrays = [mx.np.array(a) for pair in zip(ws, gs) for a in pair]
+    o1, o2 = npx.multi_sgd_update(*arrays, lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                                  num_weights=2)
+    assert_almost_equal(o1, ws[0] - 0.1 * gs[0])
+    assert_almost_equal(o2, ws[1] - 0.2 * gs[1])
+    ss = npx.multi_sum_sq(*[mx.np.array(w) for w in ws])
+    assert_almost_equal(ss, np.array([1.0, 13.0], 'f'))
+
+
+def test_group_adagrad_update():
+    w = np.ones((2, 3), 'f')
+    g = np.full((2, 3), 0.5, 'f')
+    h = np.zeros((2, 1), 'f')
+    w2, h2 = npx.group_adagrad_update(mx.np.array(w), mx.np.array(g),
+                                      mx.np.array(h), lr=0.1)
+    assert_almost_equal(h2, np.full((2, 1), 0.25, 'f'))
+    assert_almost_equal(w2, w - 0.1 * 0.5 / (0.5 + 1e-5), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_lamb_phases():
+    w = np.ones(4, 'f') * 2
+    g = np.ones(4, 'f') * 0.1
+    gdir, mean, var = npx.lamb_update_phase1(
+        mx.np.array(w), mx.np.array(g), mx.np.zeros(4), mx.np.zeros(4), t=1)
+    assert_almost_equal(mean, 0.1 * g, rtol=1e-5, atol=1e-7)
+    assert_almost_equal(var, 0.001 * g * g, rtol=1e-5, atol=1e-9)
+    r1 = mx.np.array(np.array(np.linalg.norm(w), 'f'))
+    r2 = mx.np.array(np.array(np.linalg.norm(gdir.asnumpy()), 'f'))
+    w2 = npx.lamb_update_phase2(mx.np.array(w), gdir, r1, r2, lr=0.01)
+    assert np.isfinite(w2.asnumpy()).all()
+    assert (w2.asnumpy() < w).all()
