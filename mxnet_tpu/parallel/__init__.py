@@ -15,6 +15,9 @@ from .sharded import shard_params, replicate, make_sharded_train_step
 from . import ring_attention
 from . import pipeline
 from . import moe
+from . import checkpoint
+from .checkpoint import (save_sharded, restore_sharded,
+                         SharedCheckpointManager)
 from .pipeline import pipeline_apply, stack_stage_params
 from .moe import moe_ffn
 

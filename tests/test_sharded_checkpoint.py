@@ -1,0 +1,96 @@
+"""Sharded checkpoint/resume over the virtual 8-device CPU mesh.
+
+Reference gap this covers (SURVEY §5): MXNet checkpoints are rank-0 whole
+files; the TPU build checkpoints sharded parameters collectively."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mesh():
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devs, ('dp', 'tp'))
+
+
+def test_save_restore_roundtrip_sharded(tmp_path):
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    tree = {
+        'w1': jax.device_put(
+            jnp.asarray(rng.standard_normal((8, 16), dtype=np.float32)),
+            NamedSharding(mesh, P(None, 'tp'))),
+        'b1': jax.device_put(
+            jnp.asarray(rng.standard_normal(16, dtype=np.float32)),
+            NamedSharding(mesh, P())),
+    }
+    path = str(tmp_path / 'ckpt')
+    parallel.save_sharded(path, tree)
+
+    restored = parallel.restore_sharded(path, template=tree)
+    for k in tree:
+        assert_almost_equal(np.asarray(restored[k]), np.asarray(tree[k]))
+        assert restored[k].sharding == tree[k].sharding
+
+
+def test_restore_with_new_sharding(tmp_path):
+    mesh = _mesh()
+    w = jax.device_put(jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+                       NamedSharding(mesh, P('dp', None)))
+    path = str(tmp_path / 'ckpt2')
+    parallel.save_sharded(path, {'w': w})
+
+    # restore re-sharded over tp instead of dp
+    tmpl = {'w': jax.ShapeDtypeStruct(
+        (8, 4), jnp.float32, sharding=NamedSharding(mesh, P(None, 'tp')))}
+    restored = parallel.restore_sharded(path, template=tmpl)
+    assert restored['w'].sharding.spec == P(None, 'tp')
+    assert_almost_equal(np.asarray(restored['w']), np.asarray(w))
+
+
+def test_restore_to_host_numpy(tmp_path):
+    tree = {'a': jnp.ones((3, 3)), 'nested': {'b': jnp.zeros(4)}}
+    path = str(tmp_path / 'ckpt3')
+    parallel.save_sharded(path, tree)
+    out = parallel.restore_sharded(path)
+    assert_almost_equal(np.asarray(out['a']), np.ones((3, 3)))
+    assert_almost_equal(np.asarray(out['nested']['b']), np.zeros(4))
+
+
+def test_checkpoint_manager_rotation(tmp_path):
+    mgr = parallel.SharedCheckpointManager(str(tmp_path / 'mgr'),
+                                           max_to_keep=2)
+    try:
+        for step in range(4):
+            mgr.save(step, {'w': jnp.full((2,), float(step))})
+        steps = mgr.all_steps()
+        assert mgr.latest_step() == 3
+        assert len(steps) <= 2 and 3 in steps
+        out = mgr.restore()
+        assert_almost_equal(np.asarray(out['w']), np.full((2,), 3.0))
+    finally:
+        mgr.close()
+
+
+def test_block_params_sharded_roundtrip(tmp_path):
+    from mxnet_tpu.parallel.checkpoint import (save_params_sharded,
+                                               load_params_sharded)
+    net = mx.gluon.nn.Dense(8, in_units=4)
+    net.initialize()
+    before = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    path = str(tmp_path / 'blk')
+    save_params_sharded(path, net)
+    # perturb, then restore
+    for _, p in net.collect_params().items():
+        p.set_data(mx.np.zeros(p.shape))
+    load_params_sharded(path, net)
+    after = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    for k in before:
+        assert_almost_equal(after[k], before[k])
