@@ -86,6 +86,11 @@ class random:
     """``mx.np.random`` namespace (reference numpy/random.py)."""
 
 
+class fft:
+    """``mx.np.fft`` namespace (the reference served np.fft via its
+    official-numpy fallback, numpy/fallback.py; here it runs on-device)."""
+
+
 def _build_sub_namespaces():
     from ..ops import registry as _reg
     for name, op in _reg.list_ops().items():
@@ -94,6 +99,9 @@ def _build_sub_namespaces():
                 _reg.make_frontend(op.name)))
         if name.startswith('random_'):
             setattr(random, name[len('random_'):], staticmethod(
+                _reg.make_frontend(op.name)))
+        if name.startswith('fft_'):
+            setattr(fft, name[len('fft_'):], staticmethod(
                 _reg.make_frontend(op.name)))
     from ..ops.random_ops import seed as _seed
     random.seed = staticmethod(_seed)

@@ -23,4 +23,5 @@ from . import random_ops    # noqa: F401
 from . import nn            # noqa: F401
 from . import contrib       # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import fft_ops       # noqa: F401
 from . import quantization_ops  # noqa: F401

@@ -173,7 +173,7 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
     valid = scores_s > valid_thresh
 
     def body(i, keep):
-        sup = (iou[..., i, :] > overlap_thresh) & keep[..., i:i + 1] & \
+        sup = (iou[..., i, :] > overlap_thresh) & keep[..., i][..., None] & \
             (jnp.arange(n) > i)
         return keep & ~sup
 
@@ -327,3 +327,220 @@ def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
         if g.ndim > 1 else history + g * g
     w = weight - lr * g / (jnp.sqrt(hist) + epsilon)
     return w, hist
+
+
+# ------------------------------------------------------- SSD multibox family
+
+@register('multibox_prior', differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor-box generation (reference
+    src/operator/contrib/multibox_prior.cc). data: (N, C, H, W) feature
+    map; output (1, H*W*A, 4) corner boxes, A = len(sizes)+len(ratios)-1.
+    Pure index arithmetic — XLA constant-folds it into the graph."""
+    h, w = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing='ij')          # (H, W)
+
+    ws, hs = [], []
+    for s in sizes:                       # first ratio with every size
+        r = ratios[0] ** 0.5
+        ws.append(s * r)
+        hs.append(s / r)
+    for r in ratios[1:]:                  # first size with remaining ratios
+        rr = r ** 0.5
+        ws.append(sizes[0] * rr)
+        hs.append(sizes[0] / rr)
+    ws = jnp.asarray(ws, jnp.float32) / 2                    # (A,)
+    hs = jnp.asarray(hs, jnp.float32) / 2
+
+    cxg = cxg[..., None]                                     # (H, W, 1)
+    cyg = cyg[..., None]
+    boxes = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs], axis=-1)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _corner_to_center(b):
+    w = b[..., 2] - b[..., 0]
+    h = b[..., 3] - b[..., 1]
+    return (b[..., 0] + w / 2, b[..., 1] + h / 2, w, h)
+
+
+@register('multibox_target', differentiable=False, n_out=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training-target encoder (reference
+    src/operator/contrib/multibox_target.cc). anchor: (1, A, 4) corners;
+    label: (N, M, 5) [cls, xmin, ymin, xmax, ymax], cls<0 = padding.
+    Returns (loc_target (N, A*4), loc_mask (N, A*4), cls_target (N, A)) —
+    cls_target 0 is background, gt class ids shifted by +1.
+
+    Matching is the reference's two-stage rule: each gt grabs its best
+    anchor, then every anchor with best-gt IOU > threshold joins; all
+    vectorized (argmax + where), no data-dependent loops.
+    """
+    A = anchor.shape[1]
+    anchors = anchor[0]                                     # (A, 4)
+    cls_id = label[..., 0]                                  # (N, M)
+    gt = label[..., 1:5]                                    # (N, M, 4)
+    valid = cls_id >= 0                                     # (N, M)
+
+    iou = box_iou(anchors[None], gt)                        # (N, A, M)
+    iou = jnp.where(valid[:, None, :], iou, 0.0)
+
+    best_gt = jnp.argmax(iou, axis=2)                       # (N, A)
+    best_gt_iou = jnp.max(iou, axis=2)                      # (N, A)
+    # stage 1: force-match each valid gt's best anchor
+    best_anchor = jnp.argmax(iou, axis=1)                   # (N, M)
+    N, M = cls_id.shape
+    forced = jnp.zeros((N, A), bool)
+    bidx = jnp.arange(N)[:, None].repeat(M, 1)
+    forced = forced.at[bidx, best_anchor].max(valid)
+    forced_gt = jnp.full((N, A), 0)
+    forced_gt = forced_gt.at[bidx, best_anchor].set(
+        jnp.where(valid, jnp.arange(M)[None, :].repeat(N, 0), 0))
+    # stage 2: threshold matches
+    matched = forced | (best_gt_iou > overlap_threshold)
+    gt_idx = jnp.where(forced, forced_gt, best_gt)          # (N, A)
+
+    mg = jnp.take_along_axis(gt, gt_idx[..., None], axis=1)  # (N, A, 4)
+    acx, acy, aw, ah = _corner_to_center(anchors[None])
+    gcx, gcy, gw, gh = _corner_to_center(mg)
+    tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+    ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1]
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-8), 1e-8)) / variances[2]
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-8), 1e-8)) / variances[3]
+    loc = jnp.stack([tx, ty, tw, th], axis=-1)              # (N, A, 4)
+    loc_target = jnp.where(matched[..., None], loc, 0.0).reshape(N, A * 4)
+    loc_mask = jnp.where(matched[..., None],
+                         jnp.ones_like(loc), 0.0).reshape(N, A * 4)
+
+    mcls = jnp.take_along_axis(cls_id, gt_idx, axis=1)      # (N, A)
+    cls_target = jnp.where(matched, mcls + 1, 0.0)
+    return loc_target, loc_mask, cls_target
+
+
+@register('multibox_detection', differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD decode + per-class NMS (reference
+    src/operator/contrib/multibox_detection.cc). cls_prob: (N, C, A);
+    loc_pred: (N, A*4); anchor: (1, A, 4). Output (N, A, 6):
+    [cls_id, score, xmin, ymin, xmax, ymax], suppressed rows cls_id=-1.
+    """
+    N, C, A = cls_prob.shape
+    acx, acy, aw, ah = _corner_to_center(anchor[0][None])   # (1, A)
+    loc = loc_pred.reshape(N, A, 4)
+    cx = loc[..., 0] * variances[0] * aw + acx
+    cy = loc[..., 1] * variances[1] * ah + acy
+    w = jnp.exp(loc[..., 2] * variances[2]) * aw / 2
+    h = jnp.exp(loc[..., 3] * variances[3]) * ah / 2
+    boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+
+    fg = jnp.delete(cls_prob, background_id, axis=1,
+                    assume_unique_indices=True)
+    scores = jnp.max(fg, axis=1)
+    ids = jnp.argmax(fg, axis=1)      # 0-based foreground class id, as in
+    keep = scores > threshold         # the reference's output convention
+    data = jnp.concatenate([
+        jnp.where(keep, ids.astype(jnp.float32), -1.0)[..., None],
+        jnp.where(keep, scores, -1.0)[..., None], boxes], axis=-1)
+    out = box_nms(data, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                  topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                  force_suppress=force_suppress)
+    # reference convention: invalid/suppressed rows carry class id -1
+    return out.at[..., 0].set(jnp.where(out[..., 1] < 0, -1.0, out[..., 0]))
+
+
+@register('proposal', differentiable=False, aliases=('Proposal',))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """Faster-RCNN RPN proposals (reference
+    src/operator/contrib/proposal.cc). cls_prob: (N, 2A, H, W);
+    bbox_pred: (N, 4A, H, W); im_info: (N, 3) [height, width, scale].
+    Static-shape TPU design: instead of the reference's dynamic pre/post-NMS
+    top-k copies, scores are sorted once and NMS runs over the fixed
+    rpn_post_nms_top_n best anchors; output (N, post_nms_top_n, 5)
+    [batch_idx, x1, y1, x2, y2].
+    """
+    N, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    if A != len(scales) * len(ratios):
+        raise ValueError(
+            f'cls_prob implies {A} anchors/cell but scales×ratios gives '
+            f'{len(scales) * len(ratios)}')
+    base = float(feature_stride)
+    # base anchors centered at (stride-1)/2, cuda-impl convention
+    ctr = (base - 1) / 2
+    ws, hs = [], []
+    for r in ratios:
+        size = base * base / r
+        w0 = jnp.round(jnp.sqrt(size))
+        h0 = jnp.round(w0 * r)
+        for s in scales:
+            ws.append(w0 * s)
+            hs.append(h0 * s)
+    ws = jnp.asarray(ws, jnp.float32)
+    hs = jnp.asarray(hs, jnp.float32)
+    base_anchors = jnp.stack([ctr - (ws - 1) / 2, ctr - (hs - 1) / 2,
+                              ctr + (ws - 1) / 2, ctr + (hs - 1) / 2], -1)
+
+    sx = jnp.arange(W, dtype=jnp.float32) * base
+    sy = jnp.arange(H, dtype=jnp.float32) * base
+    syg, sxg = jnp.meshgrid(sy, sx, indexing='ij')
+    shifts = jnp.stack([sxg, syg, sxg, syg], axis=-1)        # (H, W, 4)
+    anchors = (shifts[:, :, None, :] + base_anchors[None, None]
+               ).reshape(-1, 4)                              # (H*W*A, 4)
+
+    scores = cls_prob[:, A:].transpose(0, 2, 3, 1).reshape(N, -1)
+    deltas = bbox_pred.transpose(0, 2, 3, 1).reshape(N, -1, 4)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + 0.5 * (aw - 1)
+    acy = anchors[:, 1] + 0.5 * (ah - 1)
+    cx = deltas[..., 0] * aw + acx
+    cy = deltas[..., 1] * ah + acy
+    pw = jnp.exp(deltas[..., 2]) * aw
+    ph = jnp.exp(deltas[..., 3]) * ah
+    props = jnp.stack([cx - 0.5 * (pw - 1), cy - 0.5 * (ph - 1),
+                       cx + 0.5 * (pw - 1), cy + 0.5 * (ph - 1)], -1)
+    imh = im_info[:, 0][:, None]
+    imw = im_info[:, 1][:, None]
+    props = jnp.stack([jnp.clip(props[..., 0], 0, imw - 1),
+                       jnp.clip(props[..., 1], 0, imh - 1),
+                       jnp.clip(props[..., 2], 0, imw - 1),
+                       jnp.clip(props[..., 3], 0, imh - 1)], -1)
+    min_size = rpn_min_size * im_info[:, 2][:, None]
+    pw = props[..., 2] - props[..., 0] + 1
+    ph = props[..., 3] - props[..., 1] + 1
+    scores = jnp.where((pw >= min_size) & (ph >= min_size), scores, -1.0)
+
+    k = min(rpn_post_nms_top_n, scores.shape[1])
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    top_props = jnp.take_along_axis(props, top_idx[..., None], axis=1)
+    data = jnp.concatenate([jnp.zeros_like(top_scores)[..., None],
+                            top_scores[..., None], top_props], axis=-1)
+    kept = box_nms(data, overlap_thresh=threshold, valid_thresh=0.0,
+                   coord_start=2, score_index=1, id_index=-1,
+                   force_suppress=True)
+    batch_idx = jnp.arange(N, dtype=jnp.float32)[:, None, None]
+    rois = jnp.concatenate(
+        [jnp.broadcast_to(batch_idx, (N, k, 1)), kept[..., 2:6]], axis=-1)
+    if output_score:
+        return rois, kept[..., 1:2]
+    return rois

@@ -1,0 +1,118 @@
+"""FFT namespaces and the SSD/RCNN detection op family."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+npx = mx.npx
+
+
+# ---------------------------------------------------------------------- fft
+
+def test_np_fft_parity():
+    x = np.random.uniform(size=16).astype('f')
+    got = mx.np.fft.fft(mx.np.array(x))
+    want = np.fft.fft(x)
+    assert_almost_equal(got.asnumpy().real, want.real, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(got.asnumpy().imag, want.imag, rtol=1e-4, atol=1e-4)
+
+
+def test_np_fft_rfft_irfft_roundtrip():
+    x = np.random.uniform(size=(3, 16)).astype('f')
+    spec = mx.np.fft.rfft(mx.np.array(x))
+    back = mx.np.fft.irfft(spec, n=16)
+    assert_almost_equal(back, x, rtol=1e-4, atol=1e-5)
+
+
+def test_np_fft2_and_shift():
+    x = np.random.uniform(size=(4, 4)).astype('f')
+    got = mx.np.fft.fftshift(mx.np.fft.fft2(mx.np.array(x)))
+    want = np.fft.fftshift(np.fft.fft2(x))
+    assert_almost_equal(np.abs(got.asnumpy()), np.abs(want),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_contrib_fft_interleaved_roundtrip():
+    x = np.random.uniform(size=(2, 8)).astype('f')
+    spec = npx.contrib_fft(mx.np.array(x))
+    assert spec.shape == (2, 16)
+    # interleaved layout: even slots real, odd slots imag
+    want = np.fft.fft(x)
+    assert_almost_equal(spec.asnumpy()[:, 0::2], want.real.astype('f'),
+                        rtol=1e-4, atol=1e-4)
+    assert_almost_equal(spec.asnumpy()[:, 1::2], want.imag.astype('f'),
+                        rtol=1e-4, atol=1e-4)
+    # unnormalized inverse (cuFFT convention): scale by 1/n
+    back = npx.contrib_ifft(spec)
+    assert_almost_equal(back.asnumpy() / 8.0, x, rtol=1e-4, atol=1e-4)
+
+
+# ----------------------------------------------------------------- multibox
+
+def test_multibox_prior_shapes_and_centers():
+    data = mx.np.zeros((1, 3, 4, 4))
+    boxes = npx.multibox_prior(data, sizes=(0.5, 0.25), ratios=(1, 2))
+    A = 2 + 2 - 1
+    assert boxes.shape == (1, 4 * 4 * A, 4)
+    b = boxes.asnumpy()[0].reshape(4, 4, A, 4)
+    # first anchor at cell (0,0): size .5, centered at (.125, .125)
+    assert_almost_equal(b[0, 0, 0], np.array([.125 - .25, .125 - .25,
+                                              .125 + .25, .125 + .25], 'f'),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_target_matches_obvious_gt():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], 'f')
+    # one gt box exactly equal to anchor 1, class 3
+    label = np.array([[[3, 0.5, 0.5, 1.0, 1.0],
+                       [-1, 0, 0, 0, 0]]], 'f')
+    cls_pred = np.zeros((1, 5, 3), 'f')
+    loc_t, loc_m, cls_t = npx.multibox_target(
+        mx.np.array(anchors), mx.np.array(label), mx.np.array(cls_pred))
+    ct = cls_t.asnumpy()[0]
+    assert ct[1] == 4.0          # class 3 shifted by +1
+    assert ct[0] == 0.0 and ct[2] == 0.0
+    lm = loc_m.asnumpy()[0].reshape(3, 4)
+    assert lm[1].sum() == 4 and lm[0].sum() == 0
+    lt = loc_t.asnumpy()[0].reshape(3, 4)
+    assert_almost_equal(lt[1], np.zeros(4), atol=1e-5)  # perfect match
+
+
+def test_multibox_detection_decodes_and_suppresses():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.11, 0.1, 0.41, 0.4],
+                         [0.6, 0.6, 0.9, 0.9]]], 'f')
+    # class probs: background, c0, c1 — anchors 0/1 are class 0, 2 is c1
+    cls_prob = np.array([[[0.1, 0.2, 0.8],
+                          [0.8, 0.7, 0.1],
+                          [0.1, 0.1, 0.1]]], 'f')
+    loc_pred = np.zeros((1, 12), 'f')
+    out = npx.multibox_detection(mx.np.array(cls_prob),
+                                 mx.np.array(loc_pred),
+                                 mx.np.array(anchors), threshold=0.2,
+                                 nms_threshold=0.5).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    # anchor 1 suppressed by anchor 0 (same class, IOU≈0.94); anchor 2
+    # dropped by the score threshold — only the 0.8 detection survives
+    assert len(kept) == 1
+    assert abs(kept[0, 1] - 0.8) < 1e-5
+    assert_almost_equal(kept[0, 2:], anchors[0, 0], rtol=1e-4, atol=1e-5)
+
+
+def test_proposal_shapes():
+    N, A, H, W = 1, 9, 4, 4
+    rng = np.random.default_rng(0)
+    cls_prob = rng.uniform(size=(N, 2 * A, H, W)).astype('f')
+    bbox_pred = (rng.standard_normal((N, 4 * A, H, W)) * 0.1).astype('f')
+    im_info = np.array([[64.0, 64.0, 1.0]], 'f')
+    rois = npx.proposal(mx.np.array(cls_prob), mx.np.array(bbox_pred),
+                        mx.np.array(im_info), rpn_post_nms_top_n=20,
+                        scales=(8, 16, 32), feature_stride=16)
+    assert rois.shape == (1, 20, 5)
+    r = rois.asnumpy()
+    assert (r[..., 0] == 0).all()                 # batch index column
+    assert (r[..., 1:] >= -1).all() and (r[..., 1:] <= 64).all()
