@@ -55,6 +55,7 @@ from . import image
 from . import parallel
 from . import amp
 from . import quantization
+from . import contrib
 from . import test_utils
 from . import util
 from . import callback

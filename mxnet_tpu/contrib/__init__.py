@@ -1,0 +1,17 @@
+"""``mx.contrib`` — experimental/auxiliary subpackages.
+
+Reference: ``python/mxnet/contrib/`` (ONNX converters, tensorboard bridge,
+text embeddings, AMP — SURVEY §2.2 contrib row). AMP lives at
+``mxnet_tpu.amp``; ONNX here. Submodules import lazily so the core package
+doesn't pay for them.
+"""
+
+import importlib as _importlib
+
+_SUBMODULES = ('onnx', 'tensorboard', 'text')
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return _importlib.import_module(f'.{name}', __name__)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,12 @@
+"""``mx.contrib.onnx`` — ONNX export/import.
+
+Reference: ``python/mxnet/contrib/onnx/`` (mx2onnx + onnx2mx, SURVEY §2.2).
+Self-contained: the ONNX IR protobuf subset is vendored (onnx_ir.proto,
+field numbers matching the public spec) so no ``onnx`` package is needed;
+exported files open in standard ONNX tooling (netron, onnxruntime).
+"""
+
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+__all__ = ['export_model', 'import_model']

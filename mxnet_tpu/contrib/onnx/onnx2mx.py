@@ -1,0 +1,245 @@
+"""ONNX → Symbol import.
+
+Reference: ``python/mxnet/contrib/onnx/onnx2mx`` (import_model → (sym,
+arg_params, aux_params)). Parses the vendored ONNX IR protobuf and rebuilds
+the graph as registry-op Symbol nodes; initializers become parameter
+NDArrays.
+"""
+
+import numpy as _np
+
+from . import onnx_ir_pb2 as _pb
+
+_NP_DTYPE = {
+    1: 'float32', 2: 'uint8', 3: 'int8', 4: 'uint16', 5: 'int16',
+    6: 'int32', 7: 'int64', 9: 'bool', 10: 'float16', 11: 'float64',
+    12: 'uint32', 13: 'uint64',
+}
+
+
+def _tensor_to_np(t):
+    dtype = _np.dtype(_NP_DTYPE[t.data_type])
+    if t.raw_data:
+        arr = _np.frombuffer(t.raw_data, dtype=dtype)
+    elif t.float_data:
+        arr = _np.asarray(list(t.float_data), _np.float32).astype(dtype)
+    elif t.int64_data:
+        arr = _np.asarray(list(t.int64_data), _np.int64).astype(dtype)
+    elif t.int32_data:
+        arr = _np.asarray(list(t.int32_data), _np.int32).astype(dtype)
+    elif t.double_data:
+        arr = _np.asarray(list(t.double_data), _np.float64).astype(dtype)
+    else:
+        arr = _np.zeros(0, dtype)
+    return arr.reshape(tuple(t.dims))
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        T = _pb.AttributeProto
+        if a.type == T.INT:
+            out[a.name] = int(a.i)
+        elif a.type == T.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == T.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == T.INTS:
+            out[a.name] = tuple(int(v) for v in a.ints)
+        elif a.type == T.FLOATS:
+            out[a.name] = tuple(float(v) for v in a.floats)
+        elif a.type == T.TENSOR:
+            out[a.name] = _tensor_to_np(a.t)
+    return out
+
+
+def _unpads(pads, default):
+    if not pads:
+        return default
+    half = len(pads) // 2
+    begin, end = pads[:half], pads[half:]
+    if tuple(begin) != tuple(end):
+        raise NotImplementedError(f'asymmetric pads {pads} unsupported')
+    return tuple(begin)
+
+
+class _Importer:
+    def __init__(self):
+        self.env = {}          # onnx name -> Symbol or np constant
+        self.consts = {}       # names backed by initializers (np arrays)
+
+    def sym(self, name):
+        v = self.env[name]
+        if isinstance(v, _np.ndarray):
+            from ...symbol import var
+            s = var(name)
+            self.env[name] = s
+            return s
+        return v
+
+    def const(self, name):
+        """Initializer value as a host array (for shape/axes operands)."""
+        v = self.consts.get(name, self.env.get(name))
+        if not isinstance(v, _np.ndarray):
+            raise NotImplementedError(
+                f'operand {name!r} must be a constant initializer')
+        return v
+
+
+def _invoke(op, args, kwargs):
+    from ...symbol.symbol import _symbol_invoke_name
+    return _symbol_invoke_name(op, args, kwargs)
+
+
+def _import_node(imp, node):
+    at = _attrs(node)
+    ins = list(node.input)
+    op = node.op_type
+
+    def S(i):
+        return imp.sym(ins[i])
+
+    if op == 'Conv':
+        kernel = at['kernel_shape']
+        kw = dict(kernel=tuple(kernel),
+                  stride=tuple(at.get('strides') or (1,) * len(kernel)),
+                  dilate=tuple(at.get('dilations') or (1,) * len(kernel)),
+                  pad=_unpads(at.get('pads'), (0,) * len(kernel)),
+                  num_group=at.get('group', 1),
+                  no_bias=len(ins) < 3)
+        args = [S(0), S(1)] + ([S(2)] if len(ins) > 2 else [])
+        return _invoke('convolution', args, kw)
+    if op == 'ConvTranspose':
+        kernel = at['kernel_shape']
+        kw = dict(kernel=tuple(kernel),
+                  stride=tuple(at.get('strides') or (1,) * len(kernel)),
+                  pad=_unpads(at.get('pads'), (0,) * len(kernel)),
+                  num_group=at.get('group', 1), no_bias=len(ins) < 3)
+        args = [S(0), S(1)] + ([S(2)] if len(ins) > 2 else [])
+        return _invoke('deconvolution', args, kw)
+    if op == 'Gemm':
+        if at.get('transA') or not at.get('transB'):
+            raise NotImplementedError('Gemm only as FC (transB=1)')
+        return _invoke('fully_connected', [S(0), S(1), S(2)],
+                       dict(no_bias=False, flatten=False))
+    if op == 'MatMul':
+        return _invoke('matmul', [S(0), S(1)], {})
+    if op == 'BatchNormalization':
+        return _invoke('batch_norm_inference',
+                       [S(0), S(1), S(2), S(3), S(4)],
+                       dict(eps=at.get('epsilon', 1e-5), axis=1))
+    if op == 'LayerNormalization':
+        return _invoke('layer_norm', [S(0), S(1), S(2)],
+                       dict(axis=at.get('axis', -1),
+                            eps=at.get('epsilon', 1e-5)))
+    if op in ('MaxPool', 'AveragePool', 'GlobalMaxPool', 'GlobalAveragePool'):
+        if op.startswith('Global'):
+            return _invoke('pooling', [S(0)], dict(
+                pool_type='max' if 'Max' in op else 'avg',
+                global_pool=True, kernel=(1, 1)))
+        kernel = at['kernel_shape']
+        return _invoke('pooling', [S(0)], dict(
+            kernel=tuple(kernel), pool_type='max' if op == 'MaxPool'
+            else 'avg',
+            stride=tuple(at.get('strides') or (1,) * len(kernel)),
+            pad=_unpads(at.get('pads'), (0,) * len(kernel)),
+            pooling_convention='full' if at.get('ceil_mode') else 'valid',
+            count_include_pad=bool(at.get('count_include_pad', 1))))
+    if op == 'Flatten':
+        return _invoke('flatten', [S(0)], {})
+    if op == 'Reshape':
+        shape = tuple(int(v) for v in imp.const(ins[1]))
+        return _invoke('reshape', [S(0), shape], {})
+    if op == 'Transpose':
+        return _invoke('transpose', [S(0)],
+                       dict(axes=tuple(at['perm'])) if 'perm' in at else {})
+    if op == 'Unsqueeze':
+        axes = (tuple(int(v) for v in imp.const(ins[1]))
+                if len(ins) > 1 else at.get('axes'))
+        return _invoke('expand_dims', [S(0)], dict(axis=int(axes[0])))
+    if op == 'Squeeze':
+        axes = (tuple(int(v) for v in imp.const(ins[1]))
+                if len(ins) > 1 else at.get('axes'))
+        return _invoke('squeeze', [S(0)],
+                       dict(axis=axes if axes is None else tuple(axes)))
+    if op == 'Concat':
+        return _invoke('concat', [imp.sym(i) for i in ins],
+                       dict(axis=at.get('axis', 0)))
+    if op == 'Gather':
+        if at.get('axis', 0) != 0:
+            raise NotImplementedError('Gather only on axis 0')
+        return _invoke('embedding', [S(1), S(0)], {})
+    if op == 'Cast':
+        return _invoke('cast', [S(0)],
+                       dict(dtype=_NP_DTYPE[at['to']]))
+    if op in ('Dropout', 'Identity'):
+        return S(0)
+    if op == 'Softmax':
+        return _invoke('softmax', [S(0)], dict(axis=at.get('axis', -1)))
+    if op == 'LogSoftmax':
+        return _invoke('log_softmax', [S(0)], dict(axis=at.get('axis', -1)))
+    if op == 'ReduceMean':
+        return _invoke('mean', [S(0)], dict(
+            axis=tuple(at['axes']) if 'axes' in at else None,
+            keepdims=bool(at.get('keepdims', 1))))
+    if op == 'ReduceSum':
+        axes = (tuple(int(v) for v in imp.const(ins[1]))
+                if len(ins) > 1 else at.get('axes'))
+        return _invoke('sum', [S(0)], dict(
+            axis=axes, keepdims=bool(at.get('keepdims', 1))))
+    binary = {'Add': 'add', 'Sub': 'subtract', 'Mul': 'multiply',
+              'Div': 'true_divide', 'Pow': 'power', 'Max': 'maximum',
+              'Min': 'minimum'}
+    if op in binary:
+        return _invoke(binary[op], [S(0), S(1)], {})
+    unary = {'Relu': 'relu', 'Sigmoid': 'sigmoid', 'Tanh': 'tanh',
+             'Exp': 'exp', 'Log': 'log', 'Sqrt': 'sqrt', 'Abs': 'abs',
+             'Neg': 'negative', 'Erf': 'erf', 'Floor': 'floor',
+             'Ceil': 'ceil'}
+    if op in unary:
+        return _invoke(unary[op], [S(0)], {})
+    raise NotImplementedError(f'no import converter for ONNX op {op!r}')
+
+
+def import_model(model_file):
+    """Load an ONNX file → (sym, arg_params, aux_params).
+
+    Mirrors the reference ``onnx_mxnet.import_model``
+    (python/mxnet/contrib/onnx/onnx2mx/import_model.py). aux_params is
+    always empty: BN running stats import as plain arguments here.
+    """
+    from ...ndarray.ndarray import array
+    from ...symbol import Group, var
+
+    model = _pb.ModelProto()
+    with open(model_file, 'rb') as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+
+    imp = _Importer()
+    arg_params = {}
+    for t in g.initializer:
+        arr = _tensor_to_np(t)
+        imp.env[t.name] = arr
+        imp.consts[t.name] = arr
+    for vi in g.input:
+        if vi.name not in imp.env:
+            imp.env[vi.name] = var(vi.name)
+
+    for node in g.node:
+        out = _import_node(imp, node)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for name, s in zip(node.output, outs):
+            imp.env[name] = s
+
+    # initializers referenced as graph tensors become params; the import
+    # may have turned some into symbol vars lazily (imp.sym)
+    for name, arr in imp.consts.items():
+        from ...symbol import Symbol
+        if isinstance(imp.env[name], Symbol):
+            arg_params[name] = array(
+                arr.astype(_np.float32) if arr.dtype == _np.float64 else arr)
+
+    outs = [imp.sym(o.name) for o in g.output]
+    sym = outs[0] if len(outs) == 1 else Group(outs)
+    return sym, arg_params, {}

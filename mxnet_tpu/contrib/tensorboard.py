@@ -1,0 +1,47 @@
+"""TensorBoard bridge (reference python/mxnet/contrib/tensorboard.py).
+
+Gated on a TensorBoard writer implementation being installed
+(``tensorboardX`` or ``torch.utils.tensorboard``); the environment bakes
+torch-cpu in, so the torch writer is the default path.
+"""
+
+
+def _make_writer(logging_dir):
+    try:
+        from tensorboardX import SummaryWriter         # pragma: no cover
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError as e:                           # pragma: no cover
+        raise ImportError(
+            'LogMetricsCallback requires tensorboardX or torch '
+            f'(torch.utils.tensorboard): {e}')
+
+
+class LogMetricsCallback:
+    """Log training metrics each batch (reference tensorboard.py:28
+    LogMetricsCallback). Use as a batch-end callback:
+
+        cb = LogMetricsCallback('logs/train')
+        # in the loop: cb(BatchEndParam(epoch, nbatch, eval_metric, ...))
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f'{self.prefix}-{name}'
+            self.summary_writer.add_scalar(name, value, self.step)
+
+    def close(self):
+        self.summary_writer.close()

@@ -1,0 +1,13 @@
+"""``mx.contrib.text`` — vocabulary + token embeddings.
+
+Reference: ``python/mxnet/contrib/text/`` (vocab.py, embedding.py,
+utils.py). Pretrained-embedding *downloads* are gated (this environment has
+no egress); loading from a local GloVe/fastText-format file works.
+"""
+
+from . import utils
+from .vocab import Vocabulary
+from .embedding import TokenEmbedding, CustomEmbedding, get_pretrained_file_names
+
+__all__ = ['Vocabulary', 'TokenEmbedding', 'CustomEmbedding', 'utils',
+           'get_pretrained_file_names']

@@ -1,0 +1,149 @@
+"""Token embeddings (reference python/mxnet/contrib/text/embedding.py).
+
+Loads GloVe/fastText text-format embedding files into an index + matrix and
+joins them with a :class:`~mxnet_tpu.contrib.text.vocab.Vocabulary`.
+Pretrained *downloads* are gated: this environment has no egress, so
+``create(...)`` raises with instructions unless the file is already local.
+"""
+
+import io
+import logging
+import os
+
+import numpy as _np
+
+# canonical pretrained file names per source (reference embedding.py keeps
+# the same registry for its download helper)
+_PRETRAINED = {
+    'glove': ['glove.6B.50d.txt', 'glove.6B.100d.txt', 'glove.6B.200d.txt',
+              'glove.6B.300d.txt', 'glove.42B.300d.txt',
+              'glove.840B.300d.txt'],
+    'fasttext': ['wiki.simple.vec', 'wiki.en.vec', 'crawl-300d-2M.vec'],
+}
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Reference embedding.py get_pretrained_file_names."""
+    if embedding_name is None:
+        return dict(_PRETRAINED)
+    return list(_PRETRAINED[embedding_name])
+
+
+class TokenEmbedding:
+    """Base token-embedding container (reference embedding.py:63
+    _TokenEmbedding). Index 0 is the unknown token."""
+
+    def __init__(self, unknown_token='<unk>', init_unknown_vec=None):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec or _np.zeros
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None
+        self._vec_len = 0
+
+    # ------------------------------------------------------------- loading
+    def _load_embedding(self, file_path, elem_delim=' ', encoding='utf8'):
+        if not os.path.isfile(file_path):
+            raise FileNotFoundError(
+                f'{file_path} not found. Pretrained downloads are disabled '
+                'in this environment — place the embedding file locally and '
+                'pass its path.')
+        vectors = []
+        with io.open(file_path, 'r', encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2:
+                    continue                     # fastText header line
+                token, elems = parts[0], parts[1:]
+                if len(elems) <= 1:
+                    logging.warning('line %d in %s: unexpected format',
+                                    line_num, file_path)
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = len(elems)
+                    vectors.append(self._init_unknown_vec(self._vec_len))
+                if len(elems) != self._vec_len or \
+                        token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vectors.append(_np.asarray(elems, dtype=_np.float32))
+        self._idx_to_vec = _np.stack(vectors)
+
+    # -------------------------------------------------------------- lookup
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        """Full embedding matrix as mx NDArray (rows follow idx order)."""
+        from ...ndarray.ndarray import array
+        return array(self._idx_to_vec)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = []
+        for t in toks:
+            if t in self._token_to_idx:
+                idx.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                idx.append(self._token_to_idx[t.lower()])
+            else:
+                idx.append(0)
+        vecs = self._idx_to_vec[idx]
+        from ...ndarray.ndarray import array
+        out = array(vecs)
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        vals = new_vectors.asnumpy() if hasattr(new_vectors, 'asnumpy') \
+            else _np.asarray(new_vectors)
+        vals = vals.reshape(len(toks), -1)
+        for t, v in zip(toks, vals):
+            if t not in self._token_to_idx:
+                raise ValueError(f'token {t!r} is unknown')
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+    @staticmethod
+    def create(embedding_name, pretrained_file_name=None, **kwargs):
+        """Reference embedding.py create() — gated: requires the pretrained
+        file to already exist locally (no egress)."""
+        path = pretrained_file_name
+        if path is None or not os.path.isfile(path):
+            raise FileNotFoundError(
+                f'pretrained {embedding_name} file not found locally; '
+                'downloads are disabled. Known file names: '
+                f'{_PRETRAINED.get(embedding_name)}')
+        emb = CustomEmbedding(path, **kwargs)
+        return emb
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a local text file: ``token v0 v1 ... vn`` per line
+    (reference embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=' ',
+                 encoding='utf8', **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim, encoding)
+
+
+def get_vocab_embedding(vocab, embedding):
+    """Join a Vocabulary with a TokenEmbedding → (len(vocab), vec_len)
+    matrix usable to init ``gluon.nn.Embedding`` (the role of the
+    reference's composite embedding glue)."""
+    return embedding.get_vecs_by_tokens(vocab.idx_to_token).asnumpy()

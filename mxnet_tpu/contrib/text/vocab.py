@@ -1,0 +1,73 @@
+"""Vocabulary (reference python/mxnet/contrib/text/vocab.py)."""
+
+
+class Vocabulary:
+    """Indexes tokens by frequency (reference vocab.py:30 Vocabulary).
+
+    Index 0 is the unknown token; ``reserved_tokens`` follow it; the
+    remaining tokens are sorted by count (desc) then lexically.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token='<unk>', reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError('min_freq must be >= 1')
+        self._unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens or \
+                len(set(reserved_tokens)) != len(reserved_tokens):
+            raise ValueError('reserved tokens must be unique and must not '
+                             'contain the unknown token')
+        self._reserved_tokens = reserved_tokens or None
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        excluded = {self._unknown_token, *(self._reserved_tokens or [])}
+        pairs = sorted(((t, c) for t, c in counter.items()
+                        if t not in excluded),
+                       key=lambda tc: (-tc[1], tc[0]))
+        # most_freq_count counts only counter tokens — unknown/reserved are
+        # excluded from the cap (reference vocab.py semantics)
+        room = most_freq_count if most_freq_count is not None else None
+        for i, (token, count) in enumerate(pairs):
+            if count < min_freq or (room is not None and i >= room):
+                break
+            self._idx_to_token.append(token)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """tokens (str or list of str) → index/indices; unknown → 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise ValueError(f'index {i} out of vocabulary range')
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks

@@ -165,12 +165,19 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
     boxes_s = jnp.take_along_axis(boxes, order[..., None], axis=-2)
     scores_s = jnp.take_along_axis(scores, order, axis=-1)
     iou = box_iou(boxes_s, boxes_s, format=in_format)
-    if ids is not None and not force_suppress:
+    ids_s = None
+    if ids is not None:
         ids_s = jnp.take_along_axis(ids, order, axis=-1)
-        same = ids_s[..., :, None] == ids_s[..., None, :]
-        iou = jnp.where(same, iou, 0.0)
+        if not force_suppress:
+            same = ids_s[..., :, None] == ids_s[..., None, :]
+            iou = jnp.where(same, iou, 0.0)
 
     valid = scores_s > valid_thresh
+    if ids_s is not None and background_id >= 0:
+        valid = valid & (ids_s != background_id)
+    if topk > 0:
+        # only the top-k scored candidates enter NMS (reference semantics)
+        valid = valid & (jnp.arange(n) < topk)
 
     def body(i, keep):
         sup = (iou[..., i, :] > overlap_thresh) & keep[..., i][..., None] & \
@@ -181,6 +188,19 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
     out_scores = jnp.where(keep, scores_s, -1.0)
     out = jnp.take_along_axis(data, order[..., None], axis=-2)
     out = out.at[..., score_index].set(out_scores)
+    if out_format != in_format:
+        c = out[..., coord_start:coord_start + 4]
+        if out_format == 'center':
+            conv = jnp.stack([(c[..., 0] + c[..., 2]) / 2,
+                              (c[..., 1] + c[..., 3]) / 2,
+                              c[..., 2] - c[..., 0],
+                              c[..., 3] - c[..., 1]], axis=-1)
+        else:
+            conv = jnp.stack([c[..., 0] - c[..., 2] / 2,
+                              c[..., 1] - c[..., 3] / 2,
+                              c[..., 0] + c[..., 2] / 2,
+                              c[..., 1] + c[..., 3] / 2], axis=-1)
+        out = out.at[..., coord_start:coord_start + 4].set(conv)
     return out
 
 
@@ -398,15 +418,18 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
 
     best_gt = jnp.argmax(iou, axis=2)                       # (N, A)
     best_gt_iou = jnp.max(iou, axis=2)                      # (N, A)
-    # stage 1: force-match each valid gt's best anchor
+    # stage 1: force-match each valid gt's best anchor. Padding rows
+    # (cls<0) scatter to index A, which is out of range and therefore
+    # dropped — they must not clobber real matches at anchor 0.
     best_anchor = jnp.argmax(iou, axis=1)                   # (N, M)
     N, M = cls_id.shape
-    forced = jnp.zeros((N, A), bool)
+    safe_anchor = jnp.where(valid, best_anchor, A)
     bidx = jnp.arange(N)[:, None].repeat(M, 1)
-    forced = forced.at[bidx, best_anchor].max(valid)
-    forced_gt = jnp.full((N, A), 0)
-    forced_gt = forced_gt.at[bidx, best_anchor].set(
-        jnp.where(valid, jnp.arange(M)[None, :].repeat(N, 0), 0))
+    forced = jnp.zeros((N, A), bool)
+    forced = forced.at[bidx, safe_anchor].max(True, mode='drop')
+    forced_gt = jnp.zeros((N, A), jnp.int32)
+    forced_gt = forced_gt.at[bidx, safe_anchor].set(
+        jnp.arange(M, dtype=jnp.int32)[None, :].repeat(N, 0), mode='drop')
     # stage 2: threshold matches
     matched = forced | (best_gt_iou > overlap_threshold)
     gt_idx = jnp.where(forced, forced_gt, best_gt)          # (N, A)
@@ -425,6 +448,21 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
 
     mcls = jnp.take_along_axis(cls_id, gt_idx, axis=1)      # (N, A)
     cls_target = jnp.where(matched, mcls + 1, 0.0)
+
+    if negative_mining_ratio > 0:
+        # hard-negative mining (reference multibox_target.cc): rank
+        # unmatched anchors by their max foreground confidence; keep the
+        # hardest ratio×num_pos as background, set the rest to
+        # ignore_label. cls_pred: (N, C+1, A), class 0 = background.
+        probs = jax.nn.softmax(cls_pred, axis=1)
+        neg_conf = jnp.max(probs[:, 1:, :], axis=1)         # (N, A)
+        neg_conf = jnp.where(matched, -jnp.inf, neg_conf)
+        num_pos = jnp.sum(matched, axis=1, keepdims=True)   # (N, 1)
+        quota = negative_mining_ratio * num_pos
+        rank = jnp.argsort(jnp.argsort(-neg_conf, axis=1), axis=1)
+        keep_neg = (rank < quota) & ~matched
+        cls_target = jnp.where(matched | keep_neg, cls_target,
+                               ignore_label)
     return loc_target, loc_mask, cls_target
 
 

@@ -3,11 +3,17 @@
 Two surfaces, matching the reference twice over:
 
 * ``mx.np.fft.*`` — NumPy-parity complex FFTs (the reference routed these
-  to its official-numpy fallback, python/mxnet/numpy/fallback.py; here they
-  run on-device via XLA's FFT HLO).
+  to its official-numpy fallback, python/mxnet/numpy/fallback.py).
 * ``contrib_fft``/``contrib_ifft`` — the reference's GPU contrib ops
   (src/operator/contrib/fft.cc), which predate complex dtype support and
   use an interleaved real layout: last axis holds [re, im, re, im, ...].
+
+Backend note: the TPU PJRT backend in this environment reports FFT as
+UNIMPLEMENTED, so eager calls on a non-CPU device take a transparent
+host-round-trip through the CPU backend (the same storage-fallback shape
+the reference uses for GPU-unsupported sparse ops, src/common/exec_utils.h).
+Inside a TPU-jitted graph FFT remains backend-limited; trace on CPU for
+FFT-heavy graphs.
 """
 
 import jax
@@ -16,62 +22,101 @@ import jax.numpy as jnp
 from .registry import register
 
 
+def _cpu_eager(f):
+    """Run ``f`` on the CPU backend when the (concrete) inputs live on a
+    device whose platform can't lower FFT; tracers pass straight through."""
+    def wrapper(a, *args, **kw):
+        if isinstance(a, jax.core.Tracer) or not hasattr(a, 'devices'):
+            return f(a, *args, **kw)
+        plat = next(iter(a.devices())).platform
+        if plat == 'cpu':
+            return f(a, *args, **kw)
+        dev = next(iter(a.devices()))
+        cpu0 = jax.devices('cpu')[0]
+        out = f(jax.device_put(a, cpu0), *args, **kw)
+
+        def back(o):
+            # complex dtypes aren't representable on the TPU backend —
+            # complex results stay host-side (as the reference's fallback
+            # keeps unsupported storage on CPU, exec_utils.h)
+            if jnp.issubdtype(o.dtype, jnp.complexfloating):
+                return o
+            return jax.device_put(o, dev)
+
+        return jax.tree.map(back, out)
+    wrapper.__name__ = f.__name__
+    wrapper.__doc__ = f.__doc__
+    return wrapper
+
+
 @register('fft_fft')
+@_cpu_eager
 def fft_fft(a, n=None, axis=-1, norm=None):
     return jnp.fft.fft(a, n=n, axis=axis, norm=norm)
 
 
 @register('fft_ifft')
+@_cpu_eager
 def fft_ifft(a, n=None, axis=-1, norm=None):
     return jnp.fft.ifft(a, n=n, axis=axis, norm=norm)
 
 
 @register('fft_rfft')
+@_cpu_eager
 def fft_rfft(a, n=None, axis=-1, norm=None):
     return jnp.fft.rfft(a, n=n, axis=axis, norm=norm)
 
 
 @register('fft_irfft')
+@_cpu_eager
 def fft_irfft(a, n=None, axis=-1, norm=None):
     return jnp.fft.irfft(a, n=n, axis=axis, norm=norm)
 
 
 @register('fft_fft2')
+@_cpu_eager
 def fft_fft2(a, s=None, axes=(-2, -1), norm=None):
     return jnp.fft.fft2(a, s=s, axes=axes, norm=norm)
 
 
 @register('fft_ifft2')
+@_cpu_eager
 def fft_ifft2(a, s=None, axes=(-2, -1), norm=None):
     return jnp.fft.ifft2(a, s=s, axes=axes, norm=norm)
 
 
 @register('fft_fftn')
+@_cpu_eager
 def fft_fftn(a, s=None, axes=None, norm=None):
     return jnp.fft.fftn(a, s=s, axes=axes, norm=norm)
 
 
 @register('fft_ifftn')
+@_cpu_eager
 def fft_ifftn(a, s=None, axes=None, norm=None):
     return jnp.fft.ifftn(a, s=s, axes=axes, norm=norm)
 
 
 @register('fft_hfft')
+@_cpu_eager
 def fft_hfft(a, n=None, axis=-1, norm=None):
     return jnp.fft.hfft(a, n=n, axis=axis, norm=norm)
 
 
 @register('fft_ihfft')
+@_cpu_eager
 def fft_ihfft(a, n=None, axis=-1, norm=None):
     return jnp.fft.ihfft(a, n=n, axis=axis, norm=norm)
 
 
 @register('fft_fftshift', differentiable=False)
+@_cpu_eager
 def fft_fftshift(x, axes=None):
     return jnp.fft.fftshift(x, axes=axes)
 
 
 @register('fft_ifftshift', differentiable=False)
+@_cpu_eager
 def fft_ifftshift(x, axes=None):
     return jnp.fft.ifftshift(x, axes=axes)
 
@@ -101,6 +146,7 @@ def _deinterleave(x):
 
 
 @register('contrib_fft', aliases=('fft',))
+@_cpu_eager
 def contrib_fft(data, compute_size=128):
     """Reference src/operator/contrib/fft.cc _contrib_fft: real input
     (n, d) → interleaved real/imag (n, 2d). compute_size (the reference's
@@ -109,6 +155,7 @@ def contrib_fft(data, compute_size=128):
 
 
 @register('contrib_ifft', aliases=('ifft',))
+@_cpu_eager
 def contrib_ifft(data, compute_size=128):
     """Reference _contrib_ifft: interleaved (n, 2d) → real (n, d), using
     cuFFT's *unnormalized* inverse (no 1/d factor — callers rescale, as the

@@ -1,0 +1,78 @@
+"""contrib.text vocab/embedding + contrib.tensorboard bridge
+(reference tests/python/unittest/test_contrib_text.py)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_count_tokens_and_vocabulary():
+    text = mx.contrib.text
+    counter = text.utils.count_tokens_from_str(
+        'a b c \n b c c', to_lower=False)
+    assert counter == collections.Counter({'c': 3, 'b': 2, 'a': 1})
+
+    vocab = text.Vocabulary(counter, min_freq=2, unknown_token='<unk>',
+                            reserved_tokens=['<pad>'])
+    assert vocab.idx_to_token == ['<unk>', '<pad>', 'c', 'b']
+    assert vocab.to_indices(['c', 'b', 'zzz']) == [2, 3, 0]
+    assert vocab.to_tokens([1, 2]) == ['<pad>', 'c']
+    assert len(vocab) == 4
+
+
+def test_vocabulary_most_freq_count():
+    counter = collections.Counter({'w%d' % i: 10 - i for i in range(8)})
+    vocab = mx.contrib.text.Vocabulary(counter, most_freq_count=4)
+    # the cap counts only counter tokens: unk + 4 most frequent
+    assert len(vocab) == 5
+    assert vocab.idx_to_token[1] == 'w0'
+
+
+def test_custom_embedding_file(tmp_path):
+    path = tmp_path / 'emb.txt'
+    path.write_text('hello 0.1 0.2 0.3\nworld 0.4 0.5 0.6\n')
+    emb = mx.contrib.text.CustomEmbedding(str(path))
+    assert emb.vec_len == 3
+    assert len(emb) == 3            # unk + 2
+    v = emb.get_vecs_by_tokens(['hello', 'nope'])
+    assert_almost_equal(v.asnumpy()[0], np.array([0.1, 0.2, 0.3], 'f'))
+    assert_almost_equal(v.asnumpy()[1], np.zeros(3, 'f'))
+
+    emb.update_token_vectors('world', mx.np.array([1., 1., 1.]))
+    got = emb.get_vecs_by_tokens('world')
+    assert_almost_equal(got.asnumpy(), np.ones(3, 'f'))
+
+
+def test_vocab_embedding_join(tmp_path):
+    from mxnet_tpu.contrib.text.embedding import get_vocab_embedding
+    path = tmp_path / 'emb.txt'
+    path.write_text('b 1 2\nc 3 4\n')
+    emb = mx.contrib.text.CustomEmbedding(str(path))
+    vocab = mx.contrib.text.Vocabulary(collections.Counter('bbc'))
+    mat = get_vocab_embedding(vocab, emb)
+    assert mat.shape == (len(vocab), 2)
+    assert_almost_equal(mat[vocab.to_indices('c')],
+                        np.array([3., 4.], 'f'))
+
+
+def test_pretrained_registry_and_gating():
+    names = mx.contrib.text.get_pretrained_file_names('glove')
+    assert 'glove.6B.50d.txt' in names
+    with pytest.raises(FileNotFoundError):
+        mx.contrib.text.TokenEmbedding.create('glove')
+
+
+def test_tensorboard_callback(tmp_path):
+    from collections import namedtuple
+    cb = mx.contrib.tensorboard.LogMetricsCallback(str(tmp_path / 'tb'))
+    metric = mx.metric.Accuracy()
+    metric.update(mx.np.array([0, 1]), mx.np.array([[0.9, .1], [0.2, .8]]))
+    P = namedtuple('BatchEndParam', ['epoch', 'nbatch', 'eval_metric'])
+    cb(P(0, 1, metric))
+    cb.close()
+    files = list((tmp_path / 'tb').glob('events*'))
+    assert files, 'no tensorboard event file written'

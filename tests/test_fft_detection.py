@@ -116,3 +116,43 @@ def test_proposal_shapes():
     r = rois.asnumpy()
     assert (r[..., 0] == 0).all()                 # batch index column
     assert (r[..., 1:] >= -1).all() and (r[..., 1:] <= 64).all()
+
+
+def test_multibox_target_padding_does_not_clobber_anchor0():
+    # review repro: padded label rows must not steal anchor 0's match
+    anchors = np.array([[[0, 0, .5, .5], [.5, .5, 1, 1]]], 'f')
+    label = np.array([[[2, .5, .5, 1, 1],
+                       [7, 0, 0, .3, .5],
+                       [-1, 0, 0, 0, 0]]], 'f')
+    cls_pred = np.zeros((1, 9, 2), 'f')
+    _, _, cls_t = npx.multibox_target(mx.np.array(anchors),
+                                      mx.np.array(label),
+                                      mx.np.array(cls_pred))
+    assert cls_t.asnumpy()[0].tolist() == [8.0, 3.0]
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.array([[[0, 0, .5, .5], [.5, .5, 1, 1],
+                         [0, .5, .5, 1], [.5, 0, 1, .5]]], 'f')
+    label = np.array([[[1, 0, 0, .5, .5]]], 'f')
+    # cls_pred (N, C+1, A): anchor 2 is a confident false positive
+    cls_pred = np.zeros((1, 3, 4), 'f')
+    cls_pred[0, 1, 2] = 5.0
+    _, _, cls_t = npx.multibox_target(
+        mx.np.array(anchors), mx.np.array(label), mx.np.array(cls_pred),
+        negative_mining_ratio=1.0, ignore_label=-1.0)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 2.0               # matched, class 1 shifted
+    assert ct[2] == 0.0               # hardest negative kept as background
+    assert ct[1] == -1.0 and ct[3] == -1.0   # rest ignored
+
+
+def test_box_nms_topk_limits_candidates():
+    # three disjoint boxes; topk=2 must drop the lowest-scored one
+    data = np.array([[[0, 0.9, 0, 0, .1, .1],
+                      [0, 0.8, .2, .2, .3, .3],
+                      [0, 0.7, .4, .4, .5, .5]]], 'f')
+    out = npx.box_nms(mx.np.array(data), overlap_thresh=0.5, topk=2,
+                      coord_start=2, score_index=1, id_index=0)
+    scores = out.asnumpy()[0, :, 1]
+    assert (scores > 0).sum() == 2 and abs(scores[-1] + 1) < 1e-6
