@@ -1,0 +1,202 @@
+"""Per-operator forward/backward latency benchmark.
+
+Reference: ``benchmark/opperf/opperf.py`` (rule-driven per-op fwd/bwd
+latency + memory across all registered ops, SURVEY §4 "Benchmarks as
+tests"). Here: ops are pulled from the live registry, inputs come from
+category rules (CATEGORY_RULES below), timing is wall-clock around a
+``block_until_ready`` sync (JAX async dispatch ≙ the reference's engine
+push + WaitToRead).
+
+Usage:
+    python benchmark/opperf.py                     # curated default set
+    python benchmark/opperf.py --ops relu,dot     # specific ops
+    python benchmark/opperf.py --all              # everything with a rule
+    python benchmark/opperf.py --cpu --runs 20
+Output: one JSON line per op with fwd/bwd latency (ms).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+_RULES = {}
+
+
+def rule(*names, **gen):
+    for n in names:
+        _RULES[n] = gen
+
+
+def _register_rules(np_):
+    """Input-shape rules per op family (≙ benchmark/opperf/rules/)."""
+    u = lambda *s: np_.random.uniform(0.5, 1.5, s).astype('float32')  # noqa: E731
+    LARGE = (1024, 1024)
+
+    for n in ['exp', 'log', 'sqrt', 'sin', 'cos', 'tanh', 'abs', 'square',
+              'relu', 'sigmoid', 'erf', 'gelu', 'softplus', 'silu', 'sign',
+              'floor', 'ceil', 'rint', 'negative', 'reciprocal', 'cbrt',
+              'log1p', 'expm1']:
+        rule(n, args=lambda u=u: (u(*LARGE),))
+    for n in ['add', 'subtract', 'multiply', 'true_divide', 'power',
+              'maximum', 'minimum', 'hypot', 'arctan2', 'logaddexp']:
+        rule(n, args=lambda u=u: (u(*LARGE), u(*LARGE)))
+    for n in ['sum', 'mean', 'max', 'min', 'prod', 'var', 'std']:
+        rule(n, args=lambda u=u: (u(*LARGE),))
+    rule('dot', args=lambda u=u: (u(1024, 1024), u(1024, 1024)))
+    rule('matmul', args=lambda u=u: (u(32, 256, 256), u(32, 256, 256)))
+    rule('batch_dot', args=lambda u=u: (u(32, 256, 256), u(32, 256, 256)))
+    rule('einsum', args=lambda u=u: ('bij,bjk->bik', u(32, 256, 256),
+                                     u(32, 256, 256)))
+    rule('transpose', args=lambda u=u: (u(*LARGE),))
+    rule('reshape', args=lambda u=u: (u(*LARGE), (512, 2048)))
+    rule('concat', args=lambda u=u: ([u(512, 512), u(512, 512)],),
+         kwargs={'axis': 0})
+    rule('softmax', 'log_softmax', args=lambda u=u: (u(128, 1024),))
+    rule('topk', args=lambda u=u: (u(128, 1024),), kwargs={'k': 8},
+         no_grad=True)
+    rule('sort', 'argsort', args=lambda u=u: (u(128, 1024),), no_grad=True)
+    rule('argmax', 'argmin', args=lambda u=u: (u(128, 1024),), no_grad=True)
+    rule('fully_connected',
+         args=lambda u=u: (u(64, 1024), u(1024, 1024), u(1024)),
+         kwargs={'num_hidden': 1024})
+    rule('convolution',
+         args=lambda u=u: (u(32, 64, 56, 56), u(64, 64, 3, 3), u(64)),
+         kwargs={'kernel': (3, 3), 'pad': (1, 1), 'num_filter': 64})
+    rule('pooling', args=lambda u=u: (u(32, 64, 56, 56),),
+         kwargs={'kernel': (2, 2), 'stride': (2, 2), 'pool_type': 'max'})
+    rule('batch_norm_inference',
+         args=lambda u=u: (u(32, 64, 56, 56), u(64), u(64), u(64),
+                           u(64) * 0 + 1))
+    rule('layer_norm', args=lambda u=u: (u(64, 1024), u(1024), u(1024)))
+    rule('rms_norm', args=lambda u=u: (u(64, 1024), u(1024)))
+    rule('embedding', args=lambda np_=np_, u=u: (
+        np_.random.randint(0, 1000, (64, 128)).astype('float32'),
+        u(1000, 512)))
+    rule('multi_head_attention',
+         args=lambda u=u: (u(8, 512, 512), u(8, 512, 512), u(8, 512, 512)),
+         kwargs={'num_heads': 8})
+    rule('flash_attention',
+         args=lambda u=u: (u(8, 8, 512, 64), u(8, 8, 512, 64),
+                           u(8, 8, 512, 64)))
+    rule('take', args=lambda np_=np_, u=u: (
+        u(1000, 512), np_.random.randint(0, 1000, (4096,))
+        .astype('float32')))
+    rule('where', args=lambda np_=np_, u=u: (
+        (np_.random.uniform(size=LARGE) > .5), u(*LARGE), u(*LARGE)))
+    rule('cumsum', args=lambda u=u: (u(*LARGE),))
+    rule('clip', args=lambda u=u: (u(*LARGE),),
+         kwargs={'a_min': 0.7, 'a_max': 1.3})
+    rule('sgd_update', args=lambda u=u: (u(*LARGE), u(*LARGE)),
+         no_grad=True)
+    rule('adam_update',
+         args=lambda u=u: (u(*LARGE), u(*LARGE), u(*LARGE), u(*LARGE)),
+         no_grad=True)
+
+
+DEFAULT_SET = [
+    'relu', 'sigmoid', 'gelu', 'exp', 'add', 'multiply', 'sum', 'mean',
+    'dot', 'matmul', 'batch_dot', 'einsum', 'transpose', 'reshape',
+    'concat', 'softmax', 'topk', 'fully_connected', 'convolution',
+    'pooling', 'batch_norm_inference', 'layer_norm', 'embedding',
+    'multi_head_attention', 'take', 'where', 'cumsum', 'clip',
+    'sgd_update', 'adam_update',
+]
+
+
+def bench_op(mx, name, runs=10, warmup=3, backward=True):
+    import numpy as np
+    from mxnet_tpu import autograd
+
+    spec = _RULES[name]
+    raw_args = [a for a in spec['args']()]
+    args = [mx.np.array(a) if isinstance(a, np.ndarray) else a
+            for a in raw_args]
+    kwargs = spec.get('kwargs', {})
+    fn = getattr(mx.npx, name, None) or getattr(mx.np, name)
+
+    def fwd():
+        out = fn(*args, **kwargs)
+        (out[0] if isinstance(out, (tuple, list)) else out).wait_to_read()
+        return out
+
+    for _ in range(warmup):
+        fwd()
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        fwd()
+    fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+
+    bwd_ms = None
+    from mxnet_tpu.ops.registry import get_op
+    differentiable = get_op(name).differentiable and \
+        not spec.get('no_grad', False)
+    if backward and differentiable:
+        grads_on = [a for a in args if hasattr(a, 'attach_grad')]
+        for a in grads_on:
+            a.attach_grad()
+
+        def step():
+            with autograd.record():
+                out = fn(*args, **kwargs)
+                first = out[0] if isinstance(out, (tuple, list)) else out
+                loss = (first * first).sum()
+            loss.backward()
+            grads_on[0].grad.wait_to_read()
+
+        for _ in range(warmup):
+            step()
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            step()
+        bwd_ms = (time.perf_counter() - t0) / runs * 1e3
+
+    return {'op': name, 'fwd_ms': round(fwd_ms, 4),
+            'fwd_bwd_ms': round(bwd_ms, 4) if bwd_ms is not None else None}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--ops', default=None,
+                    help='comma-separated op names (default: curated set)')
+    ap.add_argument('--all', action='store_true',
+                    help='run every op with a rule')
+    ap.add_argument('--runs', type=int, default=10)
+    ap.add_argument('--warmup', type=int, default=3)
+    ap.add_argument('--no-backward', action='store_true')
+    ap.add_argument('--cpu', action='store_true')
+    args = ap.parse_args()
+
+    if args.cpu:
+        import os
+        import sys as _s
+        _s.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import _cpu_guard
+        _cpu_guard.force_cpu()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    _register_rules(np)
+
+    names = (args.ops.split(',') if args.ops
+             else sorted(_RULES) if getattr(args, 'all')
+             else DEFAULT_SET)
+    results = []
+    for name in names:
+        if name not in _RULES:
+            print(f'# no rule for op {name!r}, skipping', file=sys.stderr)
+            continue
+        try:
+            res = bench_op(mx, name, runs=args.runs, warmup=args.warmup,
+                           backward=not args.no_backward)
+        except Exception as e:   # keep sweeping (reference opperf does too)
+            res = {'op': name, 'error': f'{type(e).__name__}: {e}'}
+        results.append(res)
+        print(json.dumps(res), flush=True)
+    ok = [r for r in results if 'error' not in r]
+    print(f'# {len(ok)}/{len(results)} ops benchmarked', file=sys.stderr)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,103 @@
+"""BERT-base sentence-classification fine-tune (the GluonNLP-style loop the
+reference ecosystem used; no BERT lived in the reference repo itself —
+BASELINE.md last row).
+
+Synthetic token/label data (zero egress) through the full stack: BERTModel
+(model_zoo/bert.py) + pooled classifier head -> autograd -> Trainer with
+AdamW-style decay -> accuracy.
+
+Run: python examples/bert_finetune.py --cpu --steps 100
+"""
+
+import argparse
+import os
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--steps', type=int, default=100)
+    p.add_argument('--batch-size', type=int, default=8)
+    p.add_argument('--seq-len', type=int, default=64)
+    p.add_argument('--lr', type=float, default=5e-4)
+    p.add_argument('--layers', type=int, default=2,
+                   help='encoder layers (12 = full bert-base)')
+    p.add_argument('--dtype', default='float32')
+    p.add_argument('--cpu', action='store_true')
+    args = p.parse_args()
+
+    if args.cpu:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import _cpu_guard
+        _cpu_guard.force_cpu()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo.bert import get_bert_model
+
+    ctx = mx.current_context()
+    vocab = 1000
+    bert = get_bert_model(num_layers=args.layers, vocab_size=vocab,
+                          units=256, hidden_size=1024, num_heads=4,
+                          dropout=0.1, use_decoder=False,
+                          use_classifier=False)
+
+    class Classifier(gluon.nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.bert = bert
+            self.head = gluon.nn.Dense(2)
+
+        def forward(self, tokens, segments):
+            _, pooled = self.bert(tokens, segments)
+            return self.head(pooled)
+
+    net = Classifier()
+    net.initialize(mx.initializer.Normal(0.02), ctx=ctx)
+
+    # synthetic task: label = does the sequence contain the marker token
+    rng = np.random.default_rng(0)
+    toks = rng.integers(8, vocab, (512, args.seq_len)).astype('float32')
+    labels = (rng.uniform(size=512) > 0.5).astype('float32')
+    marker_pos = rng.integers(1, args.seq_len, 512)
+    toks[labels == 1, marker_pos[labels == 1]] = 7.0
+    segs = np.zeros_like(toks)
+
+    net(mx.np.array(toks[:1], ctx=ctx), mx.np.array(segs[:1], ctx=ctx))
+    if args.dtype != 'float32':
+        net.cast(args.dtype)
+    net.hybridize(static_alloc=True)
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'adamw',
+                            {'learning_rate': args.lr, 'wd': 0.01})
+    metric = mx.metric.Accuracy()
+
+    bs = args.batch_size
+    tic = time.time()
+    for step in range(args.steps):
+        i = (step * bs) % (512 - bs)
+        x = mx.np.array(toks[i:i + bs], ctx=ctx)
+        s = mx.np.array(segs[i:i + bs], ctx=ctx)
+        y = mx.np.array(labels[i:i + bs], ctx=ctx)
+        with autograd.record():
+            out = net(x, s)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(bs)
+        metric.update(y, out.astype('float32'))
+        if (step + 1) % 10 == 0:
+            name, acc = metric.get()
+            rate = (step + 1) * bs / (time.time() - tic)
+            print(f'step {step + 1}: {name}={acc:.3f} ({rate:.0f} '
+                  'samples/s)')
+    name, acc = metric.get()
+    print(f'final {name}={acc:.4f}')
+    assert acc > 0.6, 'fine-tune did not learn the synthetic task'
+
+
+if __name__ == '__main__':
+    main()

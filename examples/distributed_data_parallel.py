@@ -1,0 +1,91 @@
+"""SPMD data-parallel training over a device mesh (parity with reference
+example/distributed_training/cifar10_dist.py, re-designed TPU-first).
+
+Where the reference forks worker processes that push/pull through a
+parameter server (kvstore 'dist_sync'), the TPU design compiles ONE SPMD
+train step over the mesh: the batch is sharded over the 'dp' axis and XLA
+inserts the gradient all-reduce (psum over ICI). Runs on any device count —
+a TPU pod slice, or a virtual CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/distributed_data_parallel.py --devices 8
+"""
+
+import argparse
+import os
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--devices', type=int, default=8)
+    p.add_argument('--steps', type=int, default=30)
+    p.add_argument('--batch-size', type=int, default=256,
+                   help='global batch (split over dp)')
+    p.add_argument('--lr', type=float, default=0.1)
+    args = p.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if 'host_platform_device_count' not in os.environ.get('XLA_FLAGS', ''):
+        import _cpu_guard
+        _cpu_guard.force_cpu(args.devices)
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu import parallel
+
+    devices = jax.devices()[:args.devices]
+    mesh = Mesh(np.array(devices), ('dp',))
+    print(f'mesh: {len(devices)} devices over dp', file=sys.stderr)
+
+    # ------------------------------------------------- model (pure pytree)
+    rng = np.random.default_rng(0)
+    dims = [64, 128, 64, 10]
+    params = {}
+    for i, (m, n) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f'w{i}'] = jnp.asarray(
+            rng.standard_normal((m, n), dtype=np.float32) * (2 / m) ** .5)
+        params[f'b{i}'] = jnp.zeros((n,), jnp.float32)
+    params = parallel.replicate(params, mesh)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        for i in range(len(dims) - 1):
+            x = x @ p[f'w{i}'] + p[f'b{i}']
+            if i < len(dims) - 2:
+                x = jax.nn.relu(x)
+        logp = jax.nn.log_softmax(x)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+    def sgd(p, grads, state, lr):
+        new_p = {k: p[k] - lr * grads[k] for k in p}
+        return new_p, state
+
+    step = parallel.make_sharded_train_step(loss_fn, sgd, mesh)
+
+    # --------------------------------------------------------------- data
+    # synthetic 10-class blobs; each step shards the global batch over dp
+    centers = rng.standard_normal((10, dims[0])).astype('f') * 2
+    x_spec = NamedSharding(mesh, P('dp'))
+
+    opt_state = {}
+    for s in range(args.steps):
+        y = rng.integers(0, 10, args.batch_size)
+        x = (centers[y] + rng.standard_normal(
+            (args.batch_size, dims[0])).astype('f'))
+        batch = (jax.device_put(jnp.asarray(x), x_spec),
+                 jax.device_put(jnp.asarray(y, jnp.int32), x_spec))
+        params, opt_state, loss = step(params, opt_state, batch, args.lr)
+        if (s + 1) % 10 == 0:
+            print(f'step {s + 1}: loss={float(loss):.4f}')
+    assert float(loss) < 0.5, 'dp training failed to converge'
+    print('converged; gradient allreduce rode the dp axis inside one '
+          'compiled step')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,110 @@
+"""Image-classification training (parity with reference
+example/image-classification/train_*.py + benchmark_score.py).
+
+Trains any model-zoo vision net on CIFAR-10 when available under
+MXNET_HOME/datasets/cifar10, else a synthetic dataset, through the full
+stack: DataLoader -> transforms -> hybridized net -> autograd -> Trainer
+(kvstore='device') -> metric + Speedometer.
+
+Run:
+    python examples/image_classification.py --model resnet18_v1 --cpu
+    python examples/image_classification.py --model mobilenet_v2_1_0 \
+        --dtype bfloat16            # TPU path
+"""
+
+import argparse
+import os
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--model', default='resnet18_v1')
+    p.add_argument('--epochs', type=int, default=2)
+    p.add_argument('--batch-size', type=int, default=64)
+    p.add_argument('--samples', type=int, default=2048,
+                   help='synthetic dataset size')
+    p.add_argument('--image-size', type=int, default=32)
+    p.add_argument('--lr', type=float, default=0.05)
+    p.add_argument('--dtype', default='float32')
+    p.add_argument('--cpu', action='store_true')
+    args = p.parse_args()
+
+    if args.cpu:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import _cpu_guard
+        _cpu_guard.force_cpu()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    ctx = mx.current_context()
+    print(f'context: {ctx}, model: {args.model}', file=sys.stderr)
+
+    # ----------------------------------------------------------------- data
+    try:
+        train_set = gluon.data.vision.CIFAR10(train=True)
+        num_classes = 10
+        print('using CIFAR-10', file=sys.stderr)
+    except Exception:
+        rng = np.random.default_rng(0)
+        n, s = args.samples, args.image_size
+        y = rng.integers(0, 10, n)
+        x = (rng.standard_normal((n, s, s, 3)) * 0.1 +
+             y[:, None, None, None] * 0.2).astype('float32')
+        train_set = gluon.data.ArrayDataset(x, y.astype('float32'))
+        num_classes = 10
+        print('CIFAR-10 not found; synthetic dataset', file=sys.stderr)
+
+    transform = gluon.data.vision.transforms.Compose([
+        gluon.data.vision.transforms.ToTensor(),     # HWC [0,255]/float→CHW
+    ])
+    loader = gluon.data.DataLoader(
+        train_set.transform_first(transform), batch_size=args.batch_size,
+        shuffle=True, last_batch='discard')
+
+    # ---------------------------------------------------------------- model
+    net = getattr(vision, args.model)(classes=num_classes)
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    s = args.image_size
+    net(mx.np.ones((1, 3, s, s), ctx=ctx))           # materialize params
+    if args.dtype != 'float32':
+        net.cast(args.dtype)
+    net.hybridize(static_alloc=True)
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': args.lr, 'momentum': 0.9,
+                             'wd': 1e-4},
+                            kvstore='device')
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        n_seen = 0
+        for i, (x, y) in enumerate(loader):
+            x = x.as_in_context(ctx).astype(args.dtype)
+            y = y.as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update(y, out.astype('float32'))
+            n_seen += args.batch_size
+        _, acc = metric.get()
+        print(f'epoch {epoch}: accuracy={acc:.4f} '
+              f'({n_seen / (time.time() - tic):.0f} samples/s)')
+
+    name, acc = metric.get()
+    print(f'final {name}={acc:.4f}')
+    assert acc > 0.3, 'training did not learn anything'
+
+
+if __name__ == '__main__':
+    main()

@@ -141,6 +141,42 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    def __array_function__(self, func, types, args, kwargs):
+        """NumPy dispatch protocol (reference
+        python/mxnet/numpy_dispatch_protocol.py): ``numpy.mean(mx_arr)``
+        routes to the mx.np op when one is registered, else falls back to
+        official numpy on host copies (reference numpy/fallback.py)."""
+        from .. import numpy as mxnp
+
+        mxfn = getattr(mxnp, func.__name__, None)
+        if mxfn is not None and callable(mxfn):
+            try:
+                return mxfn(*args, **kwargs)
+            except TypeError:
+                pass                      # signature mismatch → fallback
+        conv = lambda x: x.asnumpy() if isinstance(x, NDArray) else x  # noqa: E731
+        args = [conv(a) for a in args]
+        kwargs = {k: conv(v) for k, v in kwargs.items()}
+        return func(*args, **kwargs)
+
+    def __array_ufunc__(self, ufunc, method, *args, **kwargs):
+        """Route numpy ufuncs (np.add(a, mx_arr), np.exp(mx_arr), ...)
+        through the op registry; non-__call__ methods (reduce, outer)
+        fall back to host numpy."""
+        from .. import numpy as mxnp
+
+        if method == '__call__' and not kwargs.get('out'):
+            mxfn = getattr(mxnp, ufunc.__name__, None)
+            if mxfn is not None and callable(mxfn):
+                try:
+                    return mxfn(*args, **kwargs)
+                except TypeError:
+                    pass
+        conv = lambda x: x.asnumpy() if isinstance(x, NDArray) else x  # noqa: E731
+        args = [conv(a) for a in args]
+        kwargs = {k: conv(v) for k, v in kwargs.items()}
+        return getattr(ufunc, method)(*args, **kwargs)
+
     def __dlpack__(self, **kwargs):
         return self._data.__dlpack__(**kwargs)
 

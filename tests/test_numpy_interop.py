@@ -1,0 +1,44 @@
+"""NumPy dispatch-protocol interoperability (reference
+tests/python/unittest/test_numpy_interoperability.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_array_function_routes_to_mx_ops():
+    x = mx.np.array(np.arange(6.0).reshape(2, 3))
+    out = np.mean(x)
+    assert isinstance(out, NDArray)
+    assert_almost_equal(out, 2.5)
+    out = np.concatenate([x, x], axis=0)
+    assert isinstance(out, NDArray) and out.shape == (4, 3)
+
+
+def test_array_function_fallback_to_numpy():
+    x = mx.np.array(np.array([3.0, 1.0, 2.0]))
+    # np.partition has no mx op — official-numpy fallback on host copies
+    out = np.partition(x, 1)
+    assert isinstance(out, np.ndarray)
+    assert out[1] == 2.0
+
+
+def test_array_ufunc_call():
+    x = mx.np.array(np.array([1.0, 2.0]))
+    out = np.add(x, 1.0)
+    assert isinstance(out, NDArray)
+    assert_almost_equal(out, np.array([2.0, 3.0]))
+    out = np.exp(x)
+    assert isinstance(out, NDArray)
+    assert_almost_equal(out, np.exp([1.0, 2.0]), rtol=1e-5, atol=1e-6)
+    # mixed operand order: numpy scalar-array first
+    out = np.multiply(np.float32(2.0), x)
+    assert_almost_equal(out, np.array([2.0, 4.0]))
+
+
+def test_array_ufunc_reduce_falls_back():
+    x = mx.np.array(np.array([1.0, 2.0, 3.0]))
+    out = np.add.reduce(x)
+    assert float(out) == 6.0
