@@ -19,6 +19,7 @@ This is the long-context flagship of the TPU build:
 """
 
 import math
+from functools import partial
 
 from jax.sharding import PartitionSpec as P
 
@@ -75,7 +76,9 @@ def _rope(x, theta, offset=0):
     import jax.numpy as jnp
     _, S, _, Dh = x.shape
     inv = 1.0 / (theta ** (jnp.arange(0, Dh, 2, dtype=jnp.float32) / Dh))
-    pos = jnp.arange(offset, offset + S, dtype=jnp.float32)
+    # offset may be a traced scalar (jitted decode step): keep the arange
+    # static and add the offset
+    pos = jnp.arange(S, dtype=jnp.float32) + offset
     ang = pos[:, None] * inv[None, :]                  # (S, Dh/2)
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
@@ -110,8 +113,15 @@ class LlamaAttention(HybridBlock):
                                flatten=False)
         self.o_proj = nn.Dense(cfg.units, use_bias=False, flatten=False)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, offset=0):
+        """cache: optional (k_cache, v_cache) raw arrays of shape
+        (B, max_len, kv_heads, dh) for incremental decode — new K/V are
+        written at ``offset`` (static-shape ``dynamic_update_slice``, the
+        TPU-idiomatic KV cache) and attention runs over the cache with an
+        absolute-position causal mask. Returns out, or (out, new_cache)."""
+        import jax
         import jax.numpy as jnp
+        from jax import lax
         from ...ndarray.ndarray import NDArray
         from ...ops.pallas.flash_attention import flash_attention
 
@@ -119,8 +129,32 @@ class LlamaAttention(HybridBlock):
         q = self.q_proj(x)._data.reshape(B, S, self._h, self._dh)
         k = self.k_proj(x)._data.reshape(B, S, self._kv, self._dh)
         v = self.v_proj(x)._data.reshape(B, S, self._kv, self._dh)
-        q = _rope(q, self._theta)
-        k = _rope(k, self._theta)
+        q = _rope(q, self._theta, offset=offset)
+        k = _rope(k, self._theta, offset=offset)
+
+        if cache is not None:
+            k_cache, v_cache = cache
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, offset, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, offset, 0, 0))
+            L = k_cache.shape[1]
+            rep = self._h // self._kv
+            kf = jnp.repeat(k_cache, rep, 2) if rep > 1 else k_cache
+            vf = jnp.repeat(v_cache, rep, 2) if rep > 1 else v_cache
+            scores = jnp.einsum(
+                'bshd,blhd->bhsl', q.astype(jnp.float32),
+                kf.astype(jnp.float32)) * (self._dh ** -0.5)
+            # query i (absolute position offset+i) sees cache slots <= it
+            qpos = offset + jnp.arange(S)[:, None]
+            mask = jnp.arange(L)[None, :] <= qpos        # (S, L)
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum('bhsl,blhd->bshd', probs,
+                             vf.astype(jnp.float32)).astype(x.dtype)
+            out = out.reshape(B, S, self._h * self._dh)
+            return self.o_proj(NDArray(out)), (k_cache, v_cache)
+
         if self._kv != self._h:
             rep = self._h // self._kv
             k = jnp.repeat(k, rep, axis=2)
@@ -158,9 +192,14 @@ class LlamaBlock(HybridBlock):
         self.post_attention_layernorm = RMSNorm(cfg.units, cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
-        return x + self.mlp(self.post_attention_layernorm(x))
+    def forward(self, x, cache=None, offset=0):
+        if cache is None:
+            x = x + self.self_attn(self.input_layernorm(x))
+            return x + self.mlp(self.post_attention_layernorm(x))
+        att, cache = self.self_attn(self.input_layernorm(x), cache=cache,
+                                    offset=offset)
+        x = x + att
+        return x + self.mlp(self.post_attention_layernorm(x)), cache
 
 
 class LlamaModel(HybridBlock):
@@ -177,11 +216,17 @@ class LlamaModel(HybridBlock):
             self.layers.append(blk)
         self.norm = RMSNorm(cfg.units, cfg.rms_norm_eps)
 
-    def forward(self, token_ids):
+    def forward(self, token_ids, caches=None, offset=0):
         x = self.embed_tokens(token_ids)
-        for blk in self.layers:
-            x = blk(x)
-        return self.norm(x)
+        if caches is None:
+            for blk in self.layers:
+                x = blk(x)
+            return self.norm(x)
+        new_caches = []
+        for blk, cache in zip(self.layers, caches):
+            x, cache = blk(x, cache=cache, offset=offset)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
 
 
 class LlamaForCausalLM(HybridBlock):
@@ -195,13 +240,115 @@ class LlamaForCausalLM(HybridBlock):
             self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
                                     flatten=False)
 
-    def forward(self, token_ids):
+    def forward(self, token_ids, caches=None, offset=0):
         from ... import np as mnp
-        h = self.model(token_ids)
+        if caches is None:
+            h = self.model(token_ids)
+        else:
+            h, caches = self.model(token_ids, caches=caches, offset=offset)
         if self.cfg.tie_word_embeddings:
             emb = self.model.embed_tokens.weight.data()
-            return mnp.matmul(h, emb.T)
-        return self.lm_head(h)
+            logits = mnp.matmul(h, emb.T)
+        else:
+            logits = self.lm_head(h)
+        return logits if caches is None else (logits, caches)
+
+    def init_caches(self, batch_size, max_length=None, dtype='float32'):
+        """Allocate per-layer KV caches: list of (k, v), each
+        (B, max_length, kv_heads, dh)."""
+        import jax.numpy as jnp
+        cfg = self.cfg
+        L = max_length or cfg.max_length
+        dh = cfg.units // cfg.num_heads
+        shape = (batch_size, L, cfg.num_kv_heads, dh)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_layers)]
+
+    def generate(self, token_ids, max_new_tokens=32, max_length=None,
+                 temperature=0.0, seed=0):
+        """Autoregressive generation with a static-shape KV cache.
+
+        TPU design: prefill is one jitted call over the whole prompt; each
+        decode step is ONE jitted call reused for every position (the
+        offset enters as a traced scalar, so there is exactly one compile
+        for the prefill shape and one for the (B, 1) decode shape — no
+        per-position retracing). Greedy when ``temperature == 0``, else
+        temperature sampling.
+
+        token_ids: (B, S) NDArray / array of prompt tokens.
+        Returns (B, S + max_new_tokens) NDArray.
+        """
+        import jax
+        import jax.numpy as jnp
+        from ... import _tape
+        from ...ndarray.ndarray import NDArray
+
+        toks = token_ids._data if isinstance(token_ids, NDArray) \
+            else jnp.asarray(token_ids)
+        toks = toks.astype(jnp.int32)
+        B, S = toks.shape
+        L = max_length or min(self.cfg.max_length, S + max_new_tokens)
+        assert S + max_new_tokens <= L, 'max_length too small'
+
+        params = self.collect_params()
+        praws = {name: p.data()._data for name, p in params.items()}
+
+        def run(praws_, tok, caches, offset):
+            saved = []
+            prev = _tape.set_recording(False)
+            try:
+                for name, p in params.items():
+                    saved.append((p, p._data))
+                    p._data = {c: NDArray(praws_[name]) for c in p._data}
+                logits, caches = self.forward(NDArray(tok), caches=caches,
+                                              offset=offset)
+                return logits._data, caches
+            finally:
+                for p, d in saved:
+                    p._data = d
+                _tape.set_recording(prev)
+
+        def pick(logits, key):
+            last = logits[:, -1, :].astype(jnp.float32)
+            if temperature <= 0.0:
+                return jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, last / temperature, axis=-1).astype(jnp.int32)
+
+        # compiled steps are cached per (batch, prompt, cache-len, greedy)
+        # so repeat generate() calls skip tracing; cache buffers are
+        # donated (≙ static_alloc's buffer reuse)
+        sig = (B, S, L, float(temperature))
+        steps = getattr(self, '_gen_steps', None)
+        if steps is None:
+            steps = self._gen_steps = {}
+        if sig in steps:
+            prefill, decode = steps[sig]
+        else:
+            @jax.jit
+            def prefill(praws_, tok, caches, key):
+                logits, caches = run(praws_, tok, caches, 0)
+                return pick(logits, key), caches
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def decode(praws_, tok, caches, offset, key):
+                logits, caches = run(praws_, tok[:, None], caches, offset)
+                return pick(logits, key), caches
+
+            steps[sig] = (prefill, decode)
+
+        key = jax.random.PRNGKey(seed)
+        caches = self.init_caches(B, L)
+        key, sub = jax.random.split(key)
+        nxt, caches = prefill(praws, toks, caches, sub)
+        out = [toks, nxt[:, None]]
+        offset = jnp.asarray(S, jnp.int32)
+        for _ in range(max_new_tokens - 1):
+            key, sub = jax.random.split(key)
+            nxt, caches = decode(praws, nxt, caches, offset, sub)
+            out.append(nxt[:, None])
+            offset = offset + 1
+        return NDArray(jnp.concatenate(out, axis=1))
 
 
 def llama_partition_rules(axis='tp'):
