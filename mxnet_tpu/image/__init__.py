@@ -90,3 +90,270 @@ def color_normalize(src, mean, std=None):
     if std is not None:
         src = src / std
     return src
+
+
+# ------------------------------------------------------------ augmenters
+# Reference image.py Augmenter classes (:585-1020) + CreateAugmenter.
+
+class Augmenter:
+    """Image augmenter base (reference image.py:585)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.random() < self.p:
+            raw = src._data if isinstance(src, NDArray) else src
+            return NDArray(raw[:, ::-1])
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ='float32'):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = array(mean) if not isinstance(mean, NDArray) else mean
+        self.std = array(std) if std is not None and \
+            not isinstance(std, NDArray) else std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self.coef = _np.array([[[0.299, 0.587, 0.114]]], 'float32')
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.contrast, self.contrast)
+        gray = (src * array(self.coef)).sum() * (3.0 / src.size)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self.coef = _np.array([[[0.299, 0.587, 0.114]]], 'float32')
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.saturation, self.saturation)
+        gray = (src * array(self.coef)).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class ColorJitterAug(SequentialAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Reference image.py:CreateAugmenter — the standard augmentation
+    pipeline factory."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_np.atleast_1d(mean)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Legacy image iterator (reference image.py:1285 ImageIter): reads
+    from a RecordIO pack (``path_imgrec``) or an image list
+    (``path_imglist`` + ``path_root``), decodes host-side, applies the
+    augmenter list, yields ``io.DataBatch`` of NCHW data.
+
+    TPU design note: this survives for API parity; the preferred input
+    path is ``gluon.data.DataLoader`` (threaded, prefetching into device
+    memory) — see mxnet_tpu/io.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root='', shuffle=False,
+                 aug_list=None, label_width=1, data_name='data',
+                 label_name='softmax_label', last_batch_handle='pad',
+                 **kwargs):
+        from ..recordio import MXIndexedRecordIO
+        assert path_imgrec or path_imglist, \
+            'ImageIter needs path_imgrec or path_imglist'
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **kwargs)
+        self._rec = None
+        self._imglist = None
+        if path_imgrec:
+            idx_path = path_imgrec[:-4] + '.idx' \
+                if path_imgrec.endswith('.rec') else path_imgrec + '.idx'
+            self._rec = MXIndexedRecordIO(idx_path, path_imgrec, 'r')
+            self._seq = list(self._rec.keys)
+        else:
+            self._imglist = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split('\t')
+                    labels = [float(v) for v in parts[1:1 + label_width]]
+                    self._imglist.append(
+                        (labels, path_root + parts[-1]))
+            self._seq = list(range(len(self._imglist)))
+        self._cur = 0
+        self.reset()
+
+    def reset(self):
+        self._cur = 0
+        if self.shuffle:
+            _np.random.shuffle(self._seq)
+
+    def next_sample(self):
+        from ..recordio import unpack_img
+        if self._cur >= len(self._seq):
+            raise StopIteration
+        idx = self._seq[self._cur]
+        self._cur += 1
+        if self._rec is not None:
+            header, img = unpack_img(self._rec.read_idx(idx))
+            return header.label, img
+        label, path = self._imglist[idx]
+        return _np.array(label), imread(path)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        from ..io import DataBatch
+        c, h, w = self.data_shape
+        data = _np.zeros((self.batch_size, h, w, c), 'float32')
+        labels = _np.zeros((self.batch_size, self.label_width), 'float32')
+        i = 0
+        pad = 0
+        while i < self.batch_size:
+            try:
+                label, img = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                break
+            if not isinstance(img, NDArray):
+                img = array(img)
+            for aug in self.auglist:
+                img = aug(img)
+            data[i] = img.asnumpy()
+            labels[i] = _np.atleast_1d(
+                label.asnumpy() if isinstance(label, NDArray) else label
+            )[:self.label_width]
+            i += 1
+        batch_data = array(data.transpose(0, 3, 1, 2))   # NCHW
+        batch_label = array(labels[:, 0] if self.label_width == 1
+                            else labels)
+        return DataBatch(data=[batch_data], label=[batch_label], pad=pad)

@@ -134,3 +134,45 @@ def test_ndarray_iter():
     assert batches[2].pad == 2
     it.reset()
     assert len(list(it)) == 3
+
+
+# ------------------------------------------------------ mx.image.ImageIter
+
+def test_image_augmenters_and_iter(tmp_path):
+    import mxnet_tpu.image as image
+    import mxnet_tpu.recordio as recordio
+
+    # pack a tiny rec file of random images
+    rec_path = str(tmp_path / 'data.rec')
+    idx_path = str(tmp_path / 'data.idx')
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, 'w')
+    rng = np.random.default_rng(0)
+    for i in range(10):
+        img = rng.integers(0, 255, (40, 36, 3)).astype('uint8')
+        hdr = recordio.IRHeader(0, float(i % 3), i, 0)
+        rec.write_idx(i, recordio.pack_img(hdr, img, img_fmt='.png'))
+    rec.close()
+
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imgrec=rec_path, shuffle=True,
+                         rand_crop=True, rand_mirror=True, mean=True,
+                         std=True)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+    n = 1 + sum(1 for _ in it)
+    assert n == 3                      # 10 imgs / batch 4 → 3 batches (pad)
+    it.reset()
+    assert next(it).data[0].shape == (4, 3, 32, 32)
+
+
+def test_create_augmenter_pipeline():
+    import mxnet_tpu.image as image
+    augs = image.CreateAugmenter((3, 24, 24), resize=26, rand_mirror=True,
+                                 brightness=0.1, mean=True, std=True)
+    img = mx.np.array(np.random.uniform(
+        0, 255, (30, 28, 3)).astype('float32'))
+    for a in augs:
+        img = a(img)
+    assert img.shape == (24, 24, 3)
+    assert abs(float(img.asnumpy().mean())) < 50     # roughly normalized
