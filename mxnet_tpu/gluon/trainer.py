@@ -15,6 +15,13 @@ from .parameter import Parameter
 from ..ndarray.ndarray import NDArray
 
 
+class _FusedUnsupported(Exception):
+    """Optimizer could not be traced into the fused update executable."""
+
+
+_FUSED_SENTINEL = object()
+
+
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore
                  ='device', compression_params=None, update_on_kvstore=None):
@@ -70,6 +77,7 @@ class Trainer:
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
         self._states = {}
+        self._fused_cache = {}
 
     def _reset_kvstore(self):
         self._kv_initialized = False
@@ -170,23 +178,129 @@ class Trainer:
                     self._kvstore.pushpull(i, grads, priority=-i)
 
     def _update(self, ignore_stale_grad=False):
-        """Reference trainer.py:444 — run optimizer per device replica."""
+        """Reference trainer.py:444 — run optimizer per device replica.
+
+        All parameter updates execute as ONE jitted call (the role of the
+        reference's fused multi-tensor kernels, optimizer_op.cc
+        multi_sgd/preloaded_multi_*): per-param eager dispatch of hundreds
+        of tiny update ops would dominate step time on TPU. Falls back to
+        the per-param loop if fused tracing fails for a custom optimizer.
+        """
         if self._update_on_kvstore:
             return  # server-side update already applied by pushpull
+        live = []
         for i, param in enumerate(self._params):
             if param.grad_req == 'null' or param._data is None:
                 continue
             if i not in self._states:
                 self._states[i] = self._optimizer.create_state_multi_precision(
                     i, param.data())
+            live.append((i, param))
+        if not live:
+            return
+        try:
+            self._fused_update(live)
+        except _FusedUnsupported:
+            for i, param in live:
+                datas = param.list_data()
+                grads = param.list_grad()
+                self._optimizer.update_multi_precision(
+                    i, datas[0], grads[0], self._states[i])
+                for d in datas[1:]:
+                    d._rebind(datas[0]._data)
+
+    # -------------------------------------------------------- fused update
+    def _fused_update(self, live):
+        import numpy as _onp
+        import jax
+        import jax.numpy as jnp
+        from .. import _tape
+
+        opt = self._optimizer
+
+        def flat_state(s):
+            if s is None:
+                return []
+            if isinstance(s, NDArray):
+                return [s._data]
+            return [e._data for e in s if isinstance(e, NDArray)]
+
+        praws = [p.list_data()[0]._data for _, p in live]
+        graws = [p.list_grad()[0]._data for _, p in live]
+        sraws = [flat_state(self._states[i]) for i, _ in live]
+
+        key = (id(opt), opt.rescale_grad, opt.clip_gradient,
+               tuple((r.shape, str(r.dtype)) for r in praws))
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            state_templates = [self._states[i] for i, _ in live]
+
+            def fused(praws_, graws_, sraws_, lrs_, wds_, ts_):
+                prev = _tape.set_recording(False)
+                try:
+                    new_ws, new_ss = [], []
+                    for j, (w, g) in enumerate(zip(praws_, graws_)):
+                        tmpl = state_templates[j]
+                        if tmpl is None:
+                            st = None
+                        elif isinstance(tmpl, NDArray):
+                            st = NDArray(sraws_[j][0])
+                        else:
+                            it = iter(sraws_[j])
+                            st = type(tmpl)(
+                                NDArray(next(it)) if isinstance(e, NDArray)
+                                else e for e in tmpl)
+                        nw, ns = opt.step(w, g, st, lrs_[j], wds_[j],
+                                          ts_[j])
+                        new_ws.append(nw)
+                        if ns is None:
+                            new_ss.append([])
+                        elif isinstance(ns, tuple):
+                            new_ss.append(list(ns))
+                        else:
+                            new_ss.append([ns])
+                    return new_ws, new_ss
+                finally:
+                    _tape.set_recording(prev)
+
+            n = len(live)
+            zeros = (jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32),
+                     jnp.zeros(n, jnp.int32))
+            try:
+                fn = jax.jit(fused)
+                # trace-check BEFORE advancing update counts so a failed
+                # optimizer falls back without double-counting
+                jax.eval_shape(fn, praws, graws, sraws, *zeros)
+            except Exception as e:
+                self._fused_cache[key] = _FUSED_SENTINEL
+                raise _FusedUnsupported(str(e))
+            self._fused_cache[key] = fn
+        elif fn is _FUSED_SENTINEL:
+            raise _FusedUnsupported('previously failed')
+
+        for i, _ in live:
+            opt._update_count(i)
+        lrs = jnp.asarray([opt._get_lr(i) for i, _ in live], jnp.float32)
+        wds = jnp.asarray([opt._get_wd(i) for i, _ in live], jnp.float32)
+        ts = jnp.asarray([opt._index_update_count[i] for i, _ in live],
+                         jnp.int32)
+        new_ws, new_ss = fn(praws, graws, sraws, lrs, wds, ts)
+        for (i, param), nw, ns in zip(live, new_ws, new_ss):
             datas = param.list_data()
-            grads = param.list_grad()
-            # after allreduce all replicas hold the same grad; update the
-            # first replica then mirror (one optimizer step per param)
-            self._optimizer.update_multi_precision(
-                i, datas[0], grads[0], self._states[i])
+            datas[0]._rebind(nw)
             for d in datas[1:]:
-                d._rebind(datas[0]._data)
+                d._rebind(nw)
+            st = self._states[i]
+            if st is None:
+                continue
+            if isinstance(st, NDArray):
+                st._rebind(ns[0])
+            else:
+                k = 0
+                for e in st:
+                    if isinstance(e, NDArray):
+                        e._rebind(ns[k])
+                        k += 1
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Manual update path (reference trainer.py:update)."""
