@@ -169,3 +169,53 @@ class NativeWriter:
             self.close()
         except Exception:
             pass
+
+
+# ------------------------------------------------------ image decode pipeline
+
+_ip_lock = threading.Lock()
+_ip_lib = None
+_ip_tried = False
+
+_IP_SRC = os.path.join(os.path.dirname(_SRC), 'imagepipe.cc')
+_IP_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       'libimagepipe.so')
+
+
+def get_imagepipe_lib():
+    """Load (building if needed) the native image pipeline; None when the
+    toolchain or libjpeg/libpng are unavailable (callers fall back to the
+    Python decode path)."""
+    global _ip_lib, _ip_tried
+    with _ip_lock:
+        if _ip_lib is not None or _ip_tried:
+            return _ip_lib
+        _ip_tried = True
+        try:
+            if not os.path.exists(_IP_OUT) or (
+                    os.path.exists(_IP_SRC) and
+                    os.path.getmtime(_IP_SRC) > os.path.getmtime(_IP_OUT)):
+                cmd = ['g++', '-O3', '-std=c++17', '-shared', '-fPIC',
+                       '-o', _IP_OUT, _IP_SRC, '-ljpeg', '-lpng',
+                       '-lpthread']
+                subprocess.run(cmd, check=True, capture_output=True)
+            lib = ctypes.CDLL(_IP_OUT)
+        except Exception as e:
+            logging.info('native image pipeline unavailable (%s); '
+                         'using Python decode path', e)
+            return None
+        c = ctypes
+        lib.ipipe_create.restype = c.c_void_p
+        lib.ipipe_create.argtypes = [
+            c.c_char_p, c.c_int64, c.c_int32, c.c_int32, c.c_int32,
+            c.c_int32, c.c_uint64, c.c_int32, c.c_int32, c.c_int32,
+            c.POINTER(c.c_float), c.POINTER(c.c_float), c.c_int32]
+        lib.ipipe_num_records.restype = c.c_int64
+        lib.ipipe_num_records.argtypes = [c.c_void_p]
+        lib.ipipe_next.restype = c.c_int64
+        lib.ipipe_next.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                                   c.POINTER(c.c_float)]
+        lib.ipipe_reset.argtypes = [c.c_void_p]
+        lib.ipipe_close.argtypes = [c.c_void_p]
+        _ip_lib = lib
+        return _ip_lib

@@ -375,3 +375,92 @@ class ThreadedRecordIter(DataIter):
 
     def close(self):
         self._reader.close()
+
+
+class ImageRecordIter(DataIter):
+    """Image-record iterator backed by the native C++ decode pipeline.
+
+    Reference: ``ImageRecordIter`` (src/io/iter_image_recordio_2.cc,
+    registered via MXNET_REGISTER_IO_ITER) — worker threads decode+augment
+    packed JPEG/PNG records straight into the batch buffer, no Python in
+    the loop. Falls back to :class:`mxnet_tpu.image.ImageIter` (host
+    cv2/PIL decode) when the native library can't build.
+
+    Augmentation: resize-short, random/center crop to ``data_shape``,
+    random mirror, mean/std normalization (the image_aug_default.cc chain).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
+                 rand_crop=False, rand_mirror=False, resize=0,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, preprocess_threads=4, seed=0, label_width=1,
+                 **kwargs):
+        from .._native import get_imagepipe_lib
+        import ctypes
+
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._fallback = None
+        lib = get_imagepipe_lib()
+        if lib is None:
+            from ..image import ImageIter
+            self._fallback = ImageIter(
+                batch_size, data_shape, path_imgrec=path_imgrec,
+                shuffle=shuffle, resize=resize, rand_crop=rand_crop,
+                rand_mirror=rand_mirror,
+                mean=_np.array([mean_r, mean_g, mean_b])
+                if (mean_r or mean_g or mean_b) else None,
+                std=_np.array([std_r, std_g, std_b])
+                if (std_r != 1 or std_g != 1 or std_b != 1) else None,
+                label_width=label_width)
+            return
+        self._lib = lib
+        c, h, w = self.data_shape
+        assert c == 3, 'native ImageRecordIter decodes RGB (c=3)'
+        mean = (ctypes.c_float * 3)(mean_r, mean_g, mean_b)
+        std = (ctypes.c_float * 3)(std_r, std_g, std_b)
+        self._h = lib.ipipe_create(
+            path_imgrec.encode(), batch_size, h, w, preprocess_threads,
+            int(shuffle), seed, int(rand_crop), int(rand_mirror),
+            int(resize), mean, std, label_width)
+        if not self._h:
+            raise IOError(f'cannot open record file {path_imgrec}')
+        self._data_buf = _np.empty((batch_size, c, h, w), _np.float32)
+        self._label_buf = _np.empty((batch_size, label_width), _np.float32)
+
+    @property
+    def num_records(self):
+        if self._fallback is not None:
+            return len(self._fallback._seq)
+        return self._lib.ipipe_num_records(self._h)
+
+    def reset(self):
+        if self._fallback is not None:
+            self._fallback.reset()
+        else:
+            self._lib.ipipe_reset(self._h)
+
+    def next(self):
+        import ctypes
+        if self._fallback is not None:
+            return self._fallback.next()
+        n = self._lib.ipipe_next(
+            self._h,
+            self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n == 0:
+            raise StopIteration
+        if n < 0:
+            raise IOError('record decode failed')
+        from ..ndarray.ndarray import array
+        data = array(self._data_buf)
+        label = array(self._label_buf[:, 0] if self.label_width == 1
+                      else self._label_buf)
+        return DataBatch(data=[data], label=[label],
+                         pad=self.batch_size - int(n))
+
+    def close(self):
+        if self._fallback is None and getattr(self, '_h', None):
+            self._lib.ipipe_close(self._h)
+            self._h = None

@@ -101,3 +101,87 @@ def test_record_file_dataset_without_idx(tmp_path):
     ds = RecordFileDataset(path)
     assert len(ds) == 2
     assert ds[1] == b'beta'
+
+
+# ------------------------------------------------- native image pipeline
+
+def _pack_rec(tmp_path, n=12, hw=(40, 36)):
+    import mxnet_tpu.recordio as recordio
+    rec_path = str(tmp_path / 'imgs.rec')
+    idx_path = str(tmp_path / 'imgs.idx')
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, 'w')
+    rng = onp.random.default_rng(0)
+    imgs = []
+    for i in range(n):
+        img = rng.integers(0, 255, (hw[0], hw[1], 3)).astype('uint8')
+        imgs.append(img)
+        hdr = recordio.IRHeader(0, float(i % 4), i, 0)
+        fmt = '.png' if i % 2 == 0 else '.jpg'
+        rec.write_idx(i, recordio.pack_img(hdr, img, img_fmt=fmt))
+    rec.close()
+    return rec_path, imgs
+
+
+def test_native_image_record_iter(tmp_path):
+    from mxnet_tpu.io import ImageRecordIter
+    from mxnet_tpu._native import get_imagepipe_lib
+
+    assert get_imagepipe_lib() is not None, \
+        'native image pipeline must build in this environment'
+    rec_path, imgs = _pack_rec(tmp_path)
+    it = ImageRecordIter(rec_path, data_shape=(3, 32, 32), batch_size=5,
+                         shuffle=False, preprocess_threads=2)
+    assert it._fallback is None, 'native path must be active'
+    assert it.num_records == 12
+    b1 = it.next()
+    assert b1.data[0].shape == (5, 3, 32, 32)
+    assert b1.label[0].shape == (5,)
+    assert b1.pad == 0
+    # labels follow pack order when not shuffled
+    assert b1.label[0].asnumpy().tolist() == [0.0, 1.0, 2.0, 3.0, 0.0]
+    # pixel values decode to the 0-255 range
+    d = b1.data[0].asnumpy()
+    assert d.min() >= 0.0 and d.max() <= 255.0 and d.std() > 10
+    b2 = it.next()
+    b3 = it.next()
+    assert b3.pad == 3                       # 12 % 5
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().pad == 0
+    it.close()
+
+
+def test_native_image_iter_png_content_roundtrip(tmp_path):
+    """PNG decode is lossless: native pipeline output must match the
+    packed pixels exactly (after crop bookkeeping)."""
+    import mxnet_tpu.recordio as recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    rec_path = str(tmp_path / 'exact.rec')
+    idx_path = str(tmp_path / 'exact.idx')
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, 'w')
+    rng = onp.random.default_rng(1)
+    img = rng.integers(0, 255, (16, 16, 3)).astype('uint8')
+    rec.write_idx(0, recordio.pack_img(
+        recordio.IRHeader(0, 2.0, 0, 0), img, img_fmt='.png'))
+    rec.close()
+
+    it = ImageRecordIter(rec_path, data_shape=(3, 16, 16), batch_size=1)
+    batch = it.next()
+    got = batch.data[0].asnumpy()[0].transpose(1, 2, 0)
+    onp.testing.assert_allclose(got, img.astype('f'), atol=0.5)
+    assert float(batch.label[0].asnumpy()[0]) == 2.0
+    it.close()
+
+
+def test_native_image_iter_normalization_and_mirror(tmp_path):
+    from mxnet_tpu.io import ImageRecordIter
+    rec_path, _ = _pack_rec(tmp_path, n=6)
+    it = ImageRecordIter(rec_path, data_shape=(3, 32, 32), batch_size=6,
+                         mean_r=123.68, mean_g=116.28, mean_b=103.53,
+                         std_r=58.4, std_g=57.1, std_b=57.4,
+                         rand_mirror=True, rand_crop=True, seed=3)
+    d = it.next().data[0].asnumpy()
+    assert abs(d.mean()) < 1.0                # roughly centered
+    it.close()
