@@ -40,11 +40,46 @@ def compute_dtype():
     return _state['dtype'] if _state['enabled'] else 'float32'
 
 
+class DynamicLossScaler:
+    """Dynamic loss scaling for fp16 (reference contrib/amp/loss_scaler.py):
+    halve on overflow, double after ``scale_window`` clean steps. bf16 never
+    needs this (fp32 exponent range) — it exists for fp16 parity."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True when any gradient is non-finite (reference uses the fused
+        multi_all_finite kernel; one jitted pass here)."""
+        import jax.numpy as jnp
+        for param in params:
+            if param.grad_req == 'null':
+                continue
+            for g in param.list_grad():
+                if not bool(jnp.isfinite(g._data).all()):
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0
+
+
 def init_trainer(trainer):
     """Reference amp.init_trainer — installs dynamic loss scaling for fp16.
-    bf16 needs none; fp16 gets a static scale hook."""
+    bf16 needs none; fp16 gets the dynamic scaler."""
     if _state['dtype'] == 'float16':
-        trainer._amp_loss_scale = 1024.0
+        trainer._amp_loss_scaler = DynamicLossScaler()
 
 
 def scale_loss(loss, trainer):
@@ -53,7 +88,8 @@ def scale_loss(loss, trainer):
 
     @contextlib.contextmanager
     def scope():
-        scale = getattr(trainer, '_amp_loss_scale', 1.0)
+        scaler = getattr(trainer, '_amp_loss_scaler', None)
+        scale = scaler.loss_scale if scaler is not None else 1.0
         if isinstance(loss, (list, tuple)):
             yield [l * scale for l in loss]
         else:
@@ -62,12 +98,22 @@ def scale_loss(loss, trainer):
 
 
 def unscale(trainer):
-    scale = getattr(trainer, '_amp_loss_scale', 1.0)
-    if scale != 1.0:
-        for param in trainer._params:
-            if param.grad_req != 'null':
-                for g in param.list_grad():
-                    g._rebind(g._data / scale)
+    """Divide gradients by the current scale; on overflow, zero them (the
+    step is effectively skipped) and shrink the scale — reference
+    loss_scaler.py semantics."""
+    scaler = getattr(trainer, '_amp_loss_scaler', None)
+    if scaler is None:
+        return True
+    overflow = scaler.has_overflow(trainer._params)
+    import jax.numpy as jnp
+    for param in trainer._params:
+        if param.grad_req == 'null':
+            continue
+        for g in param.list_grad():
+            g._rebind(jnp.zeros_like(g._data) if overflow
+                      else g._data / scaler.loss_scale)
+    scaler.update_scale(overflow)
+    return not overflow
 
 
 def convert_hybrid_block(block, target_dtype='bfloat16', **kwargs):

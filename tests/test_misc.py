@@ -194,3 +194,42 @@ def test_estimator_fit():
     net.initialize()
     est = Estimator(net, gloss.SoftmaxCrossEntropyLoss())
     est.fit(loader, epochs=1)
+
+
+# ------------------------------------------------------------------- amp
+
+def test_amp_dynamic_loss_scaler():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, autograd, gluon
+
+    amp.init(target_dtype='float16')
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    s0 = scaler.loss_scale
+    assert s0 > 1.0
+
+    x = mx.np.array(np.random.uniform(-1, 1, (2, 3)).astype('f'))
+    with autograd.record():
+        out = net(x)
+        with amp.scale_loss((out ** 2).mean(), trainer) as scaled:
+            pass
+        loss = scaled
+    loss.backward()
+    ok = amp.unscale(trainer)
+    assert ok                                     # finite grads → applied
+    g = net.weight.grad().asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() < 10  # unscaled back
+
+    # force an overflow: non-finite grad → zeroed, scale halves
+    net.weight.grad()._rebind(
+        mx.np.array(np.full((4, 3), np.inf, 'f'))._data)
+    ok = amp.unscale(trainer)
+    assert not ok
+    assert scaler.loss_scale == s0 / 2
+    assert (net.weight.grad().asnumpy() == 0).all()
+    amp._state['enabled'] = False
