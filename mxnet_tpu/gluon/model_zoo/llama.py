@@ -407,3 +407,99 @@ def llama2_7b(**kwargs):
 def llama3_8b(**kwargs):
     """Llama-3-8B shapes (GQA 32/8, 500k rope theta)."""
     return get_llama('llama3_8b', **kwargs)
+
+
+def _hf_to_interleaved(w, num_heads, head_dim):
+    """Permute q/k projection rows from HF rotate-half RoPE layout to the
+    interleaved even/odd-pair layout `_rope` uses: per head, interleaved
+    row 2j is HF row j, row 2j+1 is HF row j + head_dim/2 (both conventions
+    then rotate pair j with the same frequency theta^(-2j/d))."""
+    import numpy as np
+    w = np.asarray(w)
+    half = head_dim // 2
+    perm = np.empty(head_dim, np.int64)
+    perm[0::2] = np.arange(half)
+    perm[1::2] = np.arange(half) + half
+    w = w.reshape(num_heads, head_dim, -1)[:, perm]
+    return w.reshape(num_heads * head_dim, -1)
+
+
+def load_hf_state_dict(net, state_dict):
+    """Load HuggingFace-Transformers Llama weights into an initialized
+    :class:`LlamaForCausalLM` (the model-zoo pretrained-load surface, ≙
+    model_store.py — local weights only, no downloads).
+
+    ``state_dict``: mapping of HF parameter names to arrays (torch tensors
+    or numpy). q/k projections are re-permuted for the interleaved RoPE
+    convention (see ``_rope``); everything else maps 1:1.
+    """
+    import numpy as np
+
+    cfg = net.cfg
+    dh = cfg.units // cfg.num_heads
+
+    def to_np(v):
+        if hasattr(v, 'detach'):
+            v = v.detach().cpu().float().numpy()
+        return np.asarray(v, np.float32)
+
+    params = net.collect_params()
+    loaded = set()
+    for hf_name, value in state_dict.items():
+        name = hf_name
+        # HF 'model.layers.0.' → gluon child name 'model.layers0.'
+        while '.layers.' in name:
+            head, rest = name.split('.layers.', 1)
+            idx, rest = rest.split('.', 1)
+            name = f'{head}.layers{idx}.{rest}'
+        if name not in params:
+            raise KeyError(f'{hf_name} has no target parameter ({name})')
+        v = to_np(value)
+        if name.endswith('self_attn.q_proj.weight'):
+            v = _hf_to_interleaved(v, cfg.num_heads, dh)
+        elif name.endswith('self_attn.k_proj.weight'):
+            v = _hf_to_interleaved(v, cfg.num_kv_heads, dh)
+        p = params[name]
+        if tuple(p.shape) != v.shape:
+            raise ValueError(
+                f'{hf_name}: shape {v.shape} vs parameter {tuple(p.shape)}')
+        p.set_data(v)
+        loaded.add(name)
+    missing = set(params) - loaded
+    if missing:
+        raise ValueError(f'checkpoint missing parameters: {sorted(missing)}')
+    return net
+
+
+def from_hf_pretrained(model_path, **config_overrides):
+    """Build a LlamaForCausalLM from a local HuggingFace checkpoint
+    directory (config.json + weights). Gated on the ``transformers``
+    package; never downloads."""
+    import json
+    import os
+
+    with open(os.path.join(model_path, 'config.json')) as f:
+        hf_cfg = json.load(f)
+    cfg = dict(
+        vocab_size=hf_cfg['vocab_size'], units=hf_cfg['hidden_size'],
+        num_layers=hf_cfg['num_hidden_layers'],
+        num_heads=hf_cfg['num_attention_heads'],
+        num_kv_heads=hf_cfg.get('num_key_value_heads',
+                                hf_cfg['num_attention_heads']),
+        hidden_size=hf_cfg['intermediate_size'],
+        max_length=hf_cfg.get('max_position_embeddings', 4096),
+        rope_theta=hf_cfg.get('rope_theta', 10000.0),
+        rms_norm_eps=hf_cfg.get('rms_norm_eps', 1e-5),
+        tie_word_embeddings=hf_cfg.get('tie_word_embeddings', False))
+    cfg.update(config_overrides)
+    net = LlamaForCausalLM(LlamaConfig(**cfg))
+    net.initialize()
+    import numpy as np
+    B = 1
+    net(__import__('mxnet_tpu').np.zeros((B, 2)))   # materialize params
+
+    import transformers
+    hf = transformers.AutoModelForCausalLM.from_pretrained(
+        model_path, local_files_only=True)
+    load_hf_state_dict(net, hf.state_dict())
+    return net
