@@ -80,16 +80,15 @@ class BERTEncoderCell(HybridBlock):
 
 
 class BERTEncoder(HybridBlock):
-    """Stack of encoder cells with learned position embeddings."""
+    """Stack of encoder cells. Position embeddings live in
+    :class:`BERTModel` (added before the embedding LayerNorm, as BERT
+    specifies)."""
 
     def __init__(self, num_layers, units, hidden_size, num_heads,
                  max_length=512, dropout=0.0):
         super().__init__()
         self._max_length = max_length
         self._units = units
-        self.position_weight = Parameter(
-            'position_weight', shape=(max_length, units),
-            init=initializer.Normal(0.02))
         self.dropout = nn.Dropout(dropout) if dropout else None
         self.cells = []
         for i in range(num_layers):
@@ -98,10 +97,6 @@ class BERTEncoder(HybridBlock):
             self.cells.append(cell)
 
     def forward(self, x, mask=None):
-        from ... import np as mnp
-        seq_len = x.shape[1]
-        pos = self.position_weight.data()[:seq_len]
-        x = x + mnp.expand_dims(pos, 0)
         if self.dropout is not None:
             x = self.dropout(x)
         for cell in self.cells:
@@ -127,6 +122,9 @@ class BERTModel(HybridBlock):
         self._units = units
         self.word_embed = nn.Embedding(vocab_size, units)
         self.token_type_embed = nn.Embedding(token_type_vocab_size, units)
+        self.position_weight = Parameter(
+            'position_weight', shape=(max_length, units),
+            init=initializer.Normal(0.02))
         self.embed_ln = BERTLayerNorm(in_channels=units)
         self.encoder = BERTEncoder(num_layers, units, hidden_size,
                                    num_heads, max_length, dropout)
@@ -165,6 +163,10 @@ class BERTModel(HybridBlock):
         x = self.word_embed(token_ids)
         if token_types is not None:
             x = x + self.token_type_embed(token_types)
+        # position added BEFORE the embedding LayerNorm (BERT spec; the
+        # HF-parity test pins this ordering)
+        pos = self.position_weight.data()[:token_ids.shape[1]]
+        x = x + mnp.expand_dims(pos, 0)
         x = self.embed_ln(x)
         mask = self._attention_mask(token_ids, valid_length)
         seq = self.encoder(x, mask)
@@ -207,3 +209,82 @@ def bert_12_768_12(**kwargs):
 def bert_24_1024_16(**kwargs):
     """BERT-large (340M params)."""
     return get_bert_model('bert_24_1024_16', **kwargs)
+
+
+def load_hf_state_dict(net, state_dict):
+    """Load HuggingFace-Transformers BERT weights into an initialized
+    :class:`BERTModel` (local weights only; the pretrained-load surface ≙
+    model_store.py). HF's separate query/key/value projections concatenate
+    into the fused ``qkv`` kernel; MLM/NSP heads map when the model was
+    built with them."""
+    import numpy as _np
+
+    def to_np(v):
+        if hasattr(v, 'detach'):
+            v = v.detach().cpu().float().numpy()
+        return _np.asarray(v, _np.float32)
+
+    sd = {}
+    for k, v in state_dict.items():
+        if k.startswith('bert.'):
+            k = k[len('bert.'):]
+        sd[k] = to_np(v)
+
+    params = net.collect_params()
+
+    def put(name, value):
+        p = params[name]
+        if tuple(p.shape) != value.shape:
+            raise ValueError(f'{name}: {value.shape} vs {tuple(p.shape)}')
+        p.set_data(value)
+
+    put('word_embed.weight', sd['embeddings.word_embeddings.weight'])
+    put('token_type_embed.weight',
+        sd['embeddings.token_type_embeddings.weight'])
+    pos = sd['embeddings.position_embeddings.weight']
+    put('position_weight', pos[:params['position_weight'].shape[0]])
+    put('embed_ln.gamma', sd['embeddings.LayerNorm.weight'])
+    put('embed_ln.beta', sd['embeddings.LayerNorm.bias'])
+
+    n_layers = len(net.encoder.cells)
+    for i in range(n_layers):
+        hf = f'encoder.layer.{i}.'
+        ours = f'encoder.cell{i}.'
+        qkv_w = _np.concatenate([sd[hf + 'attention.self.query.weight'],
+                                 sd[hf + 'attention.self.key.weight'],
+                                 sd[hf + 'attention.self.value.weight']], 0)
+        qkv_b = _np.concatenate([sd[hf + 'attention.self.query.bias'],
+                                 sd[hf + 'attention.self.key.bias'],
+                                 sd[hf + 'attention.self.value.bias']], 0)
+        put(ours + 'attention.qkv.weight', qkv_w)
+        put(ours + 'attention.qkv.bias', qkv_b)
+        put(ours + 'attention.proj.weight',
+            sd[hf + 'attention.output.dense.weight'])
+        put(ours + 'attention.proj.bias',
+            sd[hf + 'attention.output.dense.bias'])
+        put(ours + 'ln1.gamma', sd[hf + 'attention.output.LayerNorm.weight'])
+        put(ours + 'ln1.beta', sd[hf + 'attention.output.LayerNorm.bias'])
+        put(ours + 'ffn1.weight', sd[hf + 'intermediate.dense.weight'])
+        put(ours + 'ffn1.bias', sd[hf + 'intermediate.dense.bias'])
+        put(ours + 'ffn2.weight', sd[hf + 'output.dense.weight'])
+        put(ours + 'ffn2.bias', sd[hf + 'output.dense.bias'])
+        put(ours + 'ln2.gamma', sd[hf + 'output.LayerNorm.weight'])
+        put(ours + 'ln2.beta', sd[hf + 'output.LayerNorm.bias'])
+
+    if net.use_pooler and 'pooler.dense.weight' in sd:
+        put('pooler.weight', sd['pooler.dense.weight'])
+        put('pooler.bias', sd['pooler.dense.bias'])
+    if net.use_decoder and 'cls.predictions.transform.dense.weight' in sd:
+        put('decoder_transform.weight',
+            sd['cls.predictions.transform.dense.weight'])
+        put('decoder_transform.bias',
+            sd['cls.predictions.transform.dense.bias'])
+        put('decoder_ln.gamma',
+            sd['cls.predictions.transform.LayerNorm.weight'])
+        put('decoder_ln.beta',
+            sd['cls.predictions.transform.LayerNorm.bias'])
+        put('decoder_bias', sd['cls.predictions.bias'])
+    if net.use_classifier and 'cls.seq_relationship.weight' in sd:
+        put('classifier.weight', sd['cls.seq_relationship.weight'])
+        put('classifier.bias', sd['cls.seq_relationship.bias'])
+    return net

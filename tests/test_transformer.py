@@ -232,3 +232,39 @@ def test_bert_classifier_requires_pooler():
         bert.BERTModel(vocab_size=10, units=8, hidden_size=16,
                        num_layers=1, num_heads=2, use_pooler=False,
                        use_classifier=True)
+
+
+def test_bert_hf_weight_import_matches_transformers():
+    """Cross-implementation parity for BERT: logits from an HF
+    BertForPreTraining's random weights must match ours."""
+    torch = pytest.importorskip('torch')
+    transformers = pytest.importorskip('transformers')
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=120, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=96,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, hidden_act='gelu',
+        attn_implementation='eager')
+    torch.manual_seed(0)
+    hf = transformers.BertForPreTraining(hf_cfg).eval()
+
+    net = bert.BERTModel(vocab_size=120, units=48, hidden_size=96,
+                         num_layers=2, num_heads=4, max_length=32,
+                         dropout=0.0)
+    net.initialize()
+    toks = onp.array([[2, 45, 99, 7, 3]], 'f')
+    segs = onp.array([[0, 0, 1, 1, 1]], 'f')
+    net(mx.np.array(toks), mx.np.array(segs))
+    bert.load_hf_state_dict(net, hf.state_dict())
+
+    seq, pooled, mlm, nsp = net(mx.np.array(toks), mx.np.array(segs))
+    with torch.no_grad():
+        out = hf(torch.tensor(toks.astype('i8')),
+                 token_type_ids=torch.tensor(segs.astype('i8')))
+    err_mlm = onp.abs(mlm.asnumpy() -
+                     out.prediction_logits.numpy()).max()
+    err_nsp = onp.abs(nsp.asnumpy() -
+                     out.seq_relationship_logits.numpy()).max()
+    assert err_mlm < 5e-3, f'MLM logit mismatch {err_mlm}'
+    assert err_nsp < 5e-3, f'NSP logit mismatch {err_nsp}'
