@@ -141,11 +141,20 @@ def _toposort(head_infos):
     return order[::-1]  # heads-first
 
 
-def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             variables=None, create_graph=False):
     """Reference: Imperative::Backward (src/imperative/imperative.cc:377).
 
     heads: list of NDArrays; head_grads: matching list (None → ones).
-    Accumulates into the ``.grad`` buffers of marked variables.
+    Accumulates into the ``.grad`` buffers of marked variables — or, when
+    ``variables`` is given (the ``autograd.grad`` path, c_api
+    MXAutogradBackwardEx with variable handles), returns their cotangents
+    instead of writing buffers.
+
+    ``create_graph=True`` replays each node's VJP *through the tape* (the
+    backward ops are recorded like forward ops), so the returned gradients
+    are differentiable — higher-order autograd, the role of the
+    reference's create_graph handling in MXGradient.
     """
     from .ndarray.ndarray import NDArray  # local import to avoid cycle
 
@@ -160,6 +169,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
     if head_grads is None:
         head_grads = [None] * len(heads)
+
+    if create_graph:
+        return _backward_recorded(heads, head_infos, head_grads,
+                                  variables, train_mode)
 
     # cotangent accumulation per (node, out_index)
     cots = {}
@@ -215,6 +228,18 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     finally:
         set_training(prev_train)
 
+    if variables is not None:
+        out = []
+        for v in variables:
+            info = getattr(v, '_ag', None)
+            if info is None or not info.variable:
+                raise ValueError('grad() variables must be marked '
+                                 '(attach_grad/mark_variables)')
+            got = var_grads.get(id(info))
+            out.append(NDArray(got[1]) if got is not None
+                       else NDArray(jnp.zeros(v.shape, v._data.dtype)))
+        return out
+
     # write into variable grad buffers honoring grad_req
     for info, cot in var_grads.values():
         if info.grad is None or info.grad_req == 'null':
@@ -224,3 +249,103 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         else:  # 'write'
             info.grad._data = cot.astype(info.grad._data.dtype)
     del node_index
+    return None
+
+
+def _backward_recorded(heads, head_infos, head_grads, variables,
+                       train_mode):
+    """Backward pass executed as *recorded* ops: every VJP application is
+    re-dispatched through the op registry with recording on, so the
+    cotangent chain itself lives on the tape (higher-order autograd)."""
+    from .ndarray.ndarray import NDArray
+    from .ops.registry import Op, apply_op
+
+    cots = {}       # (node id, out idx) -> NDArray cotangent
+    var_grads = {}  # id(AGInfo) -> (info, NDArray cotangent)
+
+    def _push(info, cot_nd):
+        if info is None or cot_nd is None:
+            return
+        if info.variable:
+            key = id(info)
+            if key in var_grads:
+                var_grads[key] = (info, var_grads[key][1] + cot_nd)
+            else:
+                var_grads[key] = (info, cot_nd)
+        elif info.node is not None:
+            key = (id(info.node), info.index)
+            cots[key] = cot_nd if key not in cots else cots[key] + cot_nd
+
+    for h, info, hg in zip(heads, head_infos, head_grads):
+        if hg is None:
+            g = NDArray(jnp.ones(h.shape, dtype=h._data.dtype))
+        elif isinstance(hg, NDArray):
+            g = hg
+        else:
+            g = NDArray(jnp.asarray(hg))
+        _push(info, g)
+
+    order = _toposort(head_infos)
+    prev_train = set_training(train_mode)
+    prev_rec = set_recording(True)
+    try:
+        for node in order:
+            out_cots, any_cot = [], False
+            for i in range(node.n_out):
+                c = cots.pop((id(node), i), None)
+                if c is None:
+                    aval = node.out_avals[i]
+                    c = NDArray(jnp.zeros(aval.shape, dtype=aval.dtype))
+                else:
+                    any_cot = True
+                out_cots.append(c)
+            if not any_cot:
+                continue
+
+            n_out, multi, fwd_fn = node.n_out, node.multi, node.fn
+
+            def bwd_fn(*raws, _n=n_out, _multi=multi, _f=fwd_fn):
+                cot_raws, in_raws = raws[:_n], raws[_n:]
+                _, vjp = jax.vjp(_f, *in_raws)
+                return vjp(tuple(cot_raws) if _multi else cot_raws[0])
+
+            # original inputs re-wrapped with their recorded lineage so
+            # third-and-higher orders chain through them too
+            in_nds = []
+            for raw, parent in zip(node.in_vals, node.parents):
+                nd = NDArray(raw)
+                if parent is not None:
+                    nd._ag = parent
+                in_nds.append(nd)
+            op = Op(f'_backward_{node.name}', bwd_fn)
+            arrays = list(out_cots) + in_nds
+            raws = [a._data for a in arrays]
+            res = apply_op(op, arrays,
+                           lambda *r, _b=bwd_fn: _b(*r), name=op.name)
+            in_cots = res if isinstance(res, tuple) else (res,)
+            for parent, cot in zip(node.parents, in_cots):
+                _push(parent, cot)
+    finally:
+        set_recording(prev_rec)
+        set_training(prev_train)
+
+    if variables is not None:
+        out = []
+        for v in variables:
+            info = getattr(v, '_ag', None)
+            if info is None or not info.variable:
+                raise ValueError('grad() variables must be marked '
+                                 '(attach_grad/mark_variables)')
+            got = var_grads.get(id(info))
+            out.append(got[1] if got is not None
+                       else NDArray(jnp.zeros(v.shape, v._data.dtype)))
+        return out
+    for info, cot_nd in var_grads.values():
+        if info.grad is None or info.grad_req == 'null':
+            continue
+        if info.grad_req == 'add':
+            info.grad._data = info.grad._data + cot_nd._data.astype(
+                info.grad._data.dtype)
+        else:
+            info.grad._data = cot_nd._data.astype(info.grad._data.dtype)
+    return None

@@ -90,9 +90,9 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Reference autograd.py:272 — returns grads instead of writing buffers.
 
-    create_graph=True (higher-order) re-runs via jax.grad composition on the
-    recorded subgraph; v1 supports first-order here and higher-order through
-    the functional `mx.grad_fn` path.
+    create_graph=True records the backward pass itself on the tape, so the
+    returned gradients are differentiable (higher-order autograd —
+    reference tests/python/unittest/test_higher_order_grad.py).
     """
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -101,27 +101,14 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
-    if create_graph:
-        raise NotImplementedError(
-            'create_graph=True: use the functional API (jax.grad via '
-            'hybridized blocks) — tape-level higher order lands later')
-    # stash existing grads, use fresh buffers
-    saved = [(v._ag.grad, v._ag.grad_req) if v._ag else None
-             for v in variables]
-    import jax.numpy as jnp
     for v in variables:
         if v._ag is None or not v._ag.variable:
             raise ValueError('variables must be marked (attach_grad) and '
                              'used in the recorded computation')
-        v._ag.grad = NDArray(jnp.zeros(v.shape, dtype=v._data.dtype))
-        v._ag.grad_req = 'write'
     retain = retain_graph if retain_graph is not None else create_graph
-    _tape.backward(heads, head_grads, retain_graph=retain,
-                   train_mode=train_mode)
-    outs = [v._ag.grad for v in variables]
-    for v, s in zip(variables, saved):
-        if s is not None:
-            v._ag.grad, v._ag.grad_req = s
+    outs = _tape.backward(heads, head_grads, retain_graph=retain,
+                          train_mode=train_mode, variables=variables,
+                          create_graph=create_graph)
     return outs[0] if single else outs
 
 

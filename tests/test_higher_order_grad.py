@@ -1,0 +1,87 @@
+"""Higher-order autograd (reference
+tests/python/unittest/test_higher_order_grad.py): create_graph=True records
+the backward pass on the tape so gradients are differentiable."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _second_order(fn, d2, x0):
+    x = mx.np.array(np.asarray(x0, 'f'))
+    x.attach_grad()
+    with autograd.record():
+        y = fn(x)
+        gx = autograd.grad(y, x, create_graph=True)
+    gx.backward()
+    assert_almost_equal(x.grad, d2(np.asarray(x0, 'f')),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_second_order_sin():
+    _second_order(mx.np.sin, lambda x: -np.sin(x), [0.3, 1.1, 2.0])
+
+
+def test_second_order_log():
+    _second_order(mx.np.log, lambda x: -1.0 / x ** 2, [0.5, 1.5, 3.0])
+
+
+def test_second_order_sigmoid():
+    def d2(x):
+        s = 1 / (1 + np.exp(-x))
+        return s * (1 - s) * (1 - 2 * s)
+    _second_order(mx.npx.sigmoid, d2, [-1.0, 0.2, 2.0])
+
+
+def test_second_order_through_product():
+    # d2/dx2 (x^3) = 6x, via elemwise chain x*x*x
+    x = mx.np.array(np.array([2.0, -1.0], 'f'))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        gx = autograd.grad(y, x, create_graph=True)
+    gx.backward()
+    assert_almost_equal(x.grad, 6 * np.array([2.0, -1.0]),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_third_order():
+    x = mx.np.array(np.array([2.0], 'f'))
+    x.attach_grad()
+    with autograd.record():
+        y = x ** 4
+        g1 = autograd.grad(y, x, create_graph=True)
+        g2 = autograd.grad(g1, x, create_graph=True)
+    g2.backward()
+    assert_almost_equal(x.grad, np.array([48.0]), rtol=1e-4, atol=1e-4)
+
+
+def test_grad_of_grad_multivariate():
+    # f = x^2 y; df/dx = 2xy; d/dy(df/dx) = 2x
+    x = mx.np.array(np.array([3.0], 'f'))
+    y = mx.np.array(np.array([5.0], 'f'))
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        f = x * x * y
+        gx = autograd.grad(f, x, create_graph=True)
+        gxy = autograd.grad(gx, y, create_graph=False)
+    assert_almost_equal(gxy, np.array([6.0]), rtol=1e-5, atol=1e-6)
+
+
+def test_first_order_grad_api_unchanged():
+    x = mx.np.array(np.array([1.0, 2.0], 'f'))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 2).sum()
+    g = autograd.grad(y, x)
+    assert_almost_equal(g, 2 * np.array([1.0, 2.0]), rtol=1e-6, atol=1e-7)
+    # and plain backward still writes buffers
+    with autograd.record():
+        y = (x ** 3).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 3 * np.array([1.0, 4.0]),
+                        rtol=1e-5, atol=1e-6)
