@@ -357,3 +357,92 @@ class ImageIter:
         batch_label = array(labels[:, 0] if self.label_width == 1
                             else labels)
         return DataBatch(data=[batch_data], label=[batch_label], pad=pad)
+
+
+# --------------------------------------------------------- detection iter
+
+class DetHorizontalFlipAug(Augmenter):
+    """Flip image and x-coordinates of corner-format boxes
+    (reference image/detection.py DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _np.random.random() < self.p:
+            raw = src._data if isinstance(src, NDArray) else src
+            src = NDArray(raw[:, ::-1])
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            x1 = label[valid, 1].copy()
+            label[valid, 1] = 1.0 - label[valid, 3]
+            label[valid, 3] = 1.0 - x1
+        return src, label
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (reference image/detection.py ImageDetIter):
+    labels are per-object rows ``[cls, x1, y1, x2, y2]`` (normalized
+    corners), padded with -1 rows to ``max_objects``. Images resize to
+    ``data_shape`` directly (box coords are scale-invariant in normalized
+    form); optional box-aware random mirror.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root='', shuffle=False,
+                 max_objects=16, rand_mirror=False, mean=None, std=None,
+                 **kwargs):
+        c, h, w = data_shape
+        aug_list = [ForceResizeAug((w, h)), CastAug()]
+        if mean is not None or std is not None:
+            aug_list.append(ColorNormalizeAug(
+                mean if mean is not None else 0.0, std))
+        super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         shuffle=shuffle, aug_list=aug_list,
+                         label_width=1, **kwargs)
+        self.max_objects = max_objects
+        self._det_augs = [DetHorizontalFlipAug(0.5)] if rand_mirror else []
+
+    def _parse_label(self, label):
+        """Flat label array → (max_objects, 5), -1-padded (reference
+        detection.py _parse_label: header [A, w] prefix supported)."""
+        arr = _np.asarray(label, 'float32').ravel()
+        if arr.size == 1:               # classification-style scalar
+            arr = _np.array([arr[0], 0, 0, 1, 1], 'float32')
+        if arr.size % 5 == 2:           # [A, w] header prefix
+            arr = arr[2:]
+        objs = arr.reshape(-1, 5)[:self.max_objects]
+        out = _np.full((self.max_objects, 5), -1.0, 'float32')
+        out[:len(objs)] = objs
+        return out
+
+    def next(self):
+        from ..io import DataBatch
+        c, h, w = self.data_shape
+        data = _np.zeros((self.batch_size, h, w, c), 'float32')
+        labels = _np.full((self.batch_size, self.max_objects, 5), -1.0,
+                          'float32')
+        i = 0
+        pad = 0
+        while i < self.batch_size:
+            try:
+                label, img = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                break
+            if not isinstance(img, NDArray):
+                img = array(img)
+            for aug in self.auglist:
+                img = aug(img)
+            lab = self._parse_label(label)
+            for aug in self._det_augs:
+                img, lab = aug(img, lab)
+            data[i] = img.asnumpy()
+            labels[i] = lab
+            i += 1
+        return DataBatch(data=[array(data.transpose(0, 3, 1, 2))],
+                         label=[array(labels)], pad=pad)

@@ -1,0 +1,75 @@
+"""Engine semantics + async error surfacing (reference
+tests/python/unittest/test_engine.py and test_exc_handling.py).
+
+The TPU design maps the ThreadedEngine's contract onto JAX async dispatch:
+ops return immediately, `wait_to_read`/`asnumpy` are the sync points, and
+errors surface there (or immediately for shape/type errors, which the
+reference also raises eagerly at FInferShape time)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_async_dispatch_and_sync_points():
+    a = mx.np.ones((64, 64))
+    b = a @ a            # returns without waiting
+    b.wait_to_read()     # explicit sync (reference WaitToRead)
+    assert float(b.asnumpy()[0, 0]) == 64.0
+    mx.nd.waitall()      # global barrier (Engine::WaitForAll)
+
+
+def test_shape_errors_raise_eagerly():
+    a = mx.np.ones((2, 3))
+    b = mx.np.ones((4, 5))
+    with pytest.raises(Exception):
+        a @ b            # infer-shape failure raises at call, as reference
+
+
+def test_nonfinite_values_do_not_raise():
+    # numerical errors are values, not exceptions (both frameworks)
+    x = mx.np.array(np.array([1.0, 0.0], 'f'))
+    y = mx.np.array(np.array([0.0, 0.0], 'f'))
+    out = (x / y).asnumpy()
+    assert np.isinf(out[0]) and np.isnan(out[1])
+
+
+def test_exception_inside_record_leaves_tape_usable():
+    x = mx.np.ones((2, 2))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 2).sum()
+        try:
+            _ = x @ mx.np.ones((3, 3))   # fails
+        except Exception:
+            pass
+        # tape must still work after the failed op
+    y.backward()
+    assert_almost_equal(x.grad, np.full((2, 2), 2.0))
+
+
+def test_naive_engine_scope():
+    # ≙ MXNET_ENGINE_TYPE=NaiveEngine: synchronous op-by-op execution
+    with engine.naive_engine():
+        a = mx.np.ones((8, 8))
+        out = (a * 3).sum()
+        assert float(out.asnumpy()) == 192.0
+
+
+def test_bulk_scope_is_transparent():
+    with engine.bulk(16):
+        x = mx.np.ones((4,))
+        for _ in range(5):
+            x = x + 1
+    assert_almost_equal(x, np.full((4,), 6.0))
+
+
+def test_waitall_after_many_async_ops():
+    xs = [mx.np.ones((32, 32)) * i for i in range(10)]
+    ys = [x @ x for x in xs]
+    mx.nd.waitall()
+    for i, y in enumerate(ys):
+        assert float(y.asnumpy()[0, 0]) == 32.0 * i * i

@@ -176,3 +176,38 @@ def test_create_augmenter_pipeline():
         img = a(img)
     assert img.shape == (24, 24, 3)
     assert abs(float(img.asnumpy().mean())) < 50     # roughly normalized
+
+
+def test_image_det_iter(tmp_path):
+    import mxnet_tpu.image as image
+    import mxnet_tpu.recordio as recordio
+
+    rec_path = str(tmp_path / 'det.rec')
+    idx_path = str(tmp_path / 'det.idx')
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, 'w')
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        img = rng.integers(0, 255, (40, 40, 3)).astype('uint8')
+        # two objects: [cls, x1, y1, x2, y2] normalized
+        label = np.array([i % 3, 0.1, 0.2, 0.5, 0.6,
+                          (i + 1) % 3, 0.4, 0.4, 0.9, 0.8], 'f')
+        hdr = recordio.IRHeader(len(label), label, i, 0)
+        rec.write_idx(i, recordio.pack_img(hdr, img, img_fmt='.png'))
+    rec.close()
+
+    it = image.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                            path_imgrec=rec_path, max_objects=4)
+    batch = next(it)
+    assert batch.data[0].shape == (3, 3, 32, 32)
+    assert batch.label[0].shape == (3, 4, 5)
+    lab = batch.label[0].asnumpy()
+    assert lab[0, 0, 0] == 0.0 and abs(lab[0, 0, 3] - 0.5) < 1e-6
+    assert (lab[:, 2:, 0] == -1).all()          # padding rows
+
+    # mirrored variant keeps boxes inside [0, 1] and flips x coords
+    it2 = image.ImageDetIter(batch_size=6, data_shape=(3, 32, 32),
+                             path_imgrec=rec_path, rand_mirror=True)
+    lab2 = next(it2).label[0].asnumpy()
+    valid = lab2[..., 0] >= 0
+    assert (lab2[..., 1:][valid[..., None].repeat(4, -1).reshape(
+        valid.shape + (4,))] >= 0).all()
