@@ -56,12 +56,18 @@ def pop_trace_provider():
 
 
 def next_key():
-    """Next PRNG subkey — traced provider if capturing, else eager global."""
+    """Next PRNG subkey — traced provider if capturing, else eager global.
+
+    The eager split runs under ``ensure_compile_time_eval``: inside an
+    outer trace (eval_shape / jit replaying a symbol) omnistaging would
+    otherwise stage the split and store a *tracer* into the global state,
+    poisoning every later eager op (leaked-tracer errors)."""
     if _providers:
         return _providers[-1].next_key()
-    key = _global()
-    key, sub = jax.random.split(key)
-    _state.key = key
+    with jax.ensure_compile_time_eval():
+        key = _global()
+        key, sub = jax.random.split(key)
+        _state.key = key
     return sub
 
 

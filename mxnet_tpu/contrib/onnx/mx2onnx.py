@@ -245,9 +245,26 @@ def _squeeze(b, node, ins, out):
         b.add('Squeeze', [ins[0], ax], [out])
 
 
-@_converts('concat')
+@_converts('concat', 'concatenate')
 def _concat(b, node, ins, out):
     b.add('Concat', ins, [out], axis=int(node.kwargs.get('axis', 0)))
+
+
+@_converts('clip')
+def _clip(b, node, ins, out):
+    kw = node.kwargs
+    amin = kw.get('a_min')
+    amax = kw.get('a_max')
+    lo = b.const('min', _np.float32(amin)) if amin is not None else ''
+    hi = b.const('max', _np.float32(amax)) if amax is not None else ''
+    b.add('Clip', [ins[0], lo, hi], [out])
+
+
+@_converts('relu6')
+def _relu6(b, node, ins, out):
+    lo = b.const('min', _np.float32(0.0))
+    hi = b.const('max', _np.float32(6.0))
+    b.add('Clip', [ins[0], lo, hi], [out])
 
 
 @_converts('embedding', 'sparse_embedding')

@@ -174,6 +174,13 @@ def _import_node(imp, node):
                        dict(dtype=_NP_DTYPE[at['to']]))
     if op in ('Dropout', 'Identity'):
         return S(0)
+    if op == 'Clip':
+        amin = float(imp.const(ins[1]).item()) if len(ins) > 1 and ins[1] \
+            else None
+        amax = float(imp.const(ins[2]).item()) if len(ins) > 2 and ins[2] \
+            else None
+        return _invoke('clip', [S(0)],
+                       dict(a_min=amin, a_max=amax))
     if op == 'Softmax':
         return _invoke('softmax', [S(0)], dict(axis=at.get('axis', -1)))
     if op == 'LogSoftmax':

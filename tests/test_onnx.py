@@ -102,3 +102,41 @@ def test_embedding_and_elemwise_export(tmp_path):
     sym2, arg_params, _ = mx.contrib.onnx.import_model(path)
     got = sym2.eval(data=idx, **arg_params)[0].asnumpy()
     assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize('name', ['mobilenet_v2_0_25', 'squeezenet1_0'])
+def test_vision_zoo_roundtrip(tmp_path, name):
+    """Model-zoo nets export and reimport with identical outputs (the
+    relu6/concatenate/clip converter coverage)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = getattr(vision, name)()
+    net.initialize()
+    x = mx.np.array(np.random.uniform(-1, 1, (1, 3, 224, 224)).astype('f'))
+    want = net(x).asnumpy()
+    sym = net._trace_symbol(x)
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = str(tmp_path / f'{name}.onnx')
+    mx.contrib.onnx.export_model(sym, params,
+                                 input_shapes=[(1, 3, 224, 224)],
+                                 onnx_file_path=path)
+    sym2, arg_params, _ = mx.contrib.onnx.import_model(path)
+    got = sym2.eval(data=x, **arg_params)[0].asnumpy()
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_stochastic_op_under_abstract_eval_does_not_leak_tracers(tmp_path):
+    """Regression: exporting a net with Dropout (stochastic op) must not
+    poison the global RNG with traced keys (mx2onnx._infer_outputs runs
+    the symbol under jax.eval_shape)."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.Dropout(0.5), gluon.nn.Dense(2))
+    net.initialize()
+    x = mx.np.ones((1, 4))
+    net(x)
+    sym = net._trace_symbol(x)
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    mx.contrib.onnx.export_model(sym, params, input_shapes=[(1, 4)],
+                                 onnx_file_path=str(tmp_path / 'd.onnx'))
+    # eager RNG still healthy after the abstract eval
+    out = mx.np.random.uniform(0, 1, (3,))
+    assert np.isfinite(out.asnumpy()).all()
