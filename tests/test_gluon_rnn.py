@@ -113,3 +113,36 @@ def test_lstm_layer_matches_cell():
     outs, _ = cell.unroll(T, [x[t] for t in range(T)], layout='TNC')
     manual = np.stack([o.asnumpy() for o in outs])
     assert_almost_equal(out, manual, rtol=1e-4, atol=1e-5)
+
+
+def test_gluon_lstm_matches_fused_rnn_op():
+    """Cross-validate the Gluon LSTM layer against the fused npx.rnn op:
+    same packed parameters must give the same outputs through two
+    independent implementations."""
+    T, B, I, H, L = 6, 3, 5, 7, 2
+    layer = rnn.LSTM(H, num_layers=L, layout='TNC', input_size=I)
+    layer.initialize()
+    x = mx.np.array(np.random.uniform(-1, 1, (T, B, I)).astype('f'))
+    h0 = mx.np.zeros((L, B, H))
+    c0 = mx.np.zeros((L, B, H))
+    out, states = layer(x, [h0, c0])
+
+    # pack the layer's params into the fused op's cuDNN-canonical vector
+    params = layer.collect_params()
+    ws, bs = [], []
+    for li in range(L):
+        ws.append(params[f'l{li}_i2h_weight'].data().asnumpy().ravel())
+        ws.append(params[f'l{li}_h2h_weight'].data().asnumpy().ravel())
+        bs.append(params[f'l{li}_i2h_bias'].data().asnumpy())
+        bs.append(params[f'l{li}_h2h_bias'].data().asnumpy())
+    packed = mx.np.array(np.concatenate(ws + bs))
+
+    out2, hy, cy = mx.npx.rnn(x, packed, h0, c0, mode='lstm',
+                              state_size=H, num_layers=L,
+                              state_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(states[0].asnumpy(), hy.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(states[1].asnumpy(), cy.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
