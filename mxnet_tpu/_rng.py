@@ -64,6 +64,19 @@ def next_key():
     poisoning every later eager op (leaked-tracer errors)."""
     if _providers:
         return _providers[-1].next_key()
+    try:
+        clean = jax.core.trace_state_clean()
+    except AttributeError:
+        from jax._src import core as _core
+        clean = _core.trace_state_clean()
+    if clean:
+        # normal eager path: async split, no device sync
+        key = _global()
+        key, sub = jax.random.split(key)
+        _state.key = key
+        return sub
+    # inside an outer trace: escape it so the stored key stays concrete —
+    # ensure_compile_time_eval *blocks*, so it must not run per eager call
     with jax.ensure_compile_time_eval():
         key = _global()
         key, sub = jax.random.split(key)
