@@ -151,3 +151,24 @@ def load_params_sharded(directory, block, mesh=None, specs=None):
         for c in list(p._data):
             p._data[c] = NDArray(value, ctx=c)
     return block
+
+
+def restore_or_init(manager, init_fn, template=None):
+    """Elastic-restart entry point (SURVEY §5 failure recovery: the
+    reference has none beyond PS heartbeats; here a re-launched job resumes
+    from the newest checkpoint). Returns ``(tree, step)``: the restored
+    state and its step, or ``(init_fn(), -1)`` on a cold start.
+
+    Typical pod loop::
+
+        mgr = SharedCheckpointManager('gs://.../ckpt')
+        state, step = restore_or_init(mgr, make_initial_state)
+        for step in range(step + 1, total_steps):
+            state = train_step(state, ...)
+            if step % 1000 == 0:
+                mgr.save(step, state)
+    """
+    latest = manager.latest_step()
+    if latest is None:
+        return init_fn(), -1
+    return manager.restore(latest, template=template), latest

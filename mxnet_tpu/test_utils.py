@@ -215,3 +215,56 @@ def environment(*args):
             else:
                 _os.environ[key] = old
     return ctx()
+
+
+def check_symbolic_forward(sym, inputs, expected, rtol=1e-4, atol=1e-5,
+                           ctx=None):
+    """Reference test_utils.py:1190 — bind inputs, compare outputs."""
+    args = sym.list_arguments()
+    if isinstance(inputs, (list, tuple)):
+        bindings = dict(zip(args, inputs))
+    else:
+        bindings = dict(inputs)
+    bindings = {k: v if isinstance(v, NDArray) else array(v, ctx=ctx)
+                for k, v in bindings.items()}
+    outs = sym.eval(**bindings)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    assert len(outs) == len(expected), \
+        f'{len(outs)} outputs vs {len(expected)} expected'
+    for got, want in zip(outs, expected):
+        assert_almost_equal(got, want, rtol=rtol, atol=atol)
+    return outs
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected_grads,
+                            rtol=1e-2, atol=1e-4, ctx=None):
+    """Reference test_utils.py check_symbolic_backward — grads of a bound
+    symbol w.r.t. its arguments against expected values."""
+    from . import autograd
+
+    args = sym.list_arguments()
+    if isinstance(inputs, (list, tuple)):
+        inputs = dict(zip(args, inputs))
+    nd_in = {k: v if isinstance(v, NDArray) else array(v, ctx=ctx)
+             for k, v in inputs.items()}
+    for v in nd_in.values():
+        v.attach_grad()
+    with autograd.record():
+        outs = sym.eval(**nd_in)
+        if not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+    heads = list(outs)
+    grads = [g if isinstance(g, NDArray) else array(g, ctx=ctx)
+             for g in out_grads]
+    from . import _tape
+    _tape.backward(heads, grads)
+    if isinstance(expected_grads, (list, tuple)):
+        expected_grads = dict(zip(args, expected_grads))
+    result = {}
+    for name, want in expected_grads.items():
+        got = nd_in[name].grad
+        if want is not None:
+            assert_almost_equal(got, want, rtol=rtol, atol=atol)
+        result[name] = got
+    return result

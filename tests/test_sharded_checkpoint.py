@@ -94,3 +94,18 @@ def test_block_params_sharded_roundtrip(tmp_path):
     after = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
     for k in before:
         assert_almost_equal(after[k], before[k])
+
+
+def test_restore_or_init(tmp_path):
+    from mxnet_tpu.parallel.checkpoint import restore_or_init
+    mgr = parallel.SharedCheckpointManager(str(tmp_path / 'el'),
+                                           max_to_keep=2)
+    try:
+        state, step = restore_or_init(mgr, lambda: {'w': jnp.zeros(2)})
+        assert step == -1 and float(state['w'][0]) == 0.0
+        mgr.save(5, {'w': jnp.full((2,), 7.0)})
+        state, step = restore_or_init(mgr, lambda: {'w': jnp.zeros(2)})
+        assert step == 5
+        assert_almost_equal(np.asarray(state['w']), np.full((2,), 7.0))
+    finally:
+        mgr.close()

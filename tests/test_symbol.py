@@ -295,3 +295,18 @@ def test_topk_positional_ret_typ():
     x = mx.sym.var('x')
     both = mx.sym.np.topk(x, -1, 2, 'both')
     assert both.num_outputs == 2
+
+
+def test_check_symbolic_forward_backward_harness():
+    from mxnet_tpu.test_utils import (check_symbolic_forward,
+                                      check_symbolic_backward)
+    x = mx.sym.var('x')
+    y = mx.sym.var('y')
+    z = (x * y + x).sum()
+    xv = onp.array([[1.0, 2.0]], 'f')
+    yv = onp.array([[3.0, 4.0]], 'f')
+    check_symbolic_forward(z, {'x': xv, 'y': yv},
+                           onp.array((xv * yv + xv).sum(), 'f'))
+    check_symbolic_backward(z, {'x': xv, 'y': yv},
+                            onp.array(1.0, 'f'),
+                            {'x': yv + 1, 'y': xv})
