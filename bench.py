@@ -121,6 +121,35 @@ def bench_bert(args, mx):
     }
 
 
+def bench_kvstore(args):
+    """KVStore push/pull bandwidth (BASELINE.md north-star row: the
+    reference ships only the harness, no number — vs_baseline anchors to
+    the 12.5 GB/s wire rate of the reference's 100GbE ps-lite deployments,
+    the closest published transport ceiling)."""
+    import io
+    import json as _json
+    import os
+    import sys as _sys
+    from contextlib import redirect_stdout
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools', 'bandwidth'))
+    import measure
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        measure.main(['--network', 'resnet50_v1',
+                      '--num-batches', str(args.iters),
+                      '--warmup', str(args.warmup)])
+    res = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    return {
+        'metric': 'kvstore_pushpull_bandwidth',
+        'value': res['value'],
+        'unit': res['unit'],
+        'vs_baseline': round(res['value'] / 12.5, 3),
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='resnet50_v1')
@@ -140,6 +169,8 @@ def main():
 
     if args.model in ('bert_base', 'bert', 'bert_12_768_12'):
         result = bench_bert(args, mx)
+    elif args.model == 'kvstore':
+        result = bench_kvstore(args)
     else:
         result = bench_resnet(args, mx)
     print(json.dumps(result))
