@@ -82,3 +82,9 @@ def softmax(data, axis=-1, **kw):
 # mx.nd.contrib / npx) — these take Python callables, so they are plain
 # functions rather than registry ops
 from ..ops.control_flow import cond, foreach, while_loop  # noqa: E402
+
+
+# npx.save/load — NumPy-frontend NDArray map (de)serialization (reference
+# python/mxnet/numpy_extension/utils.py:save/load over NDArray::Save/Load)
+from ..model import save_ndarray_map as save     # noqa: E402
+from ..model import load_ndarray_map as load     # noqa: E402
