@@ -53,16 +53,16 @@ class DynamicLossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
-        """True when any gradient is non-finite (reference uses the fused
-        multi_all_finite kernel; one jitted pass here)."""
+        """True when any gradient is non-finite. All per-grad reductions
+        stack into one device value so there is exactly ONE host sync
+        (the role of the reference's fused multi_all_finite kernel)."""
         import jax.numpy as jnp
-        for param in params:
-            if param.grad_req == 'null':
-                continue
-            for g in param.list_grad():
-                if not bool(jnp.isfinite(g._data).all()):
-                    return True
-        return False
+        flags = [jnp.isfinite(g._data).all()
+                 for param in params if param.grad_req != 'null'
+                 for g in param.list_grad()]
+        if not flags:
+            return False
+        return not bool(jnp.stack(flags).all())
 
     def update_scale(self, overflow):
         if overflow:
@@ -98,9 +98,10 @@ def scale_loss(loss, trainer):
 
 
 def unscale(trainer):
-    """Divide gradients by the current scale; on overflow, zero them (the
-    step is effectively skipped) and shrink the scale — reference
-    loss_scaler.py semantics."""
+    """Divide gradients by the current scale; on overflow, zero them,
+    shrink the scale, and arm the trainer's skip flag so the next
+    ``step()`` applies NO update at all (weight decay / momentum included)
+    — reference loss_scaler.py semantics."""
     scaler = getattr(trainer, '_amp_loss_scaler', None)
     if scaler is None:
         return True
@@ -113,6 +114,8 @@ def unscale(trainer):
             g._rebind(jnp.zeros_like(g._data) if overflow
                       else g._data / scaler.loss_scale)
     scaler.update_scale(overflow)
+    if overflow:
+        trainer._amp_skip_update = True
     return not overflow
 
 

@@ -253,8 +253,17 @@ def _concat(b, node, ins, out):
 @_converts('clip')
 def _clip(b, node, ins, out):
     kw = node.kwargs
-    amin = kw.get('a_min')
-    amax = kw.get('a_max')
+
+    def bound(name, pos):
+        v = kw.get(name)
+        if v is None and node.args_spec and len(node.args_spec) > pos:
+            spec = node.args_spec[pos]       # positional numpy signature
+            if isinstance(spec, (int, float)) and not isinstance(spec, bool):
+                v = spec
+        return v
+
+    amin = bound('a_min', 1)
+    amax = bound('a_max', 2)
     lo = b.const('min', _np.float32(amin)) if amin is not None else ''
     hi = b.const('max', _np.float32(amax)) if amax is not None else ''
     b.add('Clip', [ins[0], lo, hi], [out])

@@ -175,10 +175,11 @@ def _import_node(imp, node):
     if op in ('Dropout', 'Identity'):
         return S(0)
     if op == 'Clip':
+        # opset 11+: bounds as optional inputs; opset < 11: attributes
         amin = float(imp.const(ins[1]).item()) if len(ins) > 1 and ins[1] \
-            else None
+            else at.get('min')
         amax = float(imp.const(ins[2]).item()) if len(ins) > 2 and ins[2] \
-            else None
+            else at.get('max')
         return _invoke('clip', [S(0)],
                        dict(a_min=amin, a_max=amax))
     if op == 'Softmax':

@@ -188,6 +188,11 @@ class Trainer:
         """
         if self._update_on_kvstore:
             return  # server-side update already applied by pushpull
+        if getattr(self, '_amp_skip_update', False):
+            # amp.unscale detected a gradient overflow: skip this update
+            # entirely (no wd/momentum mutation on zeroed grads)
+            self._amp_skip_update = False
+            return
         live = []
         for i, param in enumerate(self._params):
             if param.grad_req == 'null' or param._data is None:

@@ -329,11 +329,28 @@ class ImageIter:
     def __next__(self):
         return self.next()
 
+    # per-sample hooks (overridden by ImageDetIter)
+    def _label_shape(self):
+        return (self.label_width,)
+
+    def _process_sample(self, img, label):
+        """Augment one sample → (image NDArray, label row)."""
+        for aug in self.auglist:
+            img = aug(img)
+        row = _np.atleast_1d(
+            label.asnumpy() if isinstance(label, NDArray) else label
+        )[:self.label_width]
+        return img, row
+
+    def _finalize_labels(self, labels):
+        return labels[:, 0] if self.label_width == 1 else labels
+
     def next(self):
         from ..io import DataBatch
         c, h, w = self.data_shape
         data = _np.zeros((self.batch_size, h, w, c), 'float32')
-        labels = _np.zeros((self.batch_size, self.label_width), 'float32')
+        labels = _np.full((self.batch_size,) + self._label_shape(), -1.0,
+                          'float32')
         i = 0
         pad = 0
         while i < self.batch_size:
@@ -346,17 +363,13 @@ class ImageIter:
                 break
             if not isinstance(img, NDArray):
                 img = array(img)
-            for aug in self.auglist:
-                img = aug(img)
+            img, labels[i] = self._process_sample(img, label)
             data[i] = img.asnumpy()
-            labels[i] = _np.atleast_1d(
-                label.asnumpy() if isinstance(label, NDArray) else label
-            )[:self.label_width]
             i += 1
         batch_data = array(data.transpose(0, 3, 1, 2))   # NCHW
-        batch_label = array(labels[:, 0] if self.label_width == 1
-                            else labels)
-        return DataBatch(data=[batch_data], label=[batch_label], pad=pad)
+        return DataBatch(data=[batch_data],
+                         label=[array(self._finalize_labels(labels))],
+                         pad=pad)
 
 
 # --------------------------------------------------------- detection iter
@@ -418,31 +431,17 @@ class ImageDetIter(ImageIter):
         out[:len(objs)] = objs
         return out
 
-    def next(self):
-        from ..io import DataBatch
-        c, h, w = self.data_shape
-        data = _np.zeros((self.batch_size, h, w, c), 'float32')
-        labels = _np.full((self.batch_size, self.max_objects, 5), -1.0,
-                          'float32')
-        i = 0
-        pad = 0
-        while i < self.batch_size:
-            try:
-                label, img = self.next_sample()
-            except StopIteration:
-                if i == 0:
-                    raise
-                pad = self.batch_size - i
-                break
-            if not isinstance(img, NDArray):
-                img = array(img)
-            for aug in self.auglist:
-                img = aug(img)
-            lab = self._parse_label(label)
-            for aug in self._det_augs:
-                img, lab = aug(img, lab)
-            data[i] = img.asnumpy()
-            labels[i] = lab
-            i += 1
-        return DataBatch(data=[array(data.transpose(0, 3, 1, 2))],
-                         label=[array(labels)], pad=pad)
+    # hooks into the shared ImageIter.next batch loop
+    def _label_shape(self):
+        return (self.max_objects, 5)
+
+    def _process_sample(self, img, label):
+        for aug in self.auglist:
+            img = aug(img)
+        lab = self._parse_label(label)
+        for aug in self._det_augs:
+            img, lab = aug(img, lab)
+        return img, lab
+
+    def _finalize_labels(self, labels):
+        return labels

@@ -454,9 +454,11 @@ class ImageRecordIter(DataIter):
         if n < 0:
             raise IOError('record decode failed')
         from ..ndarray.ndarray import array
-        data = array(self._data_buf)
-        label = array(self._label_buf[:, 0] if self.label_width == 1
-                      else self._label_buf)
+        # copy: device_put may zero-copy alias the aligned host buffer on
+        # CPU, and the next ipipe_next overwrites it in place
+        data = array(self._data_buf.copy())
+        label = array(self._label_buf[:, 0].copy()
+                      if self.label_width == 1 else self._label_buf.copy())
         return DataBatch(data=[data], label=[label],
                          pad=self.batch_size - int(n))
 

@@ -237,6 +237,17 @@ bool ProcessSample(Pipe* p, int64_t rec, float* data_slot, float* label_slot,
     sw = cw;
     sh = ch;
   }
+  if (sw < cw || sh < ch) {
+    // resize-short smaller than the crop: upscale to cover the crop
+    // window instead of reading past the buffer
+    std::vector<uint8_t> cover;
+    int nw = std::max(sw, cw), nh = std::max(sh, ch);
+    Resize(*src, sw, sh, &cover, nw, nh);
+    resized = std::move(cover);
+    src = &resized;
+    sw = nw;
+    sh = nh;
+  }
   int x0 = 0, y0 = 0;
   if (sw > cw || sh > ch) {
     if (p->rand_crop) {

@@ -233,3 +233,30 @@ def test_amp_dynamic_loss_scaler():
     assert scaler.loss_scale == s0 / 2
     assert (net.weight.grad().asnumpy() == 0).all()
     amp._state['enabled'] = False
+
+
+def test_amp_overflow_skips_trainer_update():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, autograd, gluon
+
+    amp.init(target_dtype='float16')
+    net = gluon.nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1, 'momentum': 0.9,
+                             'wd': 0.1})
+    amp.init_trainer(trainer)
+    w_before = net.weight.data().asnumpy().copy()
+    x = mx.np.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    net.weight.grad()._rebind(
+        mx.np.array(np.full((2, 2), np.inf, 'f'))._data)
+    ok = amp.unscale(trainer)
+    assert not ok
+    trainer.step(1)
+    # overflow step applies NO update: wd/momentum untouched
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_before)
+    amp._state['enabled'] = False

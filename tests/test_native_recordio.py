@@ -185,3 +185,26 @@ def test_native_image_iter_normalization_and_mirror(tmp_path):
     d = it.next().data[0].asnumpy()
     assert abs(d.mean()) < 1.0                # roughly centered
     it.close()
+
+
+def test_native_image_iter_resize_smaller_than_crop(tmp_path):
+    """resize-short below the crop size must upscale, not read OOB."""
+    from mxnet_tpu.io import ImageRecordIter
+    rec_path, _ = _pack_rec(tmp_path, n=4, hw=(64, 48))
+    it = ImageRecordIter(rec_path, data_shape=(3, 32, 32), batch_size=4,
+                         resize=16)          # short side 16 < crop 32
+    d = it.next().data[0].asnumpy()
+    assert d.shape == (4, 3, 32, 32)
+    assert onp.isfinite(d).all() and d.std() > 1
+    it.close()
+
+
+def test_image_record_iter_batches_do_not_alias(tmp_path):
+    from mxnet_tpu.io import ImageRecordIter
+    rec_path, _ = _pack_rec(tmp_path, n=10)
+    it = ImageRecordIter(rec_path, data_shape=(3, 32, 32), batch_size=5)
+    b1 = it.next().data[0]
+    snap = b1.asnumpy().copy()
+    it.next()                                 # refills the host buffer
+    onp.testing.assert_array_equal(b1.asnumpy(), snap)
+    it.close()
