@@ -66,7 +66,14 @@ class _Builder:
     def __init__(self):
         self.nodes = []
         self.initializers = []
+        self.shapes = {}      # (node uid, out idx) -> shape tuple
         self._uid = 0
+
+    def shape_of(self, entry):
+        """Static shape of a symbol entry (node, out_idx), from the
+        abstract-eval pre-pass; None when input shapes were not given."""
+        node, idx = entry
+        return self.shapes.get((node.uid, idx))
 
     def uname(self, base):
         self._uid += 1
@@ -229,7 +236,10 @@ def _transpose(b, node, ins, out):
 
 @_converts('expand_dims')
 def _expand(b, node, ins, out):
-    ax = b.const('axes', _np.asarray([node.kwargs['axis']], _np.int64))
+    axis = node.kwargs.get('axis')
+    if axis is None and len(node.args_spec) > 1:       # positional call
+        axis = node.args_spec[1]
+    ax = b.const('axes', _np.asarray([axis], _np.int64))
     b.add('Unsqueeze', [ins[0], ax], [out])
 
 
@@ -318,9 +328,11 @@ def _gelu(b, node, ins, out):
     b.add('Mul', [xm, half], [out])
 
 
-def _infer_outputs(sym, params, free_inputs, shapes, types):
+def _infer_outputs(sym, params, free_inputs, shapes, types, shape_env=None):
     """Abstract-eval the symbol → list of ShapeDtypeStruct (or Nones when
-    input shapes are unknown)."""
+    input shapes are unknown). When ``shape_env`` (a dict) is given, every
+    node's output shapes are recorded into it keyed (uid, out_idx) — the
+    exporter's shape pre-pass for converters that need static shapes."""
     import jax
     from ... import _tape
     from ...ndarray.ndarray import NDArray
@@ -333,11 +345,16 @@ def _infer_outputs(sym, params, free_inputs, shapes, types):
     specs += [jax.ShapeDtypeStruct(v.shape, v.dtype)
               for v in params.values()]
 
+    def tap(node, outs):
+        if shape_env is not None:
+            for i, o in enumerate(outs):
+                shape_env[(node.uid, i)] = tuple(o.shape)
+
     def run(*raws):
         prev = _tape.set_recording(False)
         try:
             outs = sym._execute(
-                {n: NDArray(r) for n, r in zip(names, raws)})
+                {n: NDArray(r) for n, r in zip(names, raws)}, tap=tap)
             return [o._data for o in outs]
         finally:
             _tape.set_recording(prev)
@@ -373,20 +390,27 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
     graph = _pb.GraphProto(name=sym.name)
     out_names = {}                      # (node uid, out idx) -> onnx name
 
+    free_inputs = [n.name for n in sym._topo()
+                   if n.op == 'null' and n.name not in params]
+    shapes = list(input_shapes or [])
+    types = input_types if isinstance(input_types, (list, tuple)) \
+        else [input_types] * len(free_inputs)
+    # pre-pass: abstract-eval for output ValueInfos AND per-node shapes
+    # (b.shapes) used by shape-dependent converters (attention, getitem)
+    out_infos = _infer_outputs(sym, params, free_inputs, shapes, types,
+                               shape_env=b.shapes)
+
     def in_name(entry):
         node, idx = entry
         if node.op == 'null':
             return node.name
         return out_names[(node.uid, idx)]
 
-    free_inputs = []
     for node in sym._topo():
         if node.op == 'null':
             if node.name in params:
                 graph.initializer.append(
                     _tensor(node.name, params[node.name]))
-            else:
-                free_inputs.append(node.name)
             continue
         if node.op == '_constant':
             value = _np.asarray(node.kwargs['value'],
@@ -420,22 +444,19 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
         for i in range(node.n_out):
             out_names[(node.uid, i)] = (
                 f'{node.name}_out{i}' if node.n_out > 1 else node.name)
-        conv(b, node, ins, out_names[(node.uid, 0)])
+        # multi-output converters (split) receive the full name list
+        out_arg = out_names[(node.uid, 0)] if node.n_out == 1 else \
+            [out_names[(node.uid, i)] for i in range(node.n_out)]
+        conv(b, node, ins, out_arg)
 
     graph.node.extend(b.nodes)
     graph.initializer.extend(b.initializers)
 
-    shapes = list(input_shapes or [])
-    types = input_types if isinstance(input_types, (list, tuple)) \
-        else [input_types] * len(free_inputs)
     for i, name in enumerate(free_inputs):
         shape = shapes[i] if i < len(shapes) else ()
         graph.input.append(
             _vinfo(name, shape, _np.dtype(types[i]).name))
 
-    # graph outputs need full ValueInfo (elem_type at minimum, per spec);
-    # abstract-eval the symbol to recover output shapes/dtypes
-    out_infos = _infer_outputs(sym, params, free_inputs, shapes, types)
     for entry, info in zip(sym._outputs, out_infos):
         if info is None:
             v = _pb.ValueInfoProto(name=in_name(entry))
@@ -451,3 +472,123 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
     with open(onnx_file_path, 'wb') as f:
         f.write(model.SerializeToString())
     return onnx_file_path
+
+
+@_converts('split')
+def _split(b, node, ins, outs):
+    """Equal split along an axis → ONNX Split with explicit sizes (opset
+    13-17 form; num_outputs attr only exists from 18)."""
+    if isinstance(outs, str):
+        outs = [outs]
+    kw = node.kwargs
+    sections = kw.get('indices_or_sections')
+    if sections is None and len(node.args_spec) > 1:
+        sections = node.args_spec[1]
+    axis = int(kw.get('axis', 0))
+    in_shape = b.shape_of(node.inputs[0])
+    if not isinstance(sections, int):
+        raise NotImplementedError('split with explicit indices unsupported '
+                                  'in ONNX export (equal sections only)')
+    if in_shape is None:
+        raise NotImplementedError(
+            'split export needs input_shapes= for the size computation')
+    size = in_shape[axis] // sections
+    sp = b.const('split', _np.full(sections, size, _np.int64))
+    b.add('Split', [ins[0], sp], list(outs), axis=axis)
+
+
+@_converts('_npi_getitem')
+def _getitem(b, node, ins, out):
+    """Basic indexing (ints/slices, no steps/newaxis) → Slice (+ Squeeze
+    for integer axes)."""
+    key = node.kwargs.get('key')
+    in_shape = b.shape_of(node.inputs[0])
+    if in_shape is None:
+        raise NotImplementedError(
+            'getitem export needs input_shapes= for bound computation')
+    if not isinstance(key, tuple):
+        key = (key,)
+    if any(k is Ellipsis for k in key):
+        # expand ellipsis to full slices
+        n_given = sum(1 for k in key if k is not Ellipsis)
+        fill = (slice(None),) * (len(in_shape) - n_given)
+        i = key.index(Ellipsis)
+        key = key[:i] + fill + key[i + 1:]
+    starts, ends, axes, squeeze_axes = [], [], [], []
+    for ax, k in enumerate(key):
+        dim = in_shape[ax]
+        if isinstance(k, int):
+            s = k if k >= 0 else k + dim
+            starts.append(s)
+            ends.append(s + 1)
+            axes.append(ax)
+            squeeze_axes.append(ax)
+        elif isinstance(k, slice):
+            if k.step not in (None, 1):
+                raise NotImplementedError('strided getitem unsupported in '
+                                          'ONNX export')
+            s = 0 if k.start is None else (k.start if k.start >= 0
+                                           else k.start + dim)
+            e = dim if k.stop is None else (k.stop if k.stop >= 0
+                                            else k.stop + dim)
+            if (s, e) != (0, dim):
+                starts.append(s)
+                ends.append(e)
+                axes.append(ax)
+        else:
+            raise NotImplementedError(
+                f'getitem key element {k!r} unsupported in ONNX export')
+    cur = ins[0]
+    if axes:
+        cur = b.add('Slice', [
+            cur, b.const('starts', _np.asarray(starts, _np.int64)),
+            b.const('ends', _np.asarray(ends, _np.int64)),
+            b.const('axes', _np.asarray(axes, _np.int64))],
+            [b.uname('sliced') if squeeze_axes else out])
+    if squeeze_axes:
+        b.add('Squeeze', [cur, b.const(
+            'sq_axes', _np.asarray(squeeze_axes, _np.int64))], [out])
+    elif not axes:
+        b.add('Identity', [ins[0]], [out])
+
+
+@_converts('multi_head_attention')
+def _mha(b, node, ins, out):
+    """Decompose fused attention into MatMul/Softmax primitives using the
+    static shapes from the pre-pass (mask-free case, as traced by BERT
+    with no valid_length)."""
+    kw = node.kwargs
+    if len(ins) > 3 or kw.get('mask') is not None:
+        raise NotImplementedError(
+            'multi_head_attention export supports the unmasked q/k/v form')
+    heads = kw.get('num_heads')
+    if heads is None and len(node.args_spec) > 3:
+        heads = node.args_spec[3]
+    q_shape = b.shape_of(node.inputs[0])
+    k_shape = b.shape_of(node.inputs[1])
+    if q_shape is None:
+        raise NotImplementedError(
+            'attention export needs input_shapes= for head reshapes')
+    B, Sq, E = q_shape
+    Sk = k_shape[1]
+    hd = E // heads
+
+    def to_heads(name, S):
+        r = b.add('Reshape', [name, b.const(
+            'hshape', _np.asarray([B, S, heads, hd], _np.int64))],
+            [b.uname('heads')])
+        return b.add('Transpose', [r], [b.uname('bhsd')],
+                     perm=[0, 2, 1, 3])
+
+    qh = to_heads(ins[0], Sq)
+    kh = to_heads(ins[1], Sk)
+    vh = to_heads(ins[2], Sk)
+    kt = b.add('Transpose', [kh], [b.uname('kt')], perm=[0, 1, 3, 2])
+    scores = b.add('MatMul', [qh, kt], [b.uname('scores')])
+    scaled = b.add('Mul', [scores, b.const(
+        'scale', _np.float32(hd ** -0.5))], [b.uname('scaled')])
+    probs = b.add('Softmax', [scaled], [b.uname('probs')], axis=-1)
+    ctxv = b.add('MatMul', [probs, vh], [b.uname('ctx')])
+    back = b.add('Transpose', [ctxv], [b.uname('back')], perm=[0, 2, 1, 3])
+    b.add('Reshape', [back, b.const(
+        'oshape', _np.asarray([B, Sq, E], _np.int64))], [out])

@@ -165,6 +165,23 @@ def _import_node(imp, node):
     if op == 'Concat':
         return _invoke('concat', [imp.sym(i) for i in ins],
                        dict(axis=at.get('axis', 0)))
+    if op == 'Split':
+        sizes = (tuple(int(v) for v in imp.const(ins[1]))
+                 if len(ins) > 1 else at.get('split'))
+        axis = at.get('axis', 0)
+        if sizes and len(set(sizes)) == 1:
+            return _invoke('split', [S(0), len(sizes)], dict(axis=axis))
+        raise NotImplementedError('non-equal Split import unsupported')
+    if op == 'Slice':
+        starts = [int(v) for v in imp.const(ins[1])]
+        ends = [int(v) for v in imp.const(ins[2])]
+        axes = ([int(v) for v in imp.const(ins[3])] if len(ins) > 3
+                else list(range(len(starts))))
+        out_s = S(0)
+        for s, e, ax in zip(starts, ends, axes):
+            out_s = _invoke('slice_axis', [out_s, ax, s,
+                                           None if e >= 2 ** 31 else e], {})
+        return out_s
     if op == 'Gather':
         if at.get('axis', 0) != 0:
             raise NotImplementedError('Gather only on axis 0')
@@ -234,9 +251,15 @@ def import_model(model_file):
         if vi.name not in imp.env:
             imp.env[vi.name] = var(vi.name)
 
+    from ...symbol import Symbol as _Sym
     for node in g.node:
         out = _import_node(imp, node)
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        if isinstance(out, (list, tuple)):
+            outs = list(out)
+        elif isinstance(out, _Sym) and len(out) > 1:
+            outs = list(out)            # expand multi-output symbol
+        else:
+            outs = [out]
         for name, s in zip(node.output, outs):
             imp.env[name] = s
 

@@ -206,8 +206,10 @@ class Symbol:
     __call__ = compose
 
     # ------------------------------------------------------------ execution
-    def _execute(self, bindings, default=None):
-        """Replay through registry.invoke. ``bindings``: name → NDArray."""
+    def _execute(self, bindings, default=None, tap=None):
+        """Replay through registry.invoke. ``bindings``: name → NDArray.
+        ``tap(node, outputs)`` is called per executed node (used by the
+        ONNX exporter's shape pre-pass under jax.eval_shape)."""
         from ..ndarray.ndarray import NDArray
         from ..ops.registry import get_op, invoke
 
@@ -253,6 +255,8 @@ class Symbol:
                 kwargs = {k: subst(v, node) for k, v in node.kwargs.items()}
                 res = invoke(op, tuple(args), kwargs)
                 values[id(node)] = res if isinstance(res, tuple) else (res,)
+            if tap is not None:
+                tap(node, values[id(node)])
         return [values[id(n)][i] for n, i in self._outputs]
 
     def eval(self, ctx=None, **kwargs):

@@ -140,3 +140,32 @@ def test_stochastic_op_under_abstract_eval_does_not_leak_tracers(tmp_path):
     # eager RNG still healthy after the abstract eval
     out = mx.np.random.uniform(0, 1, (3,))
     assert np.isfinite(out.asnumpy()).all()
+
+
+def test_bert_encoder_onnx_roundtrip(tmp_path):
+    """The transformer stack exports: fused attention decomposes into
+    MatMul/Softmax primitives, qkv split and CLS-token slicing convert."""
+    from mxnet_tpu.gluon.model_zoo import bert
+    net = bert.get_bert_model(num_layers=2, vocab_size=100, units=32,
+                              hidden_size=64, num_heads=2, dropout=0.0,
+                              use_decoder=False, use_classifier=False)
+    net.initialize()
+    toks = mx.np.array(np.random.randint(1, 100, (2, 6)).astype('f'))
+    segs = mx.np.zeros((2, 6))
+    seq, pooled = net(toks, segs)
+
+    sym = net._trace_symbol(toks, segs)
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = str(tmp_path / 'bert.onnx')
+    mx.contrib.onnx.export_model(sym, params,
+                                 input_shapes=[(2, 6), (2, 6)],
+                                 onnx_file_path=path)
+    sym2, arg_params, _ = mx.contrib.onnx.import_model(path)
+    bindings = dict(arg_params)
+    names = [n for n in sym2.list_arguments() if n not in arg_params]
+    got = sym2.eval(**bindings, **dict(zip(sorted(names),
+                                           [toks, segs])))
+    assert_almost_equal(got[0].asnumpy(), seq.asnumpy(),
+                        rtol=1e-4, atol=1e-4)
+    assert_almost_equal(got[1].asnumpy(), pooled.asnumpy(),
+                        rtol=1e-4, atol=1e-4)
