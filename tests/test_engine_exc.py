@@ -73,3 +73,50 @@ def test_waitall_after_many_async_ops():
     mx.nd.waitall()
     for i, y in enumerate(ys):
         assert float(y.asnumpy()[0, 0]) == 32.0 * i * i
+
+
+def test_autograd_state_is_thread_local():
+    """Recording/training flags are per-thread (reference
+    test_thread_local.py): a worker thread's record() must not leak into
+    the main thread."""
+    import threading
+
+    flags = {}
+
+    def worker():
+        with autograd.record():
+            flags['worker_inside'] = autograd.is_recording()
+            ev_main.set()
+            ev_worker.wait(5)
+        flags['worker_after'] = autograd.is_recording()
+
+    ev_main, ev_worker = threading.Event(), threading.Event()
+    t = threading.Thread(target=worker)
+    t.start()
+    ev_main.wait(5)
+    flags['main_during'] = autograd.is_recording()
+    ev_worker.set()
+    t.join()
+    assert flags == {'worker_inside': True, 'main_during': False,
+                     'worker_after': False}
+
+
+def test_concurrent_eager_ops():
+    """Parallel threads dispatching eager ops get correct results
+    (the engine contract the reference tests via threaded push)."""
+    import threading
+
+    results = [None] * 4
+
+    def worker(i):
+        x = mx.np.full((64, 64), float(i + 1))
+        y = (x @ x).sum()
+        results[i] = float(y.asnumpy())
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for i, r in enumerate(results):
+        assert r == 64.0 * 64 * 64 * (i + 1) ** 2
