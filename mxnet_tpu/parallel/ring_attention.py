@@ -60,16 +60,30 @@ def ring_attention_kernel(q, k, v, axis_name='sp', causal=False):
     def body(i, carry):
         m, l, o, k_blk, v_blk = carry
         src_idx = (my_idx - i) % axis_size  # whose K/V we now hold
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
         if causal:
-            # block-level causality: full block if src < mine, diagonal if ==
-            q_pos = my_idx * Sl + jnp.arange(Sl)[:, None]
-            k_pos = src_idx * Sl + jnp.arange(Sl)[None, :]
-            mask = (q_pos >= k_pos)[None, None]
+            # block-level causality: src > mine → fully masked (SKIP the
+            # matmuls — half the ring steps); src == mine → diagonal mask;
+            # src < mine → fully visible, no mask needed
+            def full_block(mlo):
+                return _online_block(qf, kf, vf, *mlo, scale)
+
+            def diag_block(mlo):
+                q_pos = jnp.arange(Sl)[:, None]
+                k_pos = jnp.arange(Sl)[None, :]
+                mask = (q_pos >= k_pos)[None, None]
+                return _online_block(qf, kf, vf, *mlo, scale, mask)
+
+            def skip_block(mlo):
+                return mlo
+
+            case = jnp.where(src_idx > my_idx, 2,
+                             jnp.where(src_idx == my_idx, 1, 0))
+            m, l, o = lax.switch(case, [full_block, diag_block, skip_block],
+                                 (m, l, o))
         else:
-            mask = None
-        m, l, o = _online_block(qf, k_blk.astype(jnp.float32),
-                                v_blk.astype(jnp.float32), m, l, o, scale,
-                                mask)
+            m, l, o = _online_block(qf, kf, vf, m, l, o, scale)
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
