@@ -28,10 +28,14 @@ def rule(*names, **gen):
         _RULES[n] = gen
 
 
-def _register_rules(np_):
-    """Input-shape rules per op family (≙ benchmark/opperf/rules/)."""
+def _register_rules(np_, large=(1024, 1024), nn_scale=8):
+    """Input-shape rules per op family (≙ benchmark/opperf/rules/).
+
+    ``large``/``nn_scale`` shrink the inputs for the correctness sweep in
+    tests/test_op_sweep.py (bench uses the defaults)."""
     u = lambda *s: np_.random.uniform(0.5, 1.5, s).astype('float32')  # noqa: E731
-    LARGE = (1024, 1024)
+    LARGE = large
+    sc = nn_scale
 
     for n in ['exp', 'log', 'sqrt', 'sin', 'cos', 'tanh', 'abs', 'square',
               'relu', 'sigmoid', 'erf', 'gelu', 'softplus', 'silu', 'sign',
@@ -43,44 +47,63 @@ def _register_rules(np_):
         rule(n, args=lambda u=u: (u(*LARGE), u(*LARGE)))
     for n in ['sum', 'mean', 'max', 'min', 'prod', 'var', 'std']:
         rule(n, args=lambda u=u: (u(*LARGE),))
-    rule('dot', args=lambda u=u: (u(1024, 1024), u(1024, 1024)))
-    rule('matmul', args=lambda u=u: (u(32, 256, 256), u(32, 256, 256)))
-    rule('batch_dot', args=lambda u=u: (u(32, 256, 256), u(32, 256, 256)))
-    rule('einsum', args=lambda u=u: ('bij,bjk->bik', u(32, 256, 256),
-                                     u(32, 256, 256)))
+    rule('dot', args=lambda u=u: (u(*LARGE), u(*LARGE)))
+    rule('matmul', args=lambda u=u, sc=sc: (u(4 * sc, 32 * sc, 32 * sc),
+                                            u(4 * sc, 32 * sc, 32 * sc)))
+    rule('batch_dot', args=lambda u=u, sc=sc: (u(4 * sc, 32 * sc, 32 * sc),
+                                               u(4 * sc, 32 * sc, 32 * sc)))
+    rule('einsum', args=lambda u=u, sc=sc: ('bij,bjk->bik',
+                                            u(4 * sc, 32 * sc, 32 * sc),
+                                            u(4 * sc, 32 * sc, 32 * sc)))
     rule('transpose', args=lambda u=u: (u(*LARGE),))
-    rule('reshape', args=lambda u=u: (u(*LARGE), (512, 2048)))
-    rule('concat', args=lambda u=u: ([u(512, 512), u(512, 512)],),
+    rule('reshape', args=lambda u=u: (u(*LARGE),),
+         kwargs_fn=lambda LARGE=LARGE: {'newshape':
+                                        (LARGE[0] // 2, LARGE[1] * 2)})
+    rule('concat', args=lambda u=u, sc=sc: ([u(64 * sc, 64 * sc),
+                                             u(64 * sc, 64 * sc)],),
          kwargs={'axis': 0})
-    rule('softmax', 'log_softmax', args=lambda u=u: (u(128, 1024),))
-    rule('topk', args=lambda u=u: (u(128, 1024),), kwargs={'k': 8},
+    rule('softmax', 'log_softmax',
+         args=lambda u=u, sc=sc: (u(16 * sc, 128 * sc),))
+    rule('topk', args=lambda u=u, sc=sc: (u(16 * sc, 128 * sc),),
+         kwargs={'k': 8}, no_grad=True)
+    rule('sort', 'argsort', args=lambda u=u, sc=sc: (u(16 * sc, 128 * sc),),
          no_grad=True)
-    rule('sort', 'argsort', args=lambda u=u: (u(128, 1024),), no_grad=True)
-    rule('argmax', 'argmin', args=lambda u=u: (u(128, 1024),), no_grad=True)
+    rule('argmax', 'argmin',
+         args=lambda u=u, sc=sc: (u(16 * sc, 128 * sc),), no_grad=True)
     rule('fully_connected',
-         args=lambda u=u: (u(64, 1024), u(1024, 1024), u(1024)),
-         kwargs={'num_hidden': 1024})
+         args=lambda u=u, sc=sc: (u(8 * sc, 128 * sc), u(128 * sc, 128 * sc),
+                                  u(128 * sc)),
+         kwargs_fn=lambda sc=sc: {'num_hidden': 128 * sc})
     rule('convolution',
-         args=lambda u=u: (u(32, 64, 56, 56), u(64, 64, 3, 3), u(64)),
-         kwargs={'kernel': (3, 3), 'pad': (1, 1), 'num_filter': 64})
-    rule('pooling', args=lambda u=u: (u(32, 64, 56, 56),),
+         args=lambda u=u, sc=sc: (u(4 * sc, 8 * sc, 7 * sc, 7 * sc),
+                                  u(8 * sc, 8 * sc, 3, 3), u(8 * sc)),
+         kwargs_fn=lambda sc=sc: {'kernel': (3, 3), 'pad': (1, 1),
+                                  'num_filter': 8 * sc})
+    rule('pooling', args=lambda u=u, sc=sc: (u(4 * sc, 8 * sc, 7 * sc,
+                                               7 * sc),),
          kwargs={'kernel': (2, 2), 'stride': (2, 2), 'pool_type': 'max'})
     rule('batch_norm_inference',
-         args=lambda u=u: (u(32, 64, 56, 56), u(64), u(64), u(64),
-                           u(64) * 0 + 1))
-    rule('layer_norm', args=lambda u=u: (u(64, 1024), u(1024), u(1024)))
-    rule('rms_norm', args=lambda u=u: (u(64, 1024), u(1024)))
-    rule('embedding', args=lambda np_=np_, u=u: (
-        np_.random.randint(0, 1000, (64, 128)).astype('float32'),
-        u(1000, 512)))
+         args=lambda u=u, sc=sc: (u(4 * sc, 8 * sc, 7 * sc, 7 * sc),
+                                  u(8 * sc), u(8 * sc), u(8 * sc),
+                                  u(8 * sc) * 0 + 1))
+    rule('layer_norm', args=lambda u=u, sc=sc: (u(8 * sc, 128 * sc),
+                                                u(128 * sc), u(128 * sc)))
+    rule('rms_norm', args=lambda u=u, sc=sc: (u(8 * sc, 128 * sc),
+                                              u(128 * sc)))
+    rule('embedding', args=lambda np_=np_, u=u, sc=sc: (
+        np_.random.randint(0, 100, (8 * sc, 16 * sc)).astype('float32'),
+        u(100, 64 * sc)))
     rule('multi_head_attention',
-         args=lambda u=u: (u(8, 512, 512), u(8, 512, 512), u(8, 512, 512)),
+         args=lambda u=u, sc=sc: (u(2 * sc, 64 * sc, 64 * sc),
+                                  u(2 * sc, 64 * sc, 64 * sc),
+                                  u(2 * sc, 64 * sc, 64 * sc)),
          kwargs={'num_heads': 8})
     rule('flash_attention',
-         args=lambda u=u: (u(8, 8, 512, 64), u(8, 8, 512, 64),
-                           u(8, 8, 512, 64)))
-    rule('take', args=lambda np_=np_, u=u: (
-        u(1000, 512), np_.random.randint(0, 1000, (4096,))
+         args=lambda u=u, sc=sc: (u(2, 2 * sc, 64 * sc, 64),
+                                  u(2, 2 * sc, 64 * sc, 64),
+                                  u(2, 2 * sc, 64 * sc, 64)))
+    rule('take', args=lambda np_=np_, u=u, sc=sc: (
+        u(100, 64 * sc), np_.random.randint(0, 100, (64 * sc,))
         .astype('float32')))
     rule('where', args=lambda np_=np_, u=u: (
         (np_.random.uniform(size=LARGE) > .5), u(*LARGE), u(*LARGE)))
@@ -112,7 +135,8 @@ def bench_op(mx, name, runs=10, warmup=3, backward=True):
     raw_args = [a for a in spec['args']()]
     args = [mx.np.array(a) if isinstance(a, np.ndarray) else a
             for a in raw_args]
-    kwargs = spec.get('kwargs', {})
+    kwargs = spec['kwargs_fn']() if 'kwargs_fn' in spec \
+        else spec.get('kwargs', {})
     fn = getattr(mx.npx, name, None) or getattr(mx.np, name)
 
     def fwd():

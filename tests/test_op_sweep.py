@@ -1,0 +1,71 @@
+"""Rule-driven op sweep: forward correctness (finite, right container)
+and gradient health for every op with an opperf rule — the breadth role of
+the reference's test_operator.py numeric sweep, sharing the rules with
+benchmark/opperf.py so bench and test coverage never drift apart."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ops.registry import get_op
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'benchmark'))
+import opperf  # noqa: E402
+
+opperf._register_rules(np, large=(16, 16), nn_scale=1)
+ALL_RULED = sorted(opperf._RULES)
+
+
+def _build(name):
+    spec = opperf._RULES[name]
+    raw = spec['args']()
+
+    def conv(a):
+        if isinstance(a, np.ndarray):
+            return mx.np.array(a)
+        if isinstance(a, (list, tuple)):
+            return [conv(e) for e in a]
+        return a
+
+    args = [conv(a) for a in raw]
+    kwargs = spec['kwargs_fn']() if 'kwargs_fn' in spec \
+        else spec.get('kwargs', {})
+    fn = getattr(mx.npx, name, None) or getattr(mx.np, name)
+    return spec, fn, args, kwargs
+
+
+@pytest.mark.parametrize('name', ALL_RULED)
+def test_op_forward_finite(name):
+    _, fn, args, kwargs = _build(name)
+    out = fn(*args, **kwargs)
+    first = out[0] if isinstance(out, (tuple, list)) else out
+    a = first.asnumpy()
+    assert np.isfinite(np.asarray(a, dtype='float64')).all(), \
+        f'{name} produced non-finite output'
+
+
+@pytest.mark.parametrize('name', [
+    n for n in ALL_RULED
+    if get_op(n).differentiable and not opperf._RULES[n].get('no_grad')])
+def test_op_grad_finite(name):
+    spec, fn, args, kwargs = _build(name)
+    grads_on = []
+    for a in args:
+        if isinstance(a, (list, tuple)):
+            grads_on += [e for e in a if hasattr(e, 'attach_grad')]
+        elif hasattr(a, 'attach_grad'):
+            grads_on.append(a)
+    for a in grads_on:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(*args, **kwargs)
+        first = out[0] if isinstance(out, (tuple, list)) else out
+        loss = (first * first).mean()
+    loss.backward()
+    g = grads_on[0].grad.asnumpy()
+    assert np.isfinite(g).all(), f'{name} produced non-finite grads'
