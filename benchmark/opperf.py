@@ -116,6 +116,108 @@ def _register_rules(np_, large=(1024, 1024), nn_scale=8):
          args=lambda u=u: (u(*LARGE), u(*LARGE), u(*LARGE), u(*LARGE)),
          no_grad=True)
 
+    # ------------------------------------------------- manipulation family
+    rule('stack', args=lambda u=u: ([u(*LARGE), u(*LARGE)],),
+         kwargs={'axis': 0})
+    rule('tile', args=lambda u=u: (u(*LARGE),), kwargs={'reps': (2, 1)})
+    rule('repeat', args=lambda u=u: (u(*LARGE),),
+         kwargs={'repeats': 2, 'axis': 0})
+    rule('flip', args=lambda u=u: (u(*LARGE),), kwargs={'axis': 0})
+    rule('roll', args=lambda u=u: (u(*LARGE),),
+         kwargs={'shift': 3, 'axis': 0})
+    rule('squeeze', args=lambda u=u, LARGE=LARGE: (
+        u(1, *LARGE),), kwargs={'axis': 0})
+    rule('expand_dims', args=lambda u=u: (u(*LARGE),), kwargs={'axis': 0})
+    rule('swapaxes', args=lambda u=u: (u(*LARGE),),
+         kwargs={'axis1': 0, 'axis2': 1})
+    rule('pad', args=lambda u=u: (u(*LARGE),),
+         kwargs={'pad_width': ((1, 1), (2, 2))})
+    rule('tril', 'triu', args=lambda u=u: (u(*LARGE),))
+    rule('diff', args=lambda u=u: (u(*LARGE),))
+    rule('cumprod', args=lambda u=u: (u(*LARGE),))
+    rule('broadcast_to', args=lambda u=u, LARGE=LARGE: (u(1, LARGE[1]),),
+         kwargs_fn=lambda LARGE=LARGE: {'shape': LARGE})
+    rule('split', args=lambda u=u: (u(*LARGE), 2), kwargs={'axis': 0})
+    rule('take_along_axis', args=lambda np_=np_, u=u, LARGE=LARGE: (
+        u(*LARGE),
+        np_.random.randint(0, LARGE[0], LARGE).astype('int64')),
+        kwargs={'axis': 0})
+    rule('gather_nd', args=lambda np_=np_, u=u, LARGE=LARGE: (
+        u(*LARGE),
+        np_.random.randint(0, LARGE[0], (1, 8)).astype('float32')))
+    rule('one_hot', args=lambda np_=np_, LARGE=LARGE: (
+        np_.random.randint(0, 10, (LARGE[0],)).astype('float32'),),
+        kwargs={'depth': 10}, no_grad=True)
+    rule('unique', args=lambda np_=np_: (
+        np_.random.randint(0, 50, (256,)).astype('float32'),),
+        no_grad=True)
+    rule('searchsorted', args=lambda np_=np_: (
+        np_.sort(np_.random.uniform(size=64)).astype('float32'),
+        np_.random.uniform(size=32).astype('float32')), no_grad=True)
+
+    # ------------------------------------------------------ linalg family
+    def _spd(n):
+        a = np_.random.uniform(0.1, 1.0, (n, n)).astype('float32')
+        return a @ a.T + n * np_.eye(n, dtype='float32')
+
+    rule('linalg_cholesky', args=lambda _spd=_spd: (_spd(24),))
+    rule('linalg_inv', args=lambda _spd=_spd: (_spd(24),))
+    rule('linalg_det', args=lambda _spd=_spd: (_spd(8),))  # det(24I)~1e33 overflows f32 grads
+    rule('linalg_slogdet', args=lambda _spd=_spd: (_spd(24),))
+
+    rule('linalg_qr', args=lambda u=u: (u(24, 16),))
+    rule('linalg_svd', args=lambda u=u: (u(24, 16),), no_grad=True)
+    rule('linalg_eigh', args=lambda _spd=_spd: (_spd(24),))
+    rule('linalg_solve', args=lambda _spd=_spd, u=u: (_spd(24), u(24, 4)))
+    rule('linalg_norm', args=lambda u=u: (u(*LARGE),))
+    rule('linalg_trsm', args=lambda _spd=_spd, u=u: (_spd(16), u(16, 8)))
+    rule('linalg_gemm2', args=lambda u=u: (u(32, 32), u(32, 32)))
+    rule('kron', args=lambda u=u: (u(8, 8), u(4, 4)))
+    rule('tensordot', args=lambda u=u: (u(8, 16), u(16, 8)),
+         kwargs={'axes': 1})
+    rule('outer', args=lambda u=u: (u(32), u(32)))
+    rule('trace', args=lambda u=u: (u(*LARGE),))
+    rule('diagonal', args=lambda u=u: (u(*LARGE),))
+
+    # ------------------------------------------------------- more reduce
+    rule('median', args=lambda u=u: (u(*LARGE),), no_grad=True)
+    rule('percentile', args=lambda u=u: (u(*LARGE), 75.0), no_grad=True)
+    rule('nansum', 'nanmean', args=lambda u=u: (u(*LARGE),))
+    rule('amax', 'amin', 'ptp', args=lambda u=u: (u(*LARGE),))
+    rule('argmax', 'argmin', args=lambda u=u: (u(*LARGE),), no_grad=True)
+    rule('count_nonzero', args=lambda u=u: (u(*LARGE),), no_grad=True)
+
+    # --------------------------------------------------------- nn extras
+    rule('leaky_relu', args=lambda u=u: (u(*LARGE),))
+    rule('hard_sigmoid', 'hard_swish', args=lambda u=u: (u(*LARGE),))
+    rule('l2_normalization', args=lambda u=u, sc=sc: (u(4 * sc, 16 * sc),))
+    rule('group_norm', args=lambda u=u, sc=sc: (
+        u(2, 8, 4 * sc, 4 * sc), u(8), u(8)), kwargs={'num_groups': 2})
+    rule('instance_norm', args=lambda u=u, sc=sc: (
+        u(2, 8, 4 * sc, 4 * sc), u(8), u(8)))
+    rule('lrn', args=lambda u=u, sc=sc: (u(2, 8, 4 * sc, 4 * sc),))
+    rule('moments', args=lambda u=u: (u(*LARGE),))
+    rule('masked_softmax', args=lambda np_=np_, u=u, LARGE=LARGE: (
+        u(*LARGE), (np_.random.uniform(size=LARGE) > 0.3)))
+    rule('im2col', args=lambda u=u, sc=sc: (u(2, 4, 4 * sc, 4 * sc),),
+         kwargs={'kernel': (3, 3), 'pad': (1, 1)})
+    rule('depth_to_space', args=lambda u=u, sc=sc: (
+        u(2, 16, 2 * sc, 2 * sc),), kwargs={'block_size': 2})
+    rule('space_to_depth', args=lambda u=u, sc=sc: (
+        u(2, 4, 4 * sc, 4 * sc),), kwargs={'block_size': 2})
+    rule('rnn', args=lambda np_=np_, u=u: (
+        u(8, 4, 16),
+        np_.random.uniform(-0.1, 0.1,
+                           (4 * 32 * 16 + 4 * 32 * 32 + 2 * 4 * 32,))
+        .astype('float32'), np_.zeros((1, 4, 32), 'float32'),
+        np_.zeros((1, 4, 32), 'float32')),
+        kwargs={'mode': 'lstm', 'state_size': 32, 'num_layers': 1})
+    rule('ctc_loss', args=lambda np_=np_, u=u: (
+        u(16, 4, 12), np_.random.randint(1, 11, (4, 5)).astype('float32')))
+    rule('interleaved_matmul_selfatt_qk',
+         args=lambda u=u, sc=sc: (u(8 * sc, 2, 8 * 3 * 8),),
+         kwargs={'heads': 8})
+
 
 DEFAULT_SET = [
     'relu', 'sigmoid', 'gelu', 'exp', 'add', 'multiply', 'sum', 'mean',

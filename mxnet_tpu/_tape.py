@@ -244,6 +244,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     for info, cot in var_grads.values():
         if info.grad is None or info.grad_req == 'null':
             continue
+        if cot.dtype == jax.dtypes.float0:
+            continue      # integer-dtype variable: no gradient (float0)
         if info.grad_req == 'add':
             info.grad._data = info.grad._data + cot.astype(info.grad._data.dtype)
         else:  # 'write'

@@ -72,8 +72,9 @@ def linalg_norm(x, ord=None, axis=None, keepdims=False):
 @register('linalg_svd', n_out=lambda args, kw: 3 if (
           kw.get('compute_uv', args[2] if len(args) > 2 else True)) else 1)
 def linalg_svd(a, full_matrices=True, compute_uv=True):
-    return jnp.linalg.svd(a, full_matrices=full_matrices,
-                          compute_uv=compute_uv)
+    out = jnp.linalg.svd(a, full_matrices=full_matrices,
+                         compute_uv=compute_uv)
+    return tuple(out) if compute_uv else out
 
 
 @register('linalg_inv')
@@ -93,7 +94,9 @@ def linalg_det(a):
 
 @register('linalg_slogdet', n_out=2)
 def linalg_slogdet(a):
-    return jnp.linalg.slogdet(a)
+    # plain tuple, not SlogdetResult: the tape's VJP cotangents must match
+    # the fn's output tree structure
+    return tuple(jnp.linalg.slogdet(a))
 
 
 @register('linalg_cholesky', aliases=('linalg_potrf',))
@@ -108,12 +111,13 @@ def linalg_cholesky(a, lower=True):
               kw.get('mode', args[1] if len(args) > 1 else 'reduced')
               == 'r') else 2)
 def linalg_qr(a, mode='reduced'):
-    return jnp.linalg.qr(a, mode=mode)
+    out = jnp.linalg.qr(a, mode=mode)
+    return tuple(out) if mode != 'r' else out
 
 
 @register('linalg_eigh', aliases=('linalg_syevd',), n_out=2)
 def linalg_eigh(a, UPLO='L'):
-    return jnp.linalg.eigh(a, UPLO=UPLO)
+    return tuple(jnp.linalg.eigh(a, UPLO=UPLO))
 
 
 @register('linalg_eigvalsh', differentiable=False)
@@ -123,7 +127,7 @@ def linalg_eigvalsh(a, UPLO='L'):
 
 @register('linalg_eig', differentiable=False, n_out=2)
 def linalg_eig(a):
-    return jnp.linalg.eig(a)
+    return tuple(jnp.linalg.eig(a))
 
 
 @register('linalg_eigvals', differentiable=False)
@@ -138,7 +142,7 @@ def linalg_solve(a, b):
 
 @register('linalg_lstsq', differentiable=False, n_out=4)
 def linalg_lstsq(a, b, rcond=None):
-    return jnp.linalg.lstsq(a, b, rcond=rcond)
+    return tuple(jnp.linalg.lstsq(a, b, rcond=rcond))
 
 
 @register('linalg_matrix_rank', differentiable=False)

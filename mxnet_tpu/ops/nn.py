@@ -543,8 +543,11 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
         allow2 = ~(is_blank | same_as_prev2)
         m = jnp.maximum(alpha, shift1)
         m = jnp.where(allow2, jnp.maximum(m, shift2), m)
+        # mask INSIDE the exp argument: where disallowed, shift2 may exceed
+        # m and exp(shift2-m) would be inf — where(False, inf, 0) has a
+        # 0·inf = NaN gradient (the classic masked-softmax trap)
         acc = jnp.exp(alpha - m) + jnp.exp(shift1 - m) + \
-            jnp.where(allow2, jnp.exp(shift2 - m), 0.0)
+            jnp.exp(jnp.where(allow2, shift2 - m, NEG))
         new = m + jnp.log(jnp.maximum(acc, 1e-37))
         emit = jnp.take_along_axis(lp_t, ext, axis=1)
         return new + emit, new + emit

@@ -18,7 +18,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 import opperf  # noqa: E402
 
 opperf._register_rules(np, large=(16, 16), nn_scale=1)
-ALL_RULED = sorted(opperf._RULES)
+from mxnet_tpu.ops import registry as _registry  # noqa: E402
+ALL_RULED = sorted(n for n in opperf._RULES
+                   if n in _registry.list_ops())
 
 
 def _build(name):
