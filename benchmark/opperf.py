@@ -68,8 +68,6 @@ def _register_rules(np_, large=(1024, 1024), nn_scale=8):
          kwargs={'k': 8}, no_grad=True)
     rule('sort', 'argsort', args=lambda u=u, sc=sc: (u(16 * sc, 128 * sc),),
          no_grad=True)
-    rule('argmax', 'argmin',
-         args=lambda u=u, sc=sc: (u(16 * sc, 128 * sc),), no_grad=True)
     rule('fully_connected',
          args=lambda u=u, sc=sc: (u(8 * sc, 128 * sc), u(128 * sc, 128 * sc),
                                   u(128 * sc)),

@@ -558,9 +558,11 @@ def _mha(b, node, ins, out):
     static shapes from the pre-pass (mask-free case, as traced by BERT
     with no valid_length)."""
     kw = node.kwargs
-    if len(ins) > 3 or kw.get('mask') is not None:
+    if len(ins) > 3 or kw.get('mask') is not None or kw.get('causal') or \
+            kw.get('dropout_p', 0.0) > 0.0:
         raise NotImplementedError(
-            'multi_head_attention export supports the unmasked q/k/v form')
+            'multi_head_attention export supports the unmasked, '
+            'non-causal, no-dropout q/k/v form')
     heads = kw.get('num_heads')
     if heads is None and len(node.args_spec) > 3:
         heads = node.args_spec[3]

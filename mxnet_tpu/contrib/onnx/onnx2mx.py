@@ -177,6 +177,11 @@ def _import_node(imp, node):
         ends = [int(v) for v in imp.const(ins[2])]
         axes = ([int(v) for v in imp.const(ins[3])] if len(ins) > 3
                 else list(range(len(starts))))
+        if len(ins) > 4 and ins[4]:
+            steps = [int(v) for v in imp.const(ins[4])]
+            if any(s != 1 for s in steps):
+                raise NotImplementedError(
+                    f'Slice with steps {steps} unsupported (stride-1 only)')
         out_s = S(0)
         for s, e, ax in zip(starts, ends, axes):
             out_s = _invoke('slice_axis', [out_s, ax, s,
