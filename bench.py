@@ -121,6 +121,41 @@ def bench_bert(args, mx):
     }
 
 
+def bench_llama_decode(args, mx):
+    """Autoregressive decode throughput, TinyLlama-1.1B shapes, KV-cache
+    jitted decode step (informational — the reference has no LLM assets;
+    vs_baseline anchors to 1x = 10 tok/s, an fp32 CPU-class rate)."""
+    import numpy as onp
+
+    from mxnet_tpu.gluon.model_zoo.llama import LlamaConfig, LlamaForCausalLM
+
+    dtype = 'bfloat16' if args.dtype == 'bf16' else 'float32'
+    cfg = LlamaConfig(vocab_size=32000, units=2048, num_layers=22,
+                      num_heads=32, num_kv_heads=4, hidden_size=5632,
+                      max_length=2048)
+    net = LlamaForCausalLM(cfg)
+    net.initialize()
+    rng = onp.random.default_rng(0)
+    prompt = mx.np.array(rng.integers(1, 32000, (1, 32)).astype('float32'))
+    net(mx.np.ones((1, 2)))
+    if dtype != 'float32':
+        net.cast(dtype)
+    n_new = max(args.iters, 32)
+    out = net.generate(prompt, max_new_tokens=n_new)       # compile
+    out.wait_to_read()
+    t0 = time.perf_counter()
+    out = net.generate(prompt, max_new_tokens=n_new)
+    out.wait_to_read()
+    dt = time.perf_counter() - t0
+    tps = n_new / dt
+    return {
+        'metric': f'llama1b_decode_{args.dtype}_batch1',
+        'value': round(tps, 2),
+        'unit': 'tok/s',
+        'vs_baseline': round(tps / 10.0, 3),
+    }
+
+
 def bench_kvstore(args):
     """KVStore push/pull bandwidth (BASELINE.md north-star row: the
     reference ships only the harness, no number — vs_baseline anchors to
@@ -171,6 +206,8 @@ def main():
         result = bench_bert(args, mx)
     elif args.model == 'kvstore':
         result = bench_kvstore(args)
+    elif args.model in ('llama_decode', 'llama'):
+        result = bench_llama_decode(args, mx)
     else:
         result = bench_resnet(args, mx)
     print(json.dumps(result))
