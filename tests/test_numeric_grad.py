@@ -1,0 +1,127 @@
+"""Finite-difference gradient verification for custom-composed ops —
+implementations with hand-written math (not thin jnp wrappers), where a
+wrong-but-finite gradient is possible (reference test_operator.py's
+check_numeric_gradient sweep)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+npx = mx.npx
+
+
+def _u(*shape):
+    return np.random.uniform(0.5, 1.5, shape).astype('float32')
+
+
+def _spd(n):
+    a = np.random.uniform(0.1, 1.0, (n, n)).astype('float32')
+    return a @ a.T + n * np.eye(n, dtype='float32')
+
+
+def test_linalg_trmm_grad():
+    check_numeric_gradient(
+        lambda A, B: npx.linalg_trmm(A, B, alpha=1.5), [_u(4, 4), _u(4, 3)])
+
+
+def test_linalg_trsm_grad():
+    check_numeric_gradient(
+        lambda A, B: npx.linalg_trsm(A, B), [_spd(4), _u(4, 3)],
+        rtol=2e-2, atol=2e-3)
+
+
+def test_linalg_gemm_grad():
+    check_numeric_gradient(
+        lambda A, B, C: npx.linalg_gemm(A, B, C, alpha=0.7, beta=1.3),
+        [_u(3, 4), _u(4, 5), _u(3, 5)])
+
+
+def test_linalg_syrk_sumlogdiag_grad():
+    check_numeric_gradient(lambda A: npx.linalg_syrk(A, alpha=0.5),
+                           [_u(4, 4)])
+    check_numeric_gradient(lambda A: npx.linalg_sumlogdiag(A), [_spd(4)])
+
+
+def test_norm_layers_grads():
+    check_numeric_gradient(
+        lambda x, g, b: npx.layer_norm(x, g, b), [_u(3, 8), _u(8), _u(8)],
+        rtol=2e-2, atol=2e-3)
+    check_numeric_gradient(
+        lambda x, g: npx.rms_norm(x, g), [_u(3, 8), _u(8)],
+        rtol=2e-2, atol=2e-3)
+    check_numeric_gradient(
+        lambda x, g, b: npx.group_norm(x, g, b, num_groups=2),
+        [_u(2, 4, 3, 3), _u(4), _u(4)], rtol=3e-2, atol=3e-3)
+
+
+def test_lrn_and_l2norm_grads():
+    check_numeric_gradient(lambda x: npx.lrn(x), [_u(1, 4, 3, 3)],
+                           rtol=2e-2, atol=2e-3)
+    check_numeric_gradient(lambda x: npx.l2_normalization(x), [_u(2, 6)],
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_im2col_col2im_grads():
+    check_numeric_gradient(
+        lambda x: npx.im2col(x, kernel=(2, 2), stride=(1, 1)),
+        [_u(1, 2, 4, 4)])
+    check_numeric_gradient(
+        lambda c: npx.col2im(c, output_size=(4, 4), kernel=(2, 2),
+                             stride=(2, 2)),
+        [_u(1, 8, 4)])
+
+
+def test_interleaved_attention_grads():
+    qkv = _u(4, 2, 2 * 3 * 4)               # (seq, batch, h*3*dh)
+    check_numeric_gradient(
+        lambda x: npx.interleaved_matmul_selfatt_qk(x, heads=2), [qkv],
+        rtol=2e-2, atol=2e-3)
+    att = np.random.dirichlet(np.ones(4), size=(4, 4)).astype('float32')
+    check_numeric_gradient(
+        lambda x, a: npx.interleaved_matmul_selfatt_valatt(x, a, heads=2),
+        [qkv, att], rtol=2e-2, atol=2e-3)
+
+
+def test_multi_head_attention_grad():
+    check_numeric_gradient(
+        lambda q, k, v: npx.multi_head_attention(q, k, v, num_heads=2),
+        [_u(1, 4, 8), _u(1, 4, 8), _u(1, 4, 8)], rtol=3e-2, atol=3e-3)
+
+
+def test_ctc_loss_grad():
+    data = np.random.uniform(-1, 1, (5, 1, 4)).astype('float32')
+    label = np.array([[1, 2, 0]], 'f')
+    check_numeric_gradient(
+        lambda d: npx.ctc_loss(d, mx.np.array(label)), [data],
+        eps=1e-2, rtol=5e-2, atol=5e-3)
+
+
+def test_fused_rnn_grad():
+    T, B, I, H = 3, 1, 2, 2
+    nparams = 4 * H * I + 4 * H * H + 2 * 4 * H
+    params = (np.random.uniform(-0.2, 0.2, nparams)).astype('float32')
+    x = _u(T, B, I)
+    h0 = np.zeros((1, B, H), 'f')
+    c0 = np.zeros((1, B, H), 'f')
+    check_numeric_gradient(
+        lambda d, p: npx.rnn(d, p, mx.np.array(h0), mx.np.array(c0),
+                             mode='lstm', state_size=H, num_layers=1),
+        [x, params], eps=1e-2, rtol=5e-2, atol=5e-3)
+
+
+def test_softmax_temperature_and_masked_grads():
+    check_numeric_gradient(
+        lambda x: npx.softmax(x, temperature=2.0), [_u(3, 6)],
+        rtol=2e-2, atol=2e-3)
+    mask = (np.random.uniform(size=(3, 6)) > 0.3)
+    check_numeric_gradient(
+        lambda x: npx.masked_softmax(x, mx.np.array(mask)), [_u(3, 6)],
+        rtol=3e-2, atol=3e-3)
+
+
+def test_optimizer_kernel_grads_not_needed_but_batch_dot_is():
+    check_numeric_gradient(
+        lambda a, b: npx.batch_dot(a, b, transpose_b=True),
+        [_u(2, 3, 4), _u(2, 5, 4)])
