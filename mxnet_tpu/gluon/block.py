@@ -23,6 +23,7 @@ cached_op.cc:776). The compiled step:
 * static_alloc maps to XLA buffer donation; bulking/fusion are XLA's job.
 """
 
+import os
 import re
 import threading
 
@@ -268,11 +269,13 @@ class _CachedGraph:
     src/imperative/cached_op.h:463)."""
 
     def __init__(self, block, static_alloc=False, static_shape=False,
-                 backend=None, flags=None):
+                 backend=None, flags=None, remat=False):
         self.block = block
         self.static_alloc = static_alloc
         self.static_shape = static_shape
         self.backend = backend
+        self.remat = remat or os.environ.get(
+            'MXNET_BACKWARD_DO_MIRROR', '') == '1'
         self._compiled = {}
         self._param_order = None
         self._monitor_callbacks = []
@@ -333,6 +336,10 @@ class _CachedGraph:
         if self.static_alloc:
             # donate input buffers (≙ static_alloc persistent buffers)
             jit_kwargs['donate_argnums'] = ()
+        if self.remat:
+            # recompute activations in backward instead of storing them
+            # (reference backward mirroring, MXNET_BACKWARD_DO_MIRROR)
+            pure_fn = jax.checkpoint(pure_fn)
         return jax.jit(pure_fn, **jit_kwargs)
 
     def __call__(self, args):
@@ -390,13 +397,20 @@ class HybridBlock(Block):
 
     def hybridize(self, active=True, backend=None, backend_opts=None,
                   static_alloc=True, static_shape=False, inline_limit=2,
-                  forward_bulk_size=None, backward_bulk_size=None, **kwargs):
+                  forward_bulk_size=None, backward_bulk_size=None,
+                  remat=False, **kwargs):
         """Reference block.py:1217. backend= selected subgraph backends in
-        the reference (optimize_for); the whole graph goes to XLA here."""
+        the reference (optimize_for); the whole graph goes to XLA here.
+
+        ``remat=True`` wraps the compiled forward in ``jax.checkpoint``:
+        backward recomputes activations instead of keeping them — the
+        reference's backward-mirroring memory trade
+        (MXNET_BACKWARD_DO_MIRROR, src/nnvm/gradient.cc:58-77), but as a
+        per-block switch."""
         self._active = active
         self._cached_graph = _CachedGraph(
             self, static_alloc=static_alloc, static_shape=static_shape,
-            backend=backend) if active else None
+            backend=backend, remat=remat) if active else None
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
 

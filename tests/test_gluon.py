@@ -282,3 +282,28 @@ def test_summary(capsys):
     net.initialize()
     net.summary(mx.np.ones((1, 3)))
     assert 'Total params' in capsys.readouterr().out
+
+
+def test_hybridize_remat_matches_plain():
+    """remat=True (gradient checkpointing, the reference's backward-mirror
+    memory trade) must change memory, not math."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation='relu'), nn.Dense(8, activation='relu'),
+            nn.Dense(4))
+    net.initialize()
+    x = mx.np.array(np.random.uniform(-1, 1, (3, 5)).astype('f'))
+    x.attach_grad()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_plain = x.grad.asnumpy().copy()
+    out_plain = net(x).asnumpy()
+
+    net.hybridize(remat=True)
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    np.testing.assert_allclose(net(x).asnumpy(), out_plain,
+                                rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), g_plain,
+                                rtol=1e-4, atol=1e-5)
