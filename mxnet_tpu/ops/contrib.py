@@ -582,3 +582,50 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     if output_score:
         return rois, kept[..., 1:2]
     return rois
+
+
+# ------------------------------------------------ sliding-window attention
+
+def _sldwin_mask(seq, w, w_left, w_right):
+    i = jnp.arange(seq)[:, None]
+    j = jnp.arange(seq)[None, :]
+    return (j >= i - w_left) & (j <= i + w_right)
+
+
+@register('sldwin_atten_mask_like', differentiable=False)
+def sldwin_atten_mask_like(score, dilation, valid_length, w,
+                           symmetric=True):
+    """Reference: src/operator/contrib/transformer.cc
+    _contrib_sldwin_atten_mask_like (GluonNLP sliding-window attention).
+    Returns the 0/1 mask shaped like ``score`` (B, H, S, S) for a window
+    of w tokens each side (w left only when not symmetric), intersected
+    with the valid-length mask."""
+    B, H, S, _ = score.shape
+    wl, wr = w, (w if symmetric else 0)
+    band = _sldwin_mask(S, w, wl, wr)[None, None]
+    valid = jnp.arange(S)[None, :] < valid_length[:, None]   # (B, S)
+    vmask = valid[:, None, :, None] & valid[:, None, None, :]
+    return jnp.broadcast_to(band & vmask,
+                            score.shape).astype(score.dtype)
+
+
+@register('sldwin_atten_score')
+def sldwin_atten_score(query, key, dilation, w, symmetric=True):
+    """Banded QK^T: only positions within the window contribute
+    (reference _contrib_sldwin_atten_score). query/key: (B, S, H, D);
+    returns (B, H, S, S) scores with out-of-band entries at -1e30 so a
+    following softmax zeroes them. Dense-banded on TPU: XLA fuses the
+    mask into the matmul epilogue; the band never materializes in HBM
+    under jit."""
+    s = jnp.einsum('bqhd,bkhd->bhqk', query, key)
+    S = query.shape[1]
+    band = _sldwin_mask(S, w, w, w if symmetric else 0)[None, None]
+    return jnp.where(band, s, -1e30)
+
+
+@register('sldwin_atten_context')
+def sldwin_atten_context(score, value, dilation, w, symmetric=True):
+    """Probability-weighted value gather for the banded scores
+    (reference _contrib_sldwin_atten_context). score: (B, H, S, S) —
+    typically softmax(sldwin_atten_score * scale); value: (B, S, H, D)."""
+    return jnp.einsum('bhqk,bkhd->bqhd', score, value)

@@ -268,3 +268,37 @@ def test_bert_hf_weight_import_matches_transformers():
                      out.seq_relationship_logits.numpy()).max()
     assert err_mlm < 5e-3, f'MLM logit mismatch {err_mlm}'
     assert err_nsp < 5e-3, f'NSP logit mismatch {err_nsp}'
+
+
+def test_sliding_window_attention_matches_dense_band():
+    """sldwin ops equal full attention under an explicit band mask."""
+    B, S, H, D, w = 2, 8, 2, 4, 2
+    rng = onp.random.default_rng(0)
+    q = mx.np.array(rng.standard_normal((B, S, H, D), dtype='f'))
+    k = mx.np.array(rng.standard_normal((B, S, H, D), dtype='f'))
+    v = mx.np.array(rng.standard_normal((B, S, H, D), dtype='f'))
+
+    score = mx.npx.sldwin_atten_score(q, k, 1, w)
+    probs = mx.npx.softmax(score * (D ** -0.5), axis=-1)
+    out = mx.npx.sldwin_atten_context(probs, v, 1, w)
+    assert out.shape == (B, S, H, D)
+
+    # dense reference with the same band
+    qn, kn, vn = (t.asnumpy() for t in (q, k, v))
+    s = onp.einsum('bqhd,bkhd->bhqk', qn, kn) * (D ** -0.5)
+    i = onp.arange(S)[:, None]
+    j = onp.arange(S)[None, :]
+    band = (onp.abs(i - j) <= w)[None, None]
+    s = onp.where(band, s, -1e30)
+    e = onp.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    want = onp.einsum('bhqk,bkhd->bqhd', p, vn)
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+    # mask_like: band ∩ valid_length
+    m = mx.npx.sldwin_atten_mask_like(mx.np.array(s.astype('f')), 1,
+                                      mx.np.array(onp.array([8, 5], 'f')),
+                                      w)
+    mn = m.asnumpy()
+    assert mn[0].astype(bool).sum() == band[0, 0].sum() * 2  # both heads
+    assert not mn[1, 0, 6:, :].any()          # beyond valid_length 5
