@@ -60,6 +60,7 @@ from . import test_utils
 from . import util
 from . import callback
 from . import model
+from . import tvmop
 from . import visualization
 
 from .util import is_np_array, is_np_shape, set_np, reset_np

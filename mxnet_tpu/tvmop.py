@@ -1,0 +1,20 @@
+"""``mx.tvmop`` — TVM-generated-kernel surface (reference
+python/mxnet/tvmop.py + root contrib/tvmop/, opt-in USE_TVM_OP).
+
+TPU design: the role TVM played for MXNet (compiling custom kernels
+outside the fixed op library) belongs to Pallas here — user kernels via
+``mx.rtc`` compile straight to Mosaic/TPU. This module keeps the surface
+for discoverability and routes to the Pallas path.
+"""
+
+
+def is_enabled():
+    """Reference checked the USE_TVM_OP build flag; TVM kernels are never
+    used in the TPU build (Pallas replaces them)."""
+    return False
+
+
+def get_kernel(name):
+    raise NotImplementedError(
+        'TVM-generated kernels are not part of the TPU build; write the '
+        'kernel with mx.rtc (Pallas) instead — see docs/deployment.md')
