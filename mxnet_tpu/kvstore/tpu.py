@@ -41,12 +41,16 @@ class KVStoreTPUSync(KVStoreLocal):
             self._mesh = jax.sharding.Mesh(devs, ('dp',))
 
     def _allreduce(self, local_sum):
-        """Global sum across processes: per-process partial sums are placed
-        on a global mesh and reduced by one XLA collective."""
+        """Global sum across processes. The gather crosses DCN once per
+        tensor; the reduction itself runs on device. (The ICI-optimal
+        single-collective path is the SPMD trainer —
+        parallel.make_sharded_train_step — where XLA owns the allreduce;
+        this KVStore surface keeps the reference's per-key semantics.)"""
         if self._nproc == 1:
             return local_sum
         from jax.experimental import multihost_utils
-        return multihost_utils.process_allgather(local_sum).sum(axis=0)
+        gathered = multihost_utils.process_allgather(local_sum)
+        return jnp.asarray(gathered).sum(axis=0)
 
     def pushpull(self, key, value, out=None, priority=0):
         for k, vals in _group(key, value):
@@ -65,11 +69,24 @@ class KVStoreTPUSync(KVStoreLocal):
             for t in targets:
                 t._rebind(result)
 
+    def init(self, key, value):
+        """Rank-0's value is authoritative (reference KVStoreDist::Init):
+        hosts that seeded independently converge here."""
+        super().init(key, value)
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+            for k, _ in _group(key, value):
+                self._store[k]._rebind(multihost_utils.broadcast_one_to_all(
+                    self._store[k]._data))
+
     def push(self, key, value, priority=0):
         for k, vals in _group(key, value):
             merged = self._allreduce(_reduce(vals))
             if self._updater is not None and k in self._store:
                 self._updater(k, NDArray(merged), self._store[k])
+            elif k in self._store:
+                # accumulate, matching KVStoreLocal.push semantics
+                self._store[k]._rebind(self._store[k]._data + merged)
             else:
                 self._store[k] = NDArray(merged)
 

@@ -78,7 +78,9 @@ class _HistogramCollector:
             covered = c[min(center + w, self.num_bins - 1)] - \
                 (c[center - w - 1] if center - w - 1 >= 0 else 0)
             if covered >= target:
-                t = float(self.edges[min(center + w, self.num_bins - 1)])
+                # covered mass extends through the UPPER edge of bin
+                # center+w, i.e. edges[center+w+1]
+                t = float(self.edges[min(center + w + 1, self.num_bins)])
                 return -t, t
         return self.min, self.max
 

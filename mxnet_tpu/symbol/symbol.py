@@ -200,8 +200,11 @@ class Symbol:
                 ent = kwargs[n.name]._outputs[0]
                 mapping[id(n)] = ent
         memo = {}
-        return self._derive([_remap(n, i, mapping, memo)
-                             for n, i in self._outputs])
+        out = self._derive([_remap(n, i, mapping, memo)
+                            for n, i in self._outputs])
+        for sub in kwargs.values():        # carry captured-constant bindings
+            out._aux.update(sub._aux)
+        return out
 
     __call__ = compose
 
@@ -279,12 +282,20 @@ class Symbol:
         return Executor(self, ctx, args, None, grad_req)
 
     # ------------------------------------------------------------- inference
+    def _positional_given(self, args, kwargs):
+        if not args:
+            return kwargs
+        if kwargs:
+            raise ValueError('pass shapes positionally or by name, not both')
+        return dict(zip(self.list_arguments(), args))
+
     def infer_shape(self, *args, **kwargs):
-        res = self._infer(kwargs, want='shape')
-        return res
+        return self._infer(self._positional_given(args, kwargs),
+                           want='shape')
 
     def infer_type(self, *args, **kwargs):
-        return self._infer(kwargs, want='dtype')
+        return self._infer(self._positional_given(args, kwargs),
+                           want='dtype')
 
     def infer_shape_partial(self, *args, **kwargs):
         try:
@@ -581,10 +592,9 @@ def _symbol_invoke(op, args, kwargs):
     args_spec = [spec_of(a) for a in args]
     kw = {}
     for k, v in kwargs.items():
-        if isinstance(v, Symbol):
-            ent = v._outputs[0]
-            inputs.append(ent)
-            kw[k] = {'__arr__': len(inputs) - 1}
+        if isinstance(v, Symbol) or (isinstance(v, (list, tuple)) and any(
+                isinstance(e, Symbol) for e in v)):
+            kw[k] = spec_of(v)
         else:
             kw[k] = dc._encode_static(v)
     node = _SymNode(op.name, name, args_spec, kw, inputs)

@@ -108,3 +108,15 @@ def test_barrier_and_dead_nodes():
     kv.barrier()
     assert kv.get_num_dead_node() == 0
     assert kv.type == 'dist_tpu_sync'
+
+
+def test_dist_tpu_sync_push_accumulates_like_local():
+    """push without an updater accumulates into the stored value —
+    KVStoreLocal semantics must survive the switch to the dist store."""
+    kv = mx.kvstore.create('dist_tpu_sync')
+    w = mx.np.array(np.array([1.0, 2.0], 'f'))
+    kv.init(3, w)
+    kv.push(3, mx.np.array(np.array([0.5, 0.5], 'f')))
+    out = mx.np.zeros((2,))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [1.5, 2.5], rtol=1e-6)

@@ -169,3 +169,17 @@ def test_exclude_layers():
     kids = list(net._children.values())
     assert not isinstance(kids[0], quantization.QuantizedDense)
     assert isinstance(kids[1], quantization.QuantizedDense)
+
+
+def test_percentile_threshold_covers_requested_mass():
+    from mxnet_tpu.quantization import _HistogramCollector
+    import numpy as onp2
+    rng = onp2.random.default_rng(0)
+    # heavy boundary bin: uniform plus a spike near the edge
+    x = onp2.concatenate([rng.uniform(-1, 1, 10000),
+                          onp2.full(500, 0.995)]).astype('float32')
+    c = _HistogramCollector(num_bins=201)
+    c.collect(x)
+    lo, t = c.percentile(99.0)
+    inside = ((x >= -t) & (x <= t)).mean()
+    assert inside >= 0.99, f'threshold {t} covers only {inside:.4f}'

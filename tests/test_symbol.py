@@ -310,3 +310,35 @@ def test_check_symbolic_forward_backward_harness():
     check_symbolic_backward(z, {'x': xv, 'y': yv},
                             onp.array(1.0, 'f'),
                             {'x': yv + 1, 'y': xv})
+
+
+def test_compose_carries_aux_bindings():
+    x = mx.sym.var('x')
+    inner = x * 2.0
+    inner._aux['const_c'] = mx.np.array(onp.array([5.0], 'f'))
+    head_in = mx.sym.var('h')
+    head = head_in + mx.sym.var('const_c')
+    composed = head(h=inner)
+    out = composed.eval(x=mx.np.array(onp.array([1.0], 'f')))
+    assert float(out[0].asnumpy()[0]) == 7.0
+
+
+def test_infer_shape_positional():
+    x = mx.sym.var('x')
+    y = mx.sym.var('y')
+    z = x + y
+    a_shapes, o_shapes, _ = z.infer_shape((2, 3), (2, 3))
+    assert list(a_shapes) == [(2, 3), (2, 3)]
+    assert list(o_shapes) == [(2, 3)]
+
+
+def test_symbol_kwarg_list_of_symbols():
+    a = mx.sym.var('a')
+    b = mx.sym.var('b')
+    s = mx.sym.concat(a, b, axis=0)     # positional form
+    out = s.eval(a=mx.np.ones((1, 2)), b=mx.np.zeros((1, 2)))
+    assert out[0].shape == (2, 2)
+    # serialization of the composed graph keeps working
+    s2 = mx.sym.fromjson(s.tojson())
+    out2 = s2.eval(a=mx.np.ones((1, 2)), b=mx.np.zeros((1, 2)))
+    assert out2[0].shape == (2, 2)
