@@ -36,21 +36,31 @@ def _as_host(data):
     return data
 
 
-_worker_dataset = None
+# per-loader worker state, keyed so several thread-pool loaders in one
+# process don't clobber each other (fork pools inherit a one-entry dict)
+_worker_state = {}
 
 
-def _worker_init(dataset):
-    global _worker_dataset
-    _worker_dataset = dataset
-
-
-def _worker_fn(samples):
-    """Fetch + batchify host-side in the worker."""
-    batch = [_worker_dataset[i] for i in samples]
+def _default_worker_batchify(batch):
     if isinstance(batch[0], tuple):
         cols = list(zip(*batch))
         return tuple(_np.asarray([_as_host(c) for c in col]) for col in cols)
     return _np.asarray([_as_host(b) for b in batch])
+
+
+def _worker_init(key, dataset, batchify_fn):
+    _worker_state[key] = (dataset, batchify_fn)
+
+
+def _worker_fn(key, samples):
+    """Fetch + batchify host-side in the worker. A custom batchify_fn
+    runs here too (it must be picklable for process pools and should
+    return host arrays)."""
+    dataset, batchify_fn = _worker_state[key]
+    batch = [dataset[i] for i in samples]
+    if batchify_fn is None:
+        return _default_worker_batchify(batch)
+    return batchify_fn(batch)
 
 
 class DataLoader:
@@ -86,17 +96,22 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._prefetch = max(0, prefetch or 2 * self._num_workers)
         self._pool = None
+        self._worker_key = id(self)
         if self._num_workers > 0:
+            # workers run the user's batchify_fn (or the host-array default);
+            # pass None for the default so unpicklable bound defaults never
+            # cross the fork pipe
+            worker_batchify = batchify_fn
             if thread_pool:
                 from multiprocessing.pool import ThreadPool
-                self._pool = ThreadPool(self._num_workers,
-                                        initializer=_worker_init,
-                                        initargs=(dataset,))
+                self._pool = ThreadPool(
+                    self._num_workers, initializer=_worker_init,
+                    initargs=(self._worker_key, dataset, worker_batchify))
             else:
                 ctx = multiprocessing.get_context('fork')
-                self._pool = ctx.Pool(self._num_workers,
-                                      initializer=_worker_init,
-                                      initargs=(dataset,))
+                self._pool = ctx.Pool(
+                    self._num_workers, initializer=_worker_init,
+                    initargs=(self._worker_key, dataset, worker_batchify))
 
     def __iter__(self):
         if self._pool is None:
@@ -108,22 +123,24 @@ class DataLoader:
         it = iter(self._batch_sampler)
         try:
             for _ in range(self._prefetch):
-                results.append(self._pool.apply_async(_worker_fn,
-                                                      (next(it),)))
+                results.append(self._pool.apply_async(
+                    _worker_fn, (self._worker_key, next(it))))
         except StopIteration:
             pass
         while results:
             res = results.pop(0)
             try:
-                results.append(self._pool.apply_async(_worker_fn,
-                                                      (next(it),)))
+                results.append(self._pool.apply_async(
+                    _worker_fn, (self._worker_key, next(it))))
             except StopIteration:
                 pass
             raw = res.get(self._timeout)
             if isinstance(raw, tuple):
                 yield [array(r) for r in raw]
-            else:
+            elif isinstance(raw, _np.ndarray):
                 yield array(raw)
+            else:
+                yield raw          # custom batchify output passes through
 
     def __len__(self):
         return len(self._batch_sampler)

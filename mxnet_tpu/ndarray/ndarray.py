@@ -172,10 +172,22 @@ class NDArray:
                     return mxfn(*args, **kwargs)
                 except TypeError:
                     pass
+        out_nd = None
+        out_spec = kwargs.get('out')
+        if out_spec is not None:
+            outs = out_spec if isinstance(out_spec, tuple) else (out_spec,)
+            if len(outs) == 1 and isinstance(outs[0], NDArray):
+                out_nd = outs[0]
+                kwargs = {k: v for k, v in kwargs.items() if k != 'out'}
         conv = lambda x: x.asnumpy() if isinstance(x, NDArray) else x  # noqa: E731
         args = [conv(a) for a in args]
         kwargs = {k: conv(v) for k, v in kwargs.items()}
-        return getattr(ufunc, method)(*args, **kwargs)
+        res = getattr(ufunc, method)(*args, **kwargs)
+        if out_nd is not None:
+            # mutate the caller's NDArray like numpy's out= contract
+            out_nd._rebind(jnp.asarray(res, dtype=out_nd.dtype))
+            return out_nd
+        return res
 
     def __dlpack__(self, **kwargs):
         return self._data.__dlpack__(**kwargs)
@@ -195,13 +207,20 @@ class NDArray:
 
     def copyto(self, other):
         """Copy to a Context (new array) or into another NDArray
-        (reference ndarray.py copyto)."""
+        (reference ndarray.py copyto: casts to the destination's dtype,
+        shapes must match)."""
         if isinstance(other, Context):
             dev = other.to_jax()
             raw = self._data if _is_tracer(self._data) else jax.device_put(self._data, dev)
             return NDArray(raw, ctx=other)
         if isinstance(other, NDArray):
-            other._rebind(jax.device_put(self._data, other.context.to_jax()))
+            if other.shape != self.shape:
+                raise ValueError(
+                    f'copyto shape mismatch: {self.shape} vs destination '
+                    f'{other.shape}')
+            raw = self._data.astype(other.dtype) \
+                if other.dtype != self.dtype else self._data
+            other._rebind(jax.device_put(raw, other.context.to_jax()))
             return other
         raise TypeError(f'copyto does not support type {type(other)}')
 
@@ -372,6 +391,10 @@ class NDArray:
 
     def _inplace(self, other, opname):
         res = self._binop(other, opname)
+        if res is NotImplemented:
+            raise TypeError(
+                f'unsupported operand type for in-place {opname}: '
+                f'{type(other).__name__}')
         self._rebind(res._data)
         return self
 

@@ -65,6 +65,12 @@ class Optimizer:
 
     # ------------------------------------------------------------------- meta
     def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning(
+                'LRScheduler of the optimizer has already been defined. '
+                'Note that set_learning_rate can mutate the value of the '
+                'learning rate of the optimizer only when the LRScheduler '
+                'of the optimizer is undefined.')   # reference optimizer.py
         self.lr = lr
 
     @property
@@ -274,7 +280,7 @@ class Nadam(Optimizer):
         self.beta1, self.beta2 = beta1, beta2
         self.epsilon = epsilon
         self.schedule_decay = schedule_decay
-        self.m_schedule = 1.0
+        self._m_schedule = {}          # per-parameter product of momentum_t
 
     def create_state(self, index, weight):
         return (_zeros_like_nd(weight), _zeros_like_nd(weight))
@@ -284,12 +290,17 @@ class Nadam(Optimizer):
         momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
         momentum_t1 = self.beta1 * (1 - 0.5 *
                                     0.96 ** ((t + 1) * self.schedule_decay))
-        self.m_schedule *= momentum_t
-        m_schedule_next = self.m_schedule * momentum_t1
+        # per-parameter schedule product keyed by the state tuple identity:
+        # one multiply per parameter step, not one per optimizer call
+        key = id(state[0])
+        m_schedule = self._m_schedule.get(key, 1.0) * momentum_t
+        self._m_schedule[key] = m_schedule
+        self.m_schedule = m_schedule   # kept for API compatibility
+        m_schedule_next = m_schedule * momentum_t1
         m, v = state[0]._data, state[1]._data
         m = self.beta1 * m + (1 - self.beta1) * g
         v = self.beta2 * v + (1 - self.beta2) * g * g
-        g_prime = g / (1 - self.m_schedule)
+        g_prime = g / (1 - m_schedule)
         m_prime = m / (1 - m_schedule_next)
         v_prime = v / (1 - self.beta2 ** t)
         m_bar = (1 - momentum_t) * g_prime + momentum_t1 * m_prime
@@ -549,12 +560,21 @@ class LAMB(Optimizer):
 
 @register
 class LANS(LAMB):
-    """LAMB + Nesterov (reference optimizer/lans.py)."""
+    """LAMB with per-step gradient normalization (reference
+    optimizer/lans.py). The normalized gradient feeds LAMB's moment
+    machinery; rescale/clip must apply exactly once, so the normalization
+    happens here and LAMB's own _prep then operates on an already-scaled
+    unit-norm gradient with rescale_grad temporarily neutralized."""
 
     def step(self, w, g, state, lr, wd, t):
         g = self._prep(g)
         g = g / jnp.maximum(jnp.linalg.norm(g), 1e-12)
-        return super().step(w, g * jnp.linalg.norm(g), state, lr, wd, t)
+        saved_rescale, saved_clip = self.rescale_grad, self.clip_gradient
+        self.rescale_grad, self.clip_gradient = 1.0, None
+        try:
+            return super().step(w, g, state, lr, wd, t)
+        finally:
+            self.rescale_grad, self.clip_gradient = saved_rescale, saved_clip
 
 
 class Updater:

@@ -211,3 +211,25 @@ def test_image_det_iter(tmp_path):
     valid = lab2[..., 0] >= 0
     assert (lab2[..., 1:][valid[..., None].repeat(4, -1).reshape(
         valid.shape + (4,))] >= 0).all()
+
+
+def test_dataloader_custom_batchify_in_workers():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    xs = [np.full((i + 1,), float(i), 'f') for i in range(8)]  # ragged
+    ds = ArrayDataset(list(range(8)))
+
+    loader = DataLoader(ds, batch_size=4, num_workers=2, thread_pool=True,
+                        batchify_fn=lambda batch: sum(batch))
+    got = sorted(x for x in loader)
+    assert got == [sum(range(4)), sum(range(4, 8))]
+
+
+def test_two_threadpool_loaders_do_not_clobber():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    a = ArrayDataset(np.arange(8, dtype='f'))
+    b = ArrayDataset(np.arange(100, 108, dtype='f'))
+    la = DataLoader(a, batch_size=4, num_workers=1, thread_pool=True)
+    lb = DataLoader(b, batch_size=4, num_workers=1, thread_pool=True)
+    va = np.concatenate([x.asnumpy() for x in la])
+    vb = np.concatenate([x.asnumpy() for x in lb])
+    assert va.max() < 100 and vb.min() >= 100

@@ -174,3 +174,28 @@ def test_dlpack_numpy_interop():
     assert n.shape == (1, 2)
     import jax.numpy as jnp
     assert jnp.asarray(a._data).shape == (1, 2)
+
+
+def test_copyto_casts_and_checks_shape():
+    src = mx.np.array(np.array([1.5, 2.5], 'f'))
+    dst = mx.np.zeros((2,), dtype='float16')
+    src.copyto(dst)
+    assert dst.dtype == np.float16
+    np.testing.assert_allclose(dst.asnumpy(), [1.5, 2.5])
+    bad = mx.np.zeros((3,))
+    with pytest.raises(ValueError):
+        src.copyto(bad)
+
+
+def test_ufunc_out_mutates_ndarray():
+    a = mx.np.array(np.array([1.0, 2.0], 'f'))
+    out = mx.np.zeros((2,))
+    r = np.add(a, 1.0, out=out)
+    assert r is out
+    np.testing.assert_allclose(out.asnumpy(), [2.0, 3.0])
+
+
+def test_inplace_unsupported_operand_raises_typeerror():
+    a = mx.np.ones((2,))
+    with pytest.raises(TypeError):
+        a += object()

@@ -112,3 +112,36 @@ def test_multi_param_update():
     o.update([0, 1], ws, gs, states)
     assert_almost_equal(ws[0], [0.9], rtol=1e-6)
     assert_almost_equal(ws[1], [1.9], rtol=1e-6)
+
+
+def test_lans_applies_rescale_once():
+    import mxnet_tpu.optimizer as opt
+    o = opt.create('lans', learning_rate=0.1, rescale_grad=1.0 / 512)
+    w = mx.np.array(np.array([1.0, 2.0], 'f'))
+    g = mx.np.array(np.array([512.0, 1024.0], 'f'))   # pre-rescale grads
+    state = o.create_state_multi_precision(0, w)
+    o.update_multi_precision(0, w, g, state)
+    # after rescale ONCE the gradient is [1, 2]; normalized direction is
+    # well-defined and the step must be O(lr), not O(lr/512)
+    step = 1.0 - float(w.asnumpy()[0])
+    assert abs(step) > 1e-3, f'update vanished (double rescale): {step}'
+
+
+def test_nadam_per_parameter_schedule():
+    import mxnet_tpu.optimizer as opt
+    o = opt.create('nadam', learning_rate=0.01)
+    ws = [mx.np.array(np.ones(2)) for _ in range(3)]
+    states = [o.create_state_multi_precision(i, w) for i, w in enumerate(ws)]
+    for i, w in enumerate(ws):
+        o.update_multi_precision(i, w, mx.np.array(np.ones(2)), states[i])
+    # all parameters saw t=1: identical first-step updates
+    vals = [float(w.asnumpy()[0]) for w in ws]
+    assert max(vals) - min(vals) < 1e-7, vals
+
+
+def test_set_learning_rate_with_scheduler_raises():
+    import mxnet_tpu.optimizer as opt
+    import mxnet_tpu.lr_scheduler as lrs
+    o = opt.create('sgd', lr_scheduler=lrs.FactorScheduler(step=10))
+    with pytest.raises(UserWarning):
+        o.set_learning_rate(1e-4)
