@@ -81,6 +81,9 @@ class NDArrayIter(DataIter):
         self.num_data = self.data[0][1].shape[0] if self.data else 0
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
+        if last_batch_handle == 'discard':
+            # reference NDArrayIter truncates the epoch to whole batches
+            self.num_data -= self.num_data % batch_size
         self.cursor = -batch_size
         self.idx = _np.arange(self.num_data)
         if shuffle:
@@ -116,24 +119,40 @@ class NDArrayIter(DataIter):
                 for name, arr in self.label]
 
     def reset(self):
-        self.cursor = -self.batch_size
-        if self.shuffle:
-            _np.random.shuffle(self.idx)
+        if self.last_batch_handle == 'roll_over' and \
+                0 < self.num_data - self.cursor < self.batch_size:
+            # remainder rolls into the next epoch's first batch (reference
+            # io.py reset; the carried tail keeps its old positions, so
+            # reshuffling is skipped for the carry epoch)
+            # leftover L unseen samples: after iter_next's += batch_size the
+            # window starts at -L, wrapping the carried tail
+            self.cursor = -self.batch_size - (self.num_data - self.cursor)
+        else:
+            self.cursor = -self.batch_size
+            if self.shuffle:
+                _np.random.shuffle(self.idx)
 
     def iter_next(self):
         self.cursor += self.batch_size
-        if self.last_batch_handle == 'roll_over':
-            return self.cursor + self.batch_size <= self.num_data
+        if self.last_batch_handle in ('roll_over', 'discard'):
+            return self.cursor + self.batch_size <= self.num_data or \
+                (self.cursor < 0)
         return self.cursor < self.num_data
 
     def _take(self, arrays):
         end = self.cursor + self.batch_size
         out = []
         for _, arr in arrays:
-            chunk = arr[self.idx[self.cursor:min(end, self.num_data)]]
-            if end > self.num_data and self.last_batch_handle == 'pad':
-                pad = end - self.num_data
-                chunk = _np.concatenate([chunk, arr[self.idx[:pad]]], axis=0)
+            if self.cursor < 0:          # roll_over carry: wrap the tail
+                chunk = _np.concatenate(
+                    [arr[self.idx[self.cursor:]], arr[self.idx[:end]]],
+                    axis=0)
+            else:
+                chunk = arr[self.idx[self.cursor:min(end, self.num_data)]]
+                if end > self.num_data and self.last_batch_handle == 'pad':
+                    pad = end - self.num_data
+                    chunk = _np.concatenate(
+                        [chunk, arr[self.idx[:pad]]], axis=0)
             out.append(array(chunk))
         return out
 
@@ -196,31 +215,65 @@ class PrefetchingIter(DataIter):
     batch ahead — host decode overlaps device compute."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
-        import queue
-        import threading
         self.iters = iters if isinstance(iters, list) else [iters]
         super().__init__(self.iters[0].batch_size)
-        self._queue = queue.Queue(maxsize=2)
-        self._stop = threading.Event()
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue = None
+        self._stop = None
         self._thread = None
+        self._done = False
         self._start()
 
+    @staticmethod
+    def _merge(batches):
+        """Concatenate the sub-iterators' data/label lists into one batch
+        (reference PrefetchingIter semantics for a list of iters)."""
+        if len(batches) == 1:
+            return batches[0]
+        data, label = [], []
+        for b in batches:
+            data.extend(b.data or [])
+            label.extend(b.label or [])
+        first = batches[0]
+        return DataBatch(data=data, label=label, pad=first.pad,
+                         index=first.index)
+
     def _start(self):
+        import queue
         import threading
+
+        q = queue.Queue(maxsize=2)
+        stop = threading.Event()
 
         def worker():
             try:
-                for batch in self.iters[0]:
-                    if self._stop.is_set():
-                        return
-                    self._queue.put(batch)
+                while not stop.is_set():
+                    try:
+                        batches = [next(it) for it in self.iters]
+                    except StopIteration:
+                        break
+                    q.put(self._merge(batches))
             finally:
-                self._queue.put(None)
+                if stop.is_set():
+                    try:                    # reset drains the old queue;
+                        q.put_nowait(None)  # never block the dying worker
+                    except Exception:
+                        pass
+                else:
+                    q.put(None)             # normal exhaustion: consumer
+                                            # is still draining, put blocks
+                                            # at most until the next get()
 
+        self._queue = q
+        self._stop = stop
+        self._done = False
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 
     def reset(self):
+        # signal, drain the OLD queue until its producer exits, then build
+        # a fresh queue+thread — stale batches can never leak across epochs
         self._stop.set()
         while self._thread.is_alive():
             try:
@@ -228,13 +281,16 @@ class PrefetchingIter(DataIter):
             except Exception:
                 pass
             self._thread.join(timeout=0.01)
-        self._stop.clear()
-        self.iters[0].reset()
+        for it in self.iters:
+            it.reset()
         self._start()
 
     def __next__(self):
+        if self._done:
+            raise StopIteration
         batch = self._queue.get()
         if batch is None:
+            self._done = True           # exhausted: further next() raises
             raise StopIteration
         return batch
 
@@ -282,38 +338,6 @@ def MNISTIter(image, label, batch_size=1, shuffle=True, flat=False,
         images = images[:, None, :, :]
     return NDArrayIter(images, labels, batch_size=batch_size,
                        shuffle=shuffle, **kwargs)
-
-
-def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
-                    shuffle=False, path_imgidx=None, **kwargs):
-    """Reference src/io/iter_image_recordio_2.cc — RecordIO image batches.
-
-    Python decode path; the gluon ImageRecordDataset + DataLoader is the
-    performant pipeline.
-    """
-    from ..gluon.data.vision.datasets import ImageRecordDataset
-    from ..gluon.data import DataLoader
-
-    ds = ImageRecordDataset(path_imgrec)
-
-    class _Iter(DataIter):
-        def __init__(self):
-            super().__init__(batch_size)
-            self._loader = DataLoader(ds, batch_size=batch_size,
-                                      shuffle=shuffle, last_batch='discard')
-            self._it = iter(self._loader)
-
-        def reset(self):
-            self._it = iter(self._loader)
-
-        def __next__(self):
-            img, lab = next(self._it)
-            img = img.transpose((0, 3, 1, 2)).astype('float32')
-            return DataBatch(data=[img], label=[lab], pad=0)
-
-        next = __next__
-
-    return _Iter()
 
 
 class ThreadedRecordIter(DataIter):

@@ -187,6 +187,17 @@ class Perplexity(CrossEntropy):
         super().__init__(name=name, **kw)
         self.ignore_label = ignore_label
 
+    def update(self, labels, preds):
+        if self.ignore_label is None:
+            return super().update(labels, preds)
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel().astype('int64')
+            pred = _to_np(pred).reshape(label.shape[0], -1)
+            keep = label != self.ignore_label
+            prob = pred[_np.arange(label.shape[0]), label][keep]
+            self.sum_metric += (-_np.log(prob + self.eps)).sum()
+            self.num_inst += int(keep.sum())
+
     def get(self):
         if self.num_inst == 0:
             return (self.name, float('nan'))
@@ -201,35 +212,63 @@ class NegativeLogLikelihood(CrossEntropy):
 
 @register
 class F1(EvalMetric):
+    """F1 score. ``average='macro'`` averages per-class F1 over observed
+    classes (generalizes the reference, which rejects multiclass input);
+    'micro' pools tp/fp/fn; 'binary' scores class 1 only."""
+
     def __init__(self, name='f1', average='macro', **kw):
         super().__init__(name, **kw)
         self.average = average
         self.reset_stats()
 
     def reset_stats(self):
-        self._tp = self._fp = self._fn = 0
+        self._tp, self._fp, self._fn = {}, {}, {}
 
     def reset(self):
         super().reset()
         self.reset_stats()
 
     def update(self, labels, preds):
+        from collections import defaultdict
         for label, pred in zip(_as_list(labels), _as_list(preds)):
             label = _to_np(label).ravel().astype('int32')
             pred = _to_np(pred)
             if pred.ndim > 1:
                 pred = pred.argmax(axis=-1)
             pred = pred.ravel().astype('int32')
-            self._tp += ((pred == 1) & (label == 1)).sum()
-            self._fp += ((pred == 1) & (label == 0)).sum()
-            self._fn += ((pred == 0) & (label == 1)).sum()
+            for c in _np.union1d(_np.unique(label), _np.unique(pred)):
+                c = int(c)
+                self._tp[c] = self._tp.get(c, 0) + int(
+                    ((pred == c) & (label == c)).sum())
+                self._fp[c] = self._fp.get(c, 0) + int(
+                    ((pred == c) & (label != c)).sum())
+                self._fn[c] = self._fn.get(c, 0) + int(
+                    ((pred != c) & (label == c)).sum())
             self.num_inst += 1
 
+    def _f1_of(self, c):
+        tp, fp, fn = self._tp.get(c, 0), self._fp.get(c, 0), \
+            self._fn.get(c, 0)
+        prec = tp / max(tp + fp, 1)
+        rec = tp / max(tp + fn, 1)
+        return 2 * prec * rec / max(prec + rec, 1e-12)
+
     def get(self):
-        prec = self._tp / max(self._tp + self._fp, 1)
-        rec = self._tp / max(self._tp + self._fn, 1)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
-        return (self.name, f1)
+        if self.average == 'micro':
+            tp = sum(self._tp.values())
+            fp = sum(self._fp.values())
+            fn = sum(self._fn.values())
+            prec = tp / max(tp + fp, 1)
+            rec = tp / max(tp + fn, 1)
+            return (self.name, 2 * prec * rec / max(prec + rec, 1e-12))
+        if self.average == 'macro':
+            classes = sorted(self._tp)
+            if not classes:
+                return (self.name, 0.0)
+            return (self.name,
+                    sum(self._f1_of(c) for c in classes) / len(classes))
+        # binary (reference default): F1 of the positive class 1
+        return (self.name, self._f1_of(1))
 
 
 @register

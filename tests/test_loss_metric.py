@@ -140,3 +140,84 @@ def test_composite_custom_perplexity():
     ce = metric.Perplexity()
     ce.update([mx.np.array([0])], [mx.np.array([[1.0, 0.0]])])
     assert ce.get()[1] == pytest.approx(1.0, rel=1e-5)
+
+
+def test_perplexity_ignores_padding():
+    m = mx.metric.Perplexity(ignore_label=0)
+    # batch: 2 real tokens + 2 padding
+    label = mx.np.array(np.array([1, 2, 0, 0], 'f'))
+    pred = np.full((4, 3), 0.1, 'f')
+    pred[0, 1] = 0.5
+    pred[1, 2] = 0.25
+    m.update(label, mx.np.array(pred))
+    want = np.exp((-np.log(0.5) - np.log(0.25)) / 2)
+    assert abs(m.get()[1] - want) < 1e-4
+
+
+def test_f1_macro_multiclass():
+    m = mx.metric.F1(average='macro')
+    label = mx.np.array(np.array([0, 1, 2, 2], 'f'))
+    pred = mx.np.array(np.array([0, 1, 2, 1], 'f'))
+    name, f1 = m.get() if False else (None, None)
+    m.update(label, pred)
+    _, f1 = m.get()
+    # class0: perfect (1.0); class1: p=.5 r=1 → 2/3; class2: p=1 r=.5 → 2/3
+    assert abs(f1 - (1.0 + 2 / 3 + 2 / 3) / 3) < 1e-6
+    micro = mx.metric.F1(average='micro')
+    micro.update(label, pred)
+    assert abs(micro.get()[1] - 0.75) < 1e-6
+
+
+def test_ndarray_iter_discard_and_rollover():
+    from mxnet_tpu.io import NDArrayIter
+    data = np.arange(10, dtype='f').reshape(10, 1)
+    it = NDArrayIter(data, batch_size=3, last_batch_handle='discard')
+    sizes = [b.data[0].shape[0] for b in it]
+    assert sizes == [3, 3, 3]                      # partial batch dropped
+
+    it2 = NDArrayIter(data, batch_size=3, last_batch_handle='roll_over')
+    seen = [b.data[0].asnumpy().ravel() for b in it2]
+    assert [s.shape[0] for s in seen] == [3, 3, 3]
+    it2.reset()                                     # 1 leftover rolls over
+    seen2 = [b.data[0].asnumpy().ravel() for b in it2]
+    assert seen2[0].shape[0] == 3
+    assert seen2[0][0] == 9.0                      # the carried sample
+    # every sample eventually seen across the two epochs
+    all_seen = np.unique(np.concatenate(seen + seen2))
+    assert len(all_seen) == 10
+
+
+def test_prefetching_iter_reset_and_exhaustion():
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    data = np.arange(8, dtype='f').reshape(8, 1)
+    base = NDArrayIter(data, batch_size=2)
+    pf = PrefetchingIter(base)
+    n1 = sum(1 for _ in pf)
+    assert n1 == 4
+    # next() after exhaustion raises immediately, never hangs
+    for _ in range(2):
+        try:
+            next(pf)
+            assert False, 'expected StopIteration'
+        except StopIteration:
+            pass
+    pf.reset()
+    vals = np.concatenate([b.data[0].asnumpy().ravel() for b in pf])
+    assert sorted(vals.tolist()) == list(np.arange(8.0))
+    # reset mid-epoch: no stale batches leak
+    pf.reset()
+    next(pf)
+    pf.reset()
+    vals = np.concatenate([b.data[0].asnumpy().ravel() for b in pf])
+    assert len(vals) == 8
+
+
+def test_prefetching_iter_merges_multiple_iters():
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    a = NDArrayIter(np.zeros((4, 1), 'f'), batch_size=2)
+    b = NDArrayIter(np.ones((4, 1), 'f'), batch_size=2)
+    pf = PrefetchingIter([a, b])
+    batch = next(pf)
+    assert len(batch.data) == 2
+    assert float(batch.data[0].asnumpy()[0, 0]) == 0.0
+    assert float(batch.data[1].asnumpy()[0, 0]) == 1.0
