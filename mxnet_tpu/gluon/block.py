@@ -277,11 +277,13 @@ class _CachedGraph:
         self.remat = remat or os.environ.get(
             'MXNET_BACKWARD_DO_MIRROR', '') == '1'
         self._compiled = {}
+        self._out_trees = {}       # per cache entry: output pytree structure
         self._param_order = None
         self._monitor_callbacks = []
 
     def clear(self):
         self._compiled.clear()
+        self._out_trees.clear()
         self._param_order = None
 
     def _params(self):
@@ -322,7 +324,7 @@ class _CachedGraph:
                 aux_out = [st.aux_writes[id(p)][1]
                            if id(p) in st.aux_writes else ar
                            for p, ar in zip(aux, aux_raws)]
-                self._out_tree = out_tree
+                self._out_trees[shapes_key] = out_tree
                 return tuple(out_raws), tuple(aux_out)
             finally:
                 for p, data in saved:
@@ -351,7 +353,11 @@ class _CachedGraph:
         in_nds = [x if isinstance(x, NDArray) else array(x) for x in leaves]
         main, aux = self._params()
         train_mode = _tape.is_training() if _tape.is_recording() else False
-        key = (tuple((x.shape, str(x.dtype)) for x in in_nds), train_mode)
+        # treedef is part of the key: same leaf shapes under different arg
+        # nesting (or train/eval forwards with different output structures)
+        # must not share a compiled entry or its output pytree
+        key = (tuple((x.shape, str(x.dtype)) for x in in_nds), train_mode,
+               treedef)
         if key not in self._compiled:
             self._compiled[key] = self._build(key, train_mode,
                                               len(in_nds), treedef)
@@ -380,7 +386,7 @@ class _CachedGraph:
                 p._data[c]._rebind(v._data)
             # aux outputs never need grad linkage
             v._ag = None
-        out = jax.tree.unflatten(self._out_tree, list(out_vals))
+        out = jax.tree.unflatten(self._out_trees[key], list(out_vals))
         for cb in self._monitor_callbacks:
             cb(self.block, out)
         return out

@@ -307,3 +307,32 @@ def test_hybridize_remat_matches_plain():
                                 rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(x.grad.asnumpy(), g_plain,
                                 rtol=1e-4, atol=1e-5)
+
+
+def test_hybridize_structure_dependent_outputs_not_confused():
+    """A forward whose output STRUCTURE differs between train and eval must
+    keep separate compiled entries and output trees (regression: a single
+    _out_tree was overwritten by the most recent trace)."""
+    class Net(gluon.nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = nn.Dense(4)
+
+        def forward(self, x):
+            out = self.d(x)
+            if autograd.is_training():
+                return out, out * 2          # train: tuple
+            return out                       # eval: single
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    x = mx.np.ones((2, 3))
+    with autograd.record():
+        o1 = net(x)
+    assert isinstance(o1, tuple) and len(o1) == 2
+    o2 = net(x)
+    assert not isinstance(o2, tuple)
+    with autograd.record():                   # cache-hit train call again
+        o3 = net(x)
+    assert isinstance(o3, tuple) and len(o3) == 2
