@@ -16,10 +16,12 @@ class Estimator:
                  trainer=None, context=None, devices=None):
         self.net = net
         self.loss = loss
-        self.train_metrics = train_metrics or [Accuracy()]
-        if not isinstance(self.train_metrics, list):
-            self.train_metrics = [self.train_metrics]
-        self.train_metrics.append(LossMetric(name='train loss'))
+        tm = train_metrics or [Accuracy()]
+        if not isinstance(tm, list):
+            tm = [tm]
+        # copy: never mutate the caller's list (and never double-append a
+        # loss metric when the same list builds two estimators)
+        self.train_metrics = list(tm) + [LossMetric(name='train loss')]
         self.val_metrics = val_metrics or []
         self.context = context or devices or [current_context()]
         if not isinstance(self.context, list):
@@ -56,11 +58,14 @@ class Estimator:
         handlers = self._init_handlers(val_data, event_handlers, batches)
         train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
             train_end = self._categorize(handlers)
-        stop = [h for h in handlers if isinstance(h, StoppingHandler)][0]
+        # ANY handler may request a stop (EarlyStoppingHandler etc.), not
+        # just the auto-added StoppingHandler
+        def _should_stop():
+            return any(getattr(h, 'stop_training', False) for h in handlers)
 
         for h in train_begin:
             h.train_begin(self)
-        while not stop.stop_training:
+        while not _should_stop():
             for h in epoch_begin:
                 h.epoch_begin(self)
             for batch in train_data:
@@ -75,7 +80,7 @@ class Estimator:
                 for h in batch_end:
                     h.batch_end(self, batch=batch, pred=pred, label=label,
                                 loss=loss, batch_size=data.shape[batch_axis])
-                if stop.stop_training:
+                if _should_stop():
                     break
             for h in epoch_end:
                 h.epoch_end(self)

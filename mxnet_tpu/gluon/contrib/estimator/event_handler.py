@@ -163,6 +163,18 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
         self.batch_index += 1
 
 
+def _resolve_mode(mode, monitor):
+    """'auto' infers the comparison direction from the monitor's name
+    (reference event_handler.py: acc/f1/topk-style metrics maximize)."""
+    if mode != 'auto':
+        return mode
+    name = getattr(monitor, 'name', str(monitor) if monitor else '') or ''
+    name = name.lower()
+    maximize = any(t in name for t in
+                   ('acc', 'f1', 'mcc', 'auc', 'map', 'topk', 'pearson'))
+    return 'max' if maximize else 'min'
+
+
 class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
     """Periodic / best-k checkpointing (reference
     event_handler.py:CheckpointHandler)."""
@@ -179,8 +191,8 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.batch_period = batch_period
         self.current_epoch = 0
         self.current_batch = 0
-        self.best = -_np.inf if mode == 'max' else _np.inf
-        self.mode = mode
+        self.mode = _resolve_mode(mode, monitor)
+        self.best = -_np.inf if self.mode == 'max' else _np.inf
         os.makedirs(model_dir, exist_ok=True)
 
     def train_begin(self, estimator, *args, **kwargs):
@@ -222,7 +234,7 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
         self.monitor = monitor
         self.min_delta = min_delta
         self.patience = patience
-        self.mode = mode
+        self.mode = _resolve_mode(mode, monitor)
         self.baseline = baseline
         self.wait = 0
         self.stopped_epoch = 0

@@ -287,6 +287,7 @@ class InstanceNorm(HybridBlock):
                  beta_initializer='zeros', gamma_initializer='ones',
                  in_channels=0, **kwargs):
         super().__init__(**kwargs)
+        self._axis = axis
         self._epsilon = epsilon
         self.gamma = Parameter('gamma', shape=(in_channels,),
                                init=gamma_initializer, differentiable=scale,
@@ -297,10 +298,17 @@ class InstanceNorm(HybridBlock):
 
     def forward(self, x):
         if self.gamma.shape[0] == 0:
-            c = x.shape[1]
+            c = x.shape[self._axis]
             for p in (self.gamma, self.beta):
                 p.shape = (c,)
                 p._finish_deferred_init()
+        if self._axis not in (1, -x.ndim + 1):
+            # channel-last (or arbitrary) layout: move channels to dim 1,
+            # normalize, move back
+            x_t = x.moveaxis(self._axis, 1)
+            out = _op('instance_norm', x_t, self.gamma.data(),
+                      self.beta.data(), eps=self._epsilon)
+            return out.moveaxis(1, self._axis)
         return _op('instance_norm', x, self.gamma.data(), self.beta.data(),
                    eps=self._epsilon)
 

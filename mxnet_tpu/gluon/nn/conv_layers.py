@@ -273,10 +273,19 @@ class ReflectionPad2D(HybridBlock):
 
     def __init__(self, padding=0, **kwargs):
         super().__init__(**kwargs)
-        p = _pair(padding, 4) if not isinstance(padding, int) else \
-            (padding,) * 4
-        self._pad = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])) \
-            if len(p) == 4 else p
+        if isinstance(padding, int):
+            p = (padding,) * 4
+        else:
+            p = tuple(padding)
+        if len(p) == 8:
+            # reference 8-tuple (N, C, H, W begin/end pairs)
+            self._pad = ((p[0], p[1]), (p[2], p[3]), (p[4], p[5]),
+                         (p[6], p[7]))
+        elif len(p) == 4:
+            self._pad = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]))
+        else:
+            raise ValueError(f'padding must be int, 4- or 8-tuple, got '
+                             f'{padding!r}')
 
     def forward(self, x):
         return _op('pad', x, pad_width=self._pad, mode='reflect')

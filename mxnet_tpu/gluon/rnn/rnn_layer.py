@@ -142,7 +142,6 @@ class _RNNLayer(HybridBlock):
         step_fn, n_states, _ = _STEPS[self._mode]
         n_layers, n_dir, hid = self._num_layers, self._dir, self._hidden_size
         dropout = self._dropout if _tape.is_training() else 0.0
-        key = _rng.next_key() if dropout else None
 
         params = []
         for layer in range(n_layers):
@@ -180,6 +179,11 @@ class _RNNLayer(HybridBlock):
                 out = outs_dir[0] if n_dir == 1 else \
                     jnp.concatenate(outs_dir, axis=-1)
                 if dropout and layer < n_layers - 1:
+                    # key drawn INSIDE the traced fn: under hybridize the
+                    # trace provider supplies a per-call key input, so the
+                    # dropout mask varies per step instead of baking one
+                    # mask into the captured graph
+                    key = _rng.next_key()
                     mask = jax.random.bernoulli(
                         jax.random.fold_in(key, layer), 1 - dropout,
                         out.shape)

@@ -173,29 +173,49 @@ def lognormal(mean=0.0, sigma=1.0, size=None, dtype='float32', key=None):
 
 
 @register('random_multinomial', stochastic=True, differentiable=False,
-          aliases=('sample_multinomial',))
+          aliases=('sample_multinomial',),
+          n_out=lambda a, kw: 2 if kw.get('get_prob') else 1)
 def multinomial(data, shape=None, get_prob=False, dtype='int32', key=None):
     """Sample category indices given (batched) probabilities
-    (reference src/operator/random/sample_multinomial_op.cc)."""
-    n = 1 if shape is None else int(_np.prod(shape)) if not isinstance(
-        shape, int) else shape
-    logits = jnp.log(jnp.maximum(data, 1e-30))
-    out_shape = data.shape[:-1] + ((n,) if shape is not None else ())
-    idx = jax.random.categorical(
-        key, logits, axis=-1,
-        shape=data.shape[:-1] + (n,) if data.ndim > 1 else (n,))
+    (reference src/operator/random/sample_multinomial_op.cc).
+    jax.random.categorical wants extra sample dims as a LEADING prefix;
+    samples move to the trailing position afterwards."""
     if shape is None:
-        idx = jnp.squeeze(idx, -1)
-    idx = idx.reshape(out_shape) if shape is not None else idx
-    return idx.astype(dtype)
+        sample_shape = ()
+    elif isinstance(shape, int):
+        sample_shape = (shape,)
+    else:
+        sample_shape = tuple(shape)
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    batch = data.shape[:-1]
+    idx = jax.random.categorical(key, logits, axis=-1,
+                                 shape=sample_shape + batch)
+    if sample_shape:
+        # (S..., B...) -> (B..., S...)
+        idx = jnp.moveaxis(idx.reshape(sample_shape + batch),
+                           tuple(range(len(sample_shape))),
+                           tuple(range(-len(sample_shape), 0)))
+    idx = idx.astype(dtype)
+    if get_prob:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        probs = jnp.take_along_axis(
+            logp.reshape(batch + (data.shape[-1],)).reshape(
+                (-1, data.shape[-1])),
+            idx.reshape((int(_np.prod(batch or (1,))), -1)).astype('int32'),
+            axis=-1).reshape(idx.shape)
+        return idx, probs
+    return idx
 
 
 @register('random_categorical', stochastic=True, differentiable=False,
           aliases=('categorical',))
 def categorical(logits, num_samples=None, key=None):
-    shape = logits.shape[:-1] + ((num_samples,) if num_samples else ())
-    return jax.random.categorical(key, logits, axis=-1,
-                                  shape=shape or None)
+    if not num_samples:
+        return jax.random.categorical(key, logits, axis=-1)
+    batch = logits.shape[:-1]
+    idx = jax.random.categorical(key, logits, axis=-1,
+                                 shape=(num_samples,) + batch)
+    return jnp.moveaxis(idx, 0, -1)        # (B..., num_samples)
 
 
 @register('random_choice', stochastic=True, differentiable=False,

@@ -260,3 +260,55 @@ def test_amp_overflow_skips_trainer_update():
     # overflow step applies NO update: wd/momentum untouched
     np.testing.assert_array_equal(net.weight.data().asnumpy(), w_before)
     amp._state['enabled'] = False
+
+
+def test_early_stopping_auto_mode_and_estimator_polls_all_handlers():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.gluon.contrib.estimator.event_handler import (
+        EarlyStoppingHandler)
+
+    # auto mode resolves accuracy-like monitors to 'max'
+    acc = mx.metric.Accuracy()
+    h = EarlyStoppingHandler(acc, patience=0)
+    assert h.mode == 'max'
+
+    # a custom handler's stop flag halts fit()
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    metrics = [mx.metric.Accuracy()]
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=metrics)
+    assert len(metrics) == 1               # caller's list untouched
+
+    class StopNow(EarlyStoppingHandler):
+        def epoch_end(self, estimator, *a, **k):
+            self.stop_training = True
+
+    data = [(mx.np.ones((4, 3)), mx.np.zeros((4,)))]
+    stopper = StopNow(acc)
+    est.fit(data, epochs=50, event_handlers=[stopper])
+    assert stopper.stop_training
+    assert est.current_epoch if hasattr(est, 'current_epoch') else True
+
+
+def test_multinomial_batched_and_categorical():
+    import numpy as np
+    import mxnet_tpu as mx
+    probs = mx.np.array(np.tile(np.array([0.1, 0.2, 0.7], 'f'), (4, 1)))
+    out = mx.npx.sample_multinomial(probs, shape=5)
+    assert out.shape == (4, 5)
+    assert (out.asnumpy() >= 0).all() and (out.asnumpy() < 3).all()
+    # scalar draw per row
+    single = mx.npx.sample_multinomial(probs)
+    assert single.shape == (4,)
+    # get_prob returns log-probs of the samples
+    s, lp = mx.npx.sample_multinomial(probs, shape=2, get_prob=True)
+    assert s.shape == (4, 2) and lp.shape == (4, 2)
+    assert (lp.asnumpy() <= 0).all()
+    # categorical with num_samples on batched logits
+    logits = mx.np.array(np.random.randn(8, 5).astype('f'))
+    c = mx.npx.categorical(logits, num_samples=3)
+    assert c.shape == (8, 3)
