@@ -321,8 +321,10 @@ class Embedding(HybridBlock):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
-        self.weight = Parameter('weight', shape=(input_dim, output_dim),
-                                init=weight_initializer, dtype=dtype)
+        self.weight = Parameter(
+            'weight', shape=(input_dim, output_dim),
+            init=weight_initializer, dtype=dtype,
+            grad_stype='row_sparse' if sparse_grad else 'default')
 
     def forward(self, x):
         return _op('embedding', x, self.weight.data(),

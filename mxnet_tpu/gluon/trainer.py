@@ -194,13 +194,39 @@ class Trainer:
             self._amp_skip_update = False
             return
         live = []
+        sparse_live = []
         for i, param in enumerate(self._params):
             if param.grad_req == 'null' or param._data is None:
                 continue
             if i not in self._states:
                 self._states[i] = self._optimizer.create_state_multi_precision(
                     i, param.data())
-            live.append((i, param))
+            if param._grad_stype == 'row_sparse':
+                sparse_live.append((i, param))
+            else:
+                live.append((i, param))
+        if sparse_live:
+            from ..ndarray import sparse as _sp
+            opt = self._optimizer
+            wants_rows = getattr(opt, 'lazy_update', False) or \
+                opt._sparse_rowwise
+            for i, param in sparse_live:
+                # row_sparse grads (Embedding(sparse_grad=True)) take the
+                # per-param sparse path: the optimizer updates only the
+                # rows present in the gradient (reference sgd lazy_update
+                # / sparse.adagrad_update). The dense tape grad is
+                # compressed here — the nnz discovery is the cast_storage
+                # step the reference runs inside the sparse backward
+                # kernel. A non-lazy optimizer would densify right back,
+                # so only compress when the row-wise path will be taken.
+                datas = param.list_data()
+                g = param.list_grad()[0]
+                if wants_rows and not isinstance(g, _sp.BaseSparseNDArray):
+                    g = _sp.row_sparse_array(g)
+                self._optimizer.update_multi_precision(
+                    i, datas[0], g, self._states[i])
+                for d in datas[1:]:
+                    d._rebind(datas[0]._data)
         if not live:
             return
         try:

@@ -165,8 +165,23 @@ class KVStoreLocal(KVStoreBase):
         self._updater = get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        """2-bit PS compression (gradient_compression.h:37) has no role on
-        ICI allreduce; accepted for compatibility."""
+        """Enable 2-bit compression (reference SetGradientCompression,
+        include/mxnet/kvstore.h + gradient_compression.h:37). On the
+        local store this only validates/records params — like the
+        reference, compression is applied on the distributed hop
+        (KVStoreTPUSync), not on in-process reduction."""
+        from .gradient_compression import GradientCompression
+        gc = GradientCompression()
+        gc.set_params(compression_params)
+        self._gc = gc
+
+    @property
+    def gradient_compression(self):
+        gc = getattr(self, '_gc', None)
+        if gc is None:
+            from .gradient_compression import GradientCompression
+            gc = self._gc = GradientCompression()
+        return gc
 
     # ------------------------------------------------------------- topology
     @property

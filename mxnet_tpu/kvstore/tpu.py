@@ -40,12 +40,27 @@ class KVStoreTPUSync(KVStoreLocal):
             devs = jax.devices()
             self._mesh = jax.sharding.Mesh(devs, ('dp',))
 
-    def _allreduce(self, local_sum):
+    def _allreduce(self, local_sum, key=None):
         """Global sum across processes. The gather crosses DCN once per
         tensor; the reduction itself runs on device. (The ICI-optimal
         single-collective path is the SPMD trainer —
         parallel.make_sharded_train_step — where XLA owns the allreduce;
-        this KVStore surface keeps the reference's per-key semantics.)"""
+        this KVStore surface keeps the reference's per-key semantics.)
+
+        With 2-bit gradient compression enabled (set_gradient_compression,
+        reference kvstore_dist.h compressed path), the local gradient is
+        quantized before the hop — 16x fewer bytes over DCN — and the
+        dequantized values are summed; the quantization error stays in
+        this worker's residual (error feedback)."""
+        gc = self.gradient_compression
+        if gc.active and key is not None:
+            shape, dtype = local_sum.shape, local_sum.dtype
+            words = gc.quantize(key, local_sum)
+            if self._nproc == 1:
+                return gc.dequantize(words, shape, dtype)
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(words)
+            return gc.dequantize_sum(jnp.asarray(gathered), shape, dtype)
         if self._nproc == 1:
             return local_sum
         from jax.experimental import multihost_utils
@@ -54,7 +69,7 @@ class KVStoreTPUSync(KVStoreLocal):
 
     def pushpull(self, key, value, out=None, priority=0):
         for k, vals in _group(key, value):
-            merged = self._allreduce(_reduce(vals))
+            merged = self._allreduce(_reduce(vals), key=k)
             if self._updater is not None:
                 if k not in self._store:
                     raise ValueError(
@@ -81,7 +96,7 @@ class KVStoreTPUSync(KVStoreLocal):
 
     def push(self, key, value, priority=0):
         for k, vals in _group(key, value):
-            merged = self._allreduce(_reduce(vals))
+            merged = self._allreduce(_reduce(vals), key=k)
             if self._updater is not None and k in self._store:
                 self._updater(k, NDArray(merged), self._store[k])
             elif k in self._store:

@@ -132,7 +132,17 @@ class Optimizer:
 
     update_multi_precision = update
 
+    #: class-level: optimizer always does row-wise updates on row_sparse
+    #: grads (reference adagrad.py:125 — sparse grads take the fused
+    #: sparse.adagrad_update path unconditionally)
+    _sparse_rowwise = False
+
     def _update_one(self, index, weight, grad, state):
+        from ..ndarray import sparse as _sp
+        if isinstance(grad, _sp.RowSparseNDArray):
+            if getattr(self, 'lazy_update', False) or self._sparse_rowwise:
+                return self._update_one_lazy(index, weight, grad, state)
+            grad = grad.todense()   # std_update: all rows, incl. wd decay
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
@@ -141,6 +151,41 @@ class Optimizer:
                                      t)
         weight._rebind(new_w)
         self._write_state(state, new_state)
+
+    def _update_one_lazy(self, index, weight, grad, state):
+        """Row-wise update on the rows present in a row_sparse grad
+        (reference sgd.py lazy_update / sparse.adagrad_update): absent
+        rows see no weight decay, no momentum decay, no state change —
+        the semantics that make large sparse embeddings trainable."""
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        rows = grad.indices._data.astype(jnp.int32)
+
+        def take(s):
+            if isinstance(s, NDArray):
+                return NDArray(s._data[rows], ctx=s._ctx)
+            if isinstance(s, (list, tuple)):
+                return type(s)(take(x) for x in s)
+            return s
+
+        w_raw = weight._data
+        new_w_rows, new_srows = self.step(w_raw[rows], grad.data._data,
+                                          take(state), lr, wd, t)
+        weight._rebind(w_raw.at[rows].set(new_w_rows))
+        self._write_state_rows(state, new_srows, rows)
+
+    def _write_state_rows(self, state, new_state, rows):
+        if state is None:
+            return
+        if isinstance(state, NDArray):
+            n = new_state[0] if isinstance(new_state, tuple) else new_state
+            state._rebind(state._data.at[rows].set(n))
+        elif isinstance(state, (list, tuple)):
+            for s, n in zip(state, new_state):
+                if isinstance(s, NDArray):
+                    s._rebind(s._data.at[rows].set(n))
 
     def _write_state(self, state, new_state):
         if state is None:
@@ -177,6 +222,7 @@ class SGD(Optimizer):
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum != 0.0:
@@ -215,12 +261,14 @@ class Adam(Optimizer):
     """Reference optimizer/adam.py; fused kernel adam_update."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, correct_bias=True, **kwargs):
+                 epsilon=1e-8, correct_bias=True, lazy_update=False,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
         self.correct_bias = correct_bias
+        self.lazy_update = lazy_update   # reference adam.py:77
 
     def create_state(self, index, weight):
         return (_zeros_like_nd(weight), _zeros_like_nd(weight))
@@ -309,6 +357,8 @@ class Nadam(Optimizer):
 
 @register
 class AdaGrad(Optimizer):
+    _sparse_rowwise = True   # reference adagrad.py:125
+
     def __init__(self, learning_rate=0.01, epsilon=1e-7, **kw):
         super().__init__(learning_rate=learning_rate, **kw)
         self.epsilon = epsilon

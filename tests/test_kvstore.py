@@ -120,3 +120,104 @@ def test_dist_tpu_sync_push_accumulates_like_local():
     out = mx.np.zeros((2,))
     kv.pull(3, out=out)
     np.testing.assert_allclose(out.asnumpy(), [1.5, 2.5], rtol=1e-6)
+
+
+# ---------------------------------------------------------------- compression
+# Reference: 2-bit gradient compression round-trip assertions from
+# tests/nightly/dist_sync_kvstore.py (compressed push/pull) over
+# src/kvstore/gradient_compression.{h,cc}.
+
+def test_gradient_compression_roundtrip():
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+    gc = GradientCompression()
+    gc.set_params({'type': '2bit', 'threshold': 0.5})
+    assert gc.get_compression_factor() == 16
+    assert gc.get_compressed_size(16) == 4          # 16 floats -> one word
+    assert gc.get_compressed_size(17) == 8
+    import jax.numpy as jnp
+    grad = jnp.array([0.7, -0.9, 0.2, -0.2, 0.0, 5.0, -5.0], jnp.float32)
+    words = gc.quantize('k', grad)
+    out = gc.dequantize(words, grad.shape)
+    np.testing.assert_allclose(
+        np.asarray(out), [0.5, -0.5, 0.0, 0.0, 0.0, 0.5, -0.5])
+    # residual holds the quantization error
+    np.testing.assert_allclose(
+        np.asarray(gc._residuals['k']),
+        [0.2, -0.4, 0.2, -0.2, 0.0, 4.5, -4.5], atol=1e-6)
+
+
+def test_gradient_compression_error_feedback():
+    """Small gradients are not lost: the residual accumulates until it
+    crosses the threshold (quantize_2bit::Map residual update)."""
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+    import jax.numpy as jnp
+    gc = GradientCompression()
+    gc.set_params({'type': '2bit', 'threshold': 0.5})
+    grad = jnp.full((4,), 0.2, jnp.float32)
+    total = np.zeros(4, 'f')
+    for _ in range(5):                      # 5 * 0.2 = 1.0 = 2 emissions
+        total += np.asarray(gc.dequantize(gc.quantize('k', grad), (4,)))
+    np.testing.assert_allclose(total, np.full(4, 1.0), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gc._residuals['k']),
+                               np.zeros(4), atol=1e-6)
+
+
+def test_gradient_compression_params_validation():
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+    gc = GradientCompression()
+    with pytest.raises(ValueError):
+        gc.set_params({'type': '1bit'})
+    with pytest.raises(ValueError):
+        gc.set_params({'type': '2bit', 'threshold': -1})
+    with pytest.raises(ValueError):
+        gc.set_params({'type': '2bit', 'bogus': 1})
+
+
+def test_dist_kvstore_compressed_pushpull():
+    """dist_tpu_sync with compression: pulled value is the dequantized
+    gradient; the error stays in the worker residual."""
+    kv = mx.kvstore.create('dist_tpu_sync')
+    kv.set_gradient_compression({'type': '2bit', 'threshold': 0.5})
+    kv.init(7, mx.np.zeros((4,)))
+    g = mx.np.array(np.array([0.6, -0.6, 0.1, 0.0], 'f'))
+    out = mx.np.zeros((4,))
+    kv.pushpull(7, g, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0],
+                               atol=1e-6)
+    # second pushpull: residuals [0.1,-0.1,0.1,0] + g crosses at idx 0,1
+    kv.pushpull(7, g, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0],
+                               atol=1e-6)
+
+
+def test_trainer_accepts_compression_params():
+    net = mx.gluon.nn.Dense(2)
+    net.initialize()
+    trainer = mx.gluon.Trainer(
+        net.collect_params(), 'sgd', {'learning_rate': 0.1},
+        kvstore='dist_tpu_sync',
+        compression_params={'type': '2bit', 'threshold': 0.5})
+    x = mx.np.ones((4, 3))
+    from mxnet_tpu import autograd
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+
+
+def test_gradient_compression_dequantize_sum():
+    """Batched decode+reduce used on the multi-host hop equals per-worker
+    decode then sum."""
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+    import jax.numpy as jnp
+    gc = GradientCompression()
+    gc.set_params({'type': '2bit', 'threshold': 0.5})
+    g1 = jnp.array([0.7, -0.9, 0.2, 5.0, 0.0], jnp.float32)
+    g2 = jnp.array([-0.6, 0.6, 0.6, -0.6, 0.0], jnp.float32)
+    w1 = gc.quantize('a', g1)
+    gc._residuals.pop('a')
+    w2 = gc.quantize('a', g2)
+    stacked = jnp.stack([w1, w2])
+    fused = gc.dequantize_sum(stacked, (5,))
+    ref = gc.dequantize(w1, (5,)) + gc.dequantize(w2, (5,))
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref))

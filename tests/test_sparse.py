@@ -141,3 +141,92 @@ def test_kvstore_row_sparse_pull_list_keys():
                              row_ids=[mx.np.array([0]), mx.np.array([1])])
     onp.testing.assert_allclose(res[0].asnumpy()[0], [0, 1])
     onp.testing.assert_allclose(res[1].asnumpy()[1], [6, 7])
+
+
+# ------------------------------------------------------- sparse optimizer
+# Reference: optimizer/sgd.py lazy_update (row_sparse grads update only
+# present rows) and adagrad.py:125 (sparse.adagrad_update path).
+
+def test_sgd_lazy_update_rowwise():
+    from mxnet_tpu.ndarray import sparse as sp
+    opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9, lazy_update=True)
+    w = mx.np.array(onp.ones((4, 2), 'f'))
+    state = opt.create_state(0, w)
+    g = sp.RowSparseNDArray(mx.np.array(onp.full((2, 2), 2.0, 'f')),
+                            mx.np.array(onp.array([1, 3])), (4, 2))
+    opt.update(0, w, g, state)
+    out = w.asnumpy()
+    # untouched rows unchanged (no wd, no momentum decay)
+    onp.testing.assert_allclose(out[0], [1, 1])
+    onp.testing.assert_allclose(out[2], [1, 1])
+    onp.testing.assert_allclose(out[1], 1 - 0.5 * 2.0)
+    # momentum state only written on touched rows
+    st = state.asnumpy()
+    onp.testing.assert_allclose(st[0], [0, 0])
+    onp.testing.assert_allclose(st[1], -1.0)
+
+
+def test_sgd_std_update_densifies():
+    """lazy_update=False: sparse grad behaves exactly like its dense
+    equivalent — wd applies to every row (reference std_update)."""
+    from mxnet_tpu.ndarray import sparse as sp
+    w1 = mx.np.array(onp.ones((3, 2), 'f'))
+    w2 = mx.np.array(onp.ones((3, 2), 'f'))
+    gd = onp.zeros((3, 2), 'f')
+    gd[1] = 3.0
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1)
+    opt.update(0, w1, sp.row_sparse_array(mx.np.array(gd)), None)
+    opt2 = mx.optimizer.SGD(learning_rate=0.1, wd=0.1)
+    opt2.update(0, w2, mx.np.array(gd), None)
+    onp.testing.assert_allclose(w1.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_adagrad_sparse_rowwise():
+    from mxnet_tpu.ndarray import sparse as sp
+    opt = mx.optimizer.AdaGrad(learning_rate=0.5)
+    w = mx.np.array(onp.ones((4, 2), 'f'))
+    state = opt.create_state(0, w)
+    g = sp.RowSparseNDArray(mx.np.array(onp.full((1, 2), 2.0, 'f')),
+                            mx.np.array(onp.array([2])), (4, 2))
+    opt.update(0, w, g, state)
+    out = w.asnumpy()
+    onp.testing.assert_allclose(out[0], [1, 1])
+    assert out[2][0] < 1.0
+    st = state.asnumpy()
+    onp.testing.assert_allclose(st[2], 4.0)     # g^2 accumulated
+    onp.testing.assert_allclose(st[0], 0.0)
+
+
+def test_adam_lazy_update_rowwise():
+    from mxnet_tpu.ndarray import sparse as sp
+    opt = mx.optimizer.Adam(learning_rate=0.1, lazy_update=True)
+    w = mx.np.array(onp.ones((4, 2), 'f'))
+    state = opt.create_state(0, w)
+    g = sp.RowSparseNDArray(mx.np.array(onp.full((2, 2), 1.0, 'f')),
+                            mx.np.array(onp.array([0, 3])), (4, 2))
+    opt.update(0, w, g, state)
+    out = w.asnumpy()
+    onp.testing.assert_allclose(out[1], [1, 1])
+    assert out[0][0] < 1.0
+    m = state[0].asnumpy()
+    assert abs(m[0][0]) > 0 and m[1][0] == 0
+
+
+def test_embedding_sparse_grad_trainer():
+    """Embedding(sparse_grad=True) end-to-end: only looked-up rows move
+    (reference Embedding sparse_grad + lazy sgd)."""
+    from mxnet_tpu import autograd, gluon
+    net = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    net.initialize()
+    before = net.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 1.0, 'lazy_update': True})
+    x = mx.np.array(onp.array([1, 5]))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    after = net.weight.data().asnumpy()
+    changed = onp.any(after != before, axis=1)
+    assert changed[1] and changed[5]
+    assert not changed[0] and not changed[9]
