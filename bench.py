@@ -40,15 +40,27 @@ def bench_resnet(args, mx):
         net.cast(dtype)
     net.hybridize(static_alloc=True)
 
+    # every timed iteration gets value-distinct input: the dev tunnel
+    # content-caches (executable, input-values) pairs, so feeding the
+    # same batch every step measures the cache, not the chip. The
+    # per-iteration perturbation is one fused scalar op — noise next to
+    # the conv stack.
+    # eps must exceed the bf16 ulp at 1.0 (2^-7): smaller steps quantize
+    # away and consecutive iterations degenerate to identical values
     x = mx.np.ones((args.batch, 3, 224, 224), dtype=dtype, ctx=ctx)
-    for _ in range(args.warmup):
-        y = net(x)
+    eps = mx.np.full((1,), 2.0 ** -6, dtype=dtype, ctx=ctx)
+
+    def batch(i):
+        return x + eps * float(i + 1)
+
+    for i in range(args.warmup):
+        y = net(batch(i))
     y.wait_to_read()
 
     t0 = time.perf_counter()
     outs = []
-    for _ in range(args.iters):
-        outs.append(net(x))
+    for i in range(args.iters):
+        outs.append(net(batch(args.warmup + i)))
     for o in outs:
         o.wait_to_read()
     dt = time.perf_counter() - t0
@@ -143,8 +155,12 @@ def bench_llama_decode(args, mx):
     n_new = max(args.iters, 32)
     out = net.generate(prompt, max_new_tokens=n_new)       # compile
     out.wait_to_read()
+    # time a DIFFERENT prompt: the dev tunnel content-caches identical
+    # (program, inputs) executions, so re-timing the warmup prompt would
+    # measure the cache instead of the decode loop
+    prompt2 = mx.np.array(rng.integers(1, 32000, (1, 32)).astype('float32'))
     t0 = time.perf_counter()
-    out = net.generate(prompt, max_new_tokens=n_new)
+    out = net.generate(prompt2, max_new_tokens=n_new)
     out.wait_to_read()
     dt = time.perf_counter() - t0
     tps = n_new / dt

@@ -26,6 +26,7 @@ cached_op.cc:776). The compiled step:
 import os
 import re
 import threading
+import warnings
 
 import numpy as _np
 
@@ -280,6 +281,12 @@ class _CachedGraph:
         self._out_trees = {}       # per cache entry: output pytree structure
         self._param_order = None
         self._monitor_callbacks = []
+        # set when the graph has data-dependent shapes (boolean_mask,
+        # np.unique, ...) that abstract jit tracing cannot express —
+        # the block then runs eagerly, like the reference CachedOp with
+        # config.is_dynamic (cached_op.h:455: "uses dynamic shape" →
+        # op-by-op execution)
+        self._dynamic = False
 
     def clear(self):
         self._compiled.clear()
@@ -348,6 +355,12 @@ class _CachedGraph:
         import jax
         from ..ops.registry import Op, apply_op
 
+        if self._dynamic:
+            out = self.block.forward(*args)
+            for cb in self._monitor_callbacks:
+                cb(self.block, out)
+            return out
+
         leaves, treedef = jax.tree.flatten(
             args, is_leaf=lambda x: isinstance(x, NDArray))
         in_nds = [x if isinstance(x, NDArray) else array(x) for x in leaves]
@@ -375,8 +388,27 @@ class _CachedGraph:
             outs, aux_out = jfn(rng_key, tuple(ins), tuple(ps), aux_raws)
             return tuple(outs) + tuple(aux_out)
 
+        from ..ops.registry import DynamicShapeError
+
         op = Op('_CachedOp', fn, differentiable=True)
-        res = apply_op(op, in_nds + main_nds, fn, name='_CachedOp')
+        try:
+            res = apply_op(op, in_nds + main_nds, fn, name='_CachedOp')
+        except DynamicShapeError:
+            # a dynamic-output-shape op inside the graph (boolean_mask,
+            # unique, ...): permanently switch this block to eager
+            # op-by-op execution (reference dynamic-shape CachedOp).
+            # Other tracing errors — e.g. Python control flow on traced
+            # values — propagate unchanged so user bugs stay visible.
+            # The failed entry is dropped so a later clear()+
+            # re-hybridize can retry compilation.
+            self._dynamic = True
+            self._compiled.pop(key, None)
+            self._out_trees.pop(key, None)
+            warnings.warn(
+                f'{type(self.block).__name__}: graph has data-dependent '
+                'shapes; hybridize falls back to eager execution '
+                '(reference CachedOp is_dynamic)', stacklevel=2)
+            return self(args)
         if not isinstance(res, tuple):
             res = (res,)
         out_vals = res[:len(res) - n_aux] if n_aux else res

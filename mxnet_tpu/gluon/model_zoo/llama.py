@@ -315,39 +315,65 @@ class LlamaForCausalLM(HybridBlock):
             return jax.random.categorical(
                 key, last / temperature, axis=-1).astype(jnp.int32)
 
-        # compiled steps are cached per (batch, prompt, cache-len, greedy)
-        # so repeat generate() calls skip tracing; cache buffers are
-        # donated (≙ static_alloc's buffer reuse)
-        sig = (B, S, L, float(temperature))
+        # compiled steps are cached so repeat generate() calls skip
+        # tracing; cache buffers are donated (≙ static_alloc's buffer
+        # reuse). The whole decode loop is ONE lax.scan program: no
+        # per-token host dispatch at all — the Python-loop equivalent
+        # pays a dispatch round-trip per token, which at ~1 ms/token
+        # decode speed is a measurable tax. The prefill key excludes
+        # n_new (it doesn't depend on it); the scan length does enter
+        # the decode key, so n_new is rounded up to a power of two and
+        # excess tokens are computed-and-dropped — varying-length
+        # generate() calls hit a handful of compiled programs instead of
+        # one per distinct n.
+        n_rest = max_new_tokens - 1
+        n_pad = 1
+        while n_pad < n_rest:
+            n_pad *= 2
+        n_pad = min(n_pad, L - S - 1)
+        psig = (B, S, L, float(temperature))
+        dsig = psig + (n_pad,)
         steps = getattr(self, '_gen_steps', None)
         if steps is None:
             steps = self._gen_steps = {}
-        if sig in steps:
-            prefill, decode = steps[sig]
+        if len(steps) > 16:    # bound compiled-executable growth
+            steps.pop(next(iter(steps)))
+        if psig in steps:
+            prefill = steps[psig]
         else:
             @jax.jit
             def prefill(praws_, tok, caches, key):
                 logits, caches = run(praws_, tok, caches, 0)
                 return pick(logits, key), caches
 
+            steps[psig] = prefill
+        if dsig in steps:
+            decode_n = steps[dsig]
+        else:
             @partial(jax.jit, donate_argnums=(2,))
-            def decode(praws_, tok, caches, offset, key):
-                logits, caches = run(praws_, tok[:, None], caches, offset)
-                return pick(logits, key), caches
+            def decode_n(praws_, tok, caches, offset, key):
+                def body(carry, _):
+                    nxt, ch, off, k = carry
+                    k, sub = jax.random.split(k)
+                    logits, ch = run(praws_, nxt[:, None], ch, off)
+                    nxt = pick(logits, sub)
+                    return (nxt, ch, off + 1, k), nxt
 
-            steps[sig] = (prefill, decode)
+                (_, caches_, _, _), toks_out = jax.lax.scan(
+                    body, (tok, caches, offset, key), None, length=n_pad)
+                return toks_out, caches_    # (n_pad, B)
+
+            steps[dsig] = decode_n
 
         key = jax.random.PRNGKey(seed)
         caches = self.init_caches(B, L)
         key, sub = jax.random.split(key)
         nxt, caches = prefill(praws, toks, caches, sub)
         out = [toks, nxt[:, None]]
-        offset = jnp.asarray(S, jnp.int32)
-        for _ in range(max_new_tokens - 1):
-            key, sub = jax.random.split(key)
-            nxt, caches = decode(praws, nxt, caches, offset, sub)
-            out.append(nxt[:, None])
-            offset = offset + 1
+        if max_new_tokens > 1:
+            rest, caches = decode_n(praws, nxt, caches,
+                                    jnp.asarray(S, jnp.int32), key)
+            out.append(rest[:n_rest].T)   # drop pad-to-power-of-2 excess
         return NDArray(jnp.concatenate(out, axis=1))
 
 

@@ -300,20 +300,29 @@ def searchsorted(a, v, side='left'):
     return jnp.searchsorted(a, v, side=side)
 
 
-@register('argwhere', differentiable=False)
+def _dyn_unless_size(args, kwargs):
+    # with an explicit size= the output shape is static and jit-safe
+    return kwargs.get('size') is None and (len(args) < 2 or args[1] is None)
+
+
+@register('argwhere', differentiable=False, dynamic_shape=_dyn_unless_size)
 def argwhere(x, size=None):
     return jnp.argwhere(x, size=size)
 
 
-@register('nonzero', differentiable=False)
+@register('nonzero', differentiable=False, dynamic_shape=_dyn_unless_size)
 def nonzero(x, size=None):
     return jnp.nonzero(x, size=size)
 
 
-@register('boolean_mask', differentiable=False)
+@register('boolean_mask', static_argnums=(1,), static_argnames=('index',),
+          dynamic_shape=True)
 def boolean_mask(data, index, axis=0):
-    """Reference: src/operator/contrib/boolean_mask.cc. Dynamic output shape
-    — host-side in eager mode; unsupported under jit (use masking instead)."""
+    """Reference: src/operator/contrib/boolean_mask.cc. Dynamic output
+    shape: the mask is baked as a concrete constant (static arg), so the
+    op is differentiable w.r.t. ``data`` — the backward scatters
+    cotangents to the kept rows (reference BooleanMaskBackward) — while
+    the output shape stays data-independent for the tracer."""
     mask = index.astype(bool)
     return jnp.compress(mask, data, axis=axis)
 

@@ -132,7 +132,8 @@ def _unique_n_out(args, kwargs):
     return n
 
 
-@register('unique', differentiable=False, n_out=_unique_n_out)
+@register('unique', differentiable=False, n_out=_unique_n_out,
+          dynamic_shape=lambda args, kw: kw.get('size') is None)
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
            axis=None, size=None):
     return jnp.unique(x, return_index=return_index,

@@ -37,10 +37,12 @@ class Op:
     """
 
     __slots__ = ('name', 'fn', 'differentiable', 'stochastic', 'namespaces',
-                 'aliases', 'wrap', 'n_out')
+                 'aliases', 'wrap', 'n_out', 'static_argnums',
+                 'static_argnames', 'dynamic_shape')
 
     def __init__(self, name, fn, differentiable=True, stochastic=False,
-                 namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1):
+                 namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1,
+                 static_argnums=(), static_argnames=(), dynamic_shape=False):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
@@ -51,10 +53,29 @@ class Op:
         # output arity for symbolic construction (≙ FNumOutputs in the
         # reference op registry): int, or callable(args, kwargs) -> int
         self.n_out = n_out
+        # NDArray args baked as concrete constants instead of traced
+        # (their values may steer data-dependent output shapes, and no
+        # gradient flows to them — reference MakeZeroGradNodes on that
+        # input). E.g. boolean_mask's mask.
+        self.static_argnums = frozenset(static_argnums)
+        self.static_argnames = frozenset(static_argnames)
+        # op's output shape depends on input VALUES (reference
+        # FInferShape returning unknown → dynamic-shape CachedOp):
+        # raises DynamicShapeError under abstract tracing so callers
+        # (e.g. _CachedGraph) can fall back to eager precisely
+        self.dynamic_shape = dynamic_shape
+
+
+class DynamicShapeError(TypeError):
+    """A dynamic-output-shape op was reached with abstract (traced)
+    inputs. Raised instead of an opaque jax tracer error so the caller
+    can distinguish "this graph needs eager execution" (reference
+    CachedOp is_dynamic) from a genuine tracing bug in user code."""
 
 
 def register(name=None, differentiable=True, stochastic=False,
-             namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1):
+             namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1,
+             static_argnums=(), static_argnames=(), dynamic_shape=False):
     """Decorator registering a raw-array function as an operator.
 
     The decorated ``fn`` takes jax arrays (plus static kwargs) and returns a
@@ -67,7 +88,10 @@ def register(name=None, differentiable=True, stochastic=False,
         opname = name or fn.__name__
         op = Op(opname, fn, differentiable=differentiable,
                 stochastic=stochastic, namespaces=namespaces,
-                aliases=aliases, wrap=wrap, n_out=n_out)
+                aliases=aliases, wrap=wrap, n_out=n_out,
+                static_argnums=static_argnums,
+                static_argnames=static_argnames,
+                dynamic_shape=dynamic_shape)
         _OPS[opname] = op
         for a in aliases:
             _OPS[a] = op
@@ -142,16 +166,21 @@ def invoke(op_name, args, kwargs):
     consts = list(args)
     for i, a in enumerate(args):
         if isinstance(a, NDArray):
-            arr_slots.append((i, None))
-            arrays.append(a)
+            if i in op.static_argnums:
+                consts[i] = a._data   # bake concrete; no grad, no tracing
+            else:
+                arr_slots.append((i, None))
+                arrays.append(a)
         elif isinstance(a, (list, tuple)):
             consts[i] = list(a)
             for j, e in enumerate(a):
                 if isinstance(e, NDArray):
                     arr_slots.append((i, j))
                     arrays.append(e)
-    kw_arr = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
-    kw_static = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
+    kw_arr = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)
+              and k not in op.static_argnames}
+    kw_static = {k: (v._data if isinstance(v, NDArray) else v)
+                 for k, v in kwargs.items() if k not in kw_arr}
     kw_keys = list(kw_arr)
     arrays = arrays + [kw_arr[k] for k in kw_keys]
 
@@ -168,6 +197,17 @@ def invoke(op_name, args, kwargs):
         kw = dict(kw_static)
         for k, r in zip(kw_keys, raws[npos:]):
             kw[k] = r
+        dyn = op.dynamic_shape(a, kw) if callable(op.dynamic_shape) \
+            else op.dynamic_shape
+        # abstract tracers only: vjp/JVP tracers carry concrete primals
+        # and evaluate dynamic-shape ops fine
+        if dyn and any(isinstance(x, jax.core.Tracer)
+                       and not jax.core.is_concrete(x)
+                       for x in (*a, *kw.values()) if x is not None):
+            raise DynamicShapeError(
+                f'op {op.name!r} has a data-dependent output shape and '
+                'cannot run under abstract tracing (reference '
+                'dynamic-shape CachedOp); execute it eagerly')
         return fn_raw(*a, **kw)
 
     if out is not None:

@@ -1,0 +1,101 @@
+"""Dynamic (data-dependent) output shapes.
+
+Reference: tests/python/unittest/test_dynamic_shape.py (boolean_mask under
+a hybridized block with backward) + the dynamic-shape CachedOp config
+(src/imperative/cached_op.h:455 is_dynamic → op-by-op execution). TPU
+design: abstract jit tracing cannot express data-dependent shapes, so a
+hybridized graph containing one falls back to eager execution — same
+split as the reference's static/dynamic CachedOp paths.
+"""
+
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_boolean_mask_forward_backward():
+    """Mirrors reference test_dynamic_shape.py::test_dynamic_shape."""
+    data = mx.np.array(onp.array([[1, 2, 3], [4, 5, 6], [7, 8, 9]], 'f'))
+    index = mx.np.array(onp.array([0, 1, 1], 'f'))
+    data.attach_grad()
+    with autograd.record():
+        result = mx.npx.boolean_mask(data, index)
+    result.backward()
+    assert_almost_equal(result, onp.array([[4, 5, 6], [7, 8, 9]], 'f'))
+    assert_almost_equal(data.grad,
+                        onp.array([[0, 0, 0], [1, 1, 1], [1, 1, 1]], 'f'))
+
+
+def test_boolean_mask_hybridized_backward():
+    class _TestBlock(gluon.HybridBlock):
+        def forward(self, data, index):
+            return mx.npx.boolean_mask(data, index)
+
+    block = _TestBlock()
+    block.hybridize()
+    data = mx.np.array(onp.array([[1, 2, 3], [4, 5, 6], [7, 8, 9]], 'f'))
+    index = mx.np.array(onp.array([0, 1, 1], 'f'))
+    data.attach_grad()
+    with autograd.record():
+        result = block(data, index)
+    result.backward()
+    assert_almost_equal(result, onp.array([[4, 5, 6], [7, 8, 9]], 'f'))
+    assert_almost_equal(data.grad,
+                        onp.array([[0, 0, 0], [1, 1, 1], [1, 1, 1]], 'f'))
+
+
+def test_boolean_mask_hybridized_mask_change():
+    """A hybridized dynamic-shape graph must honor fresh mask values —
+    it switches to eager execution rather than baking the first mask."""
+    class _TestBlock(gluon.HybridBlock):
+        def forward(self, data, index):
+            return mx.npx.boolean_mask(data, index)
+
+    block = _TestBlock()
+    block.hybridize()
+    data = mx.np.array(onp.array([[1, 2, 3], [4, 5, 6], [7, 8, 9]], 'f'))
+    r1 = block(data, mx.np.array(onp.array([0, 1, 1], 'f')))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter('always')
+        r2 = block(data, mx.np.array(onp.array([1, 0, 0], 'f')))
+    assert r1.asnumpy().tolist() == [[4, 5, 6], [7, 8, 9]]
+    assert r2.asnumpy().tolist() == [[1, 2, 3]]
+    assert any('data-dependent' in str(w.message) for w in caught)
+    assert block._cached_graph._dynamic
+    # still correct (and silent) once in dynamic mode
+    r3 = block(data, mx.np.array(onp.array([1, 1, 0], 'f')))
+    assert r3.asnumpy().tolist() == [[1, 2, 3], [4, 5, 6]]
+
+
+def test_unique_dynamic():
+    x = mx.np.array(onp.array([1, 2, 2, 3, 3, 3], 'f'))
+    vals, counts = mx.np.unique(x, return_counts=True)
+    assert vals.asnumpy().tolist() == [1, 2, 3]
+    assert counts.asnumpy().tolist() == [1, 2, 3]
+
+
+def test_nonzero_argwhere_dynamic():
+    x = mx.np.array(onp.array([[0, 1], [2, 0]], 'f'))
+    (rows, cols) = mx.np.nonzero(x)
+    assert rows.asnumpy().tolist() == [0, 1]
+    assert cols.asnumpy().tolist() == [1, 0]
+    aw = mx.np.argwhere(x)
+    assert aw.asnumpy().tolist() == [[0, 1], [1, 0]]
+
+
+def test_boolean_mask_no_grad_to_mask():
+    """The mask input receives no gradient (reference
+    MakeZeroGradNodes on the index input of boolean_mask)."""
+    data = mx.np.array(onp.ones((3, 2), 'f'))
+    index = mx.np.array(onp.array([1, 0, 1], 'f'))
+    data.attach_grad()
+    index.attach_grad()
+    with autograd.record():
+        out = mx.npx.boolean_mask(data, index)
+    out.backward()
+    assert_almost_equal(index.grad, onp.zeros(3, 'f'))

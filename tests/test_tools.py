@@ -131,3 +131,18 @@ def test_bandwidth_measure_uniform():
     result = json.loads(r.stdout.strip().splitlines()[-1])
     assert result['metric'] == 'kvstore_pushpull_bandwidth'
     assert result['value'] > 0
+
+
+def test_flakiness_checker_spec_parsing():
+    """Reference tools/flakiness_checker.py CLI spec forms."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'flakiness_checker', 'tools/flakiness_checker.py')
+    fc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fc)
+    p, name = fc.parse_test_spec('test_tools.py::test_diagnose_runs')
+    assert p.endswith('test_tools.py') and name == 'test_diagnose_runs'
+    p2, name2 = fc.parse_test_spec('test_diagnose_runs')
+    assert p2.endswith('test_tools.py') and name2 == 'test_diagnose_runs'
+    p3, name3 = fc.parse_test_spec('test_tools.py')
+    assert p3.endswith('test_tools.py') and name3 is None

@@ -72,6 +72,12 @@ def main(argv=None):
     times = []
     for it in range(args.warmup + args.num_batches):
         outs = [mx.np.zeros(g.shape) for g in grads]
+        # value-distinct gradients every iteration: the dev tunnel
+        # content-caches identical executions, which would turn repeat
+        # pushpulls of the same values into cache hits
+        grads = [g * 1.0001 for g in grads]
+        for g in grads:
+            g.wait_to_read()
         for o in outs:
             o.wait_to_read()
         t0 = time.perf_counter()

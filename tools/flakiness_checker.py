@@ -1,0 +1,107 @@
+"""Check a test for flakiness by re-running it many times under fresh seeds.
+
+Reference: ``tools/flakiness_checker.py`` (same CLI shape: a test spec as
+``test_file.py::test_name`` / ``test_file.py:test_name`` / bare
+``test_name``, with ``--num-trials`` and ``--seed``). The reference relies
+on the in-process ``MXNET_TEST_COUNT`` rerun loop of its ``with_seed``
+decorator; here each trial is its own pytest process so a trial that
+wedges the accelerator runtime cannot poison the next one, and the seed
+goes in via ``MXNET_TEST_SEED`` (honored by tests/conftest.py).
+"""
+
+import argparse
+import logging
+import os
+import random
+import subprocess
+import sys
+
+logging.basicConfig(level=logging.INFO)
+logger = logging.getLogger('flakiness_checker')
+
+DEFAULT_NUM_TRIALS = 30
+
+
+def find_test_path(test_file):
+    """Locate the test file under cwd (reference find_test_path)."""
+    if os.path.isabs(test_file) and os.path.exists(test_file):
+        return test_file
+    top = os.getcwd()
+    candidates = [os.path.join(top, test_file),
+                  os.path.join(top, 'tests', test_file)]
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    for root, _dirs, files in os.walk(top):
+        if os.path.basename(test_file) in files:
+            return os.path.join(root, os.path.basename(test_file))
+    raise FileNotFoundError(f'could not find test file {test_file!r}')
+
+
+def parse_test_spec(spec):
+    """Accept file.py::name, file.py:name, file.py, or bare test name."""
+    for sep in ('::', ':'):
+        if sep in spec:
+            f, name = spec.split(sep, 1)
+            return find_test_path(f), name
+    if spec.endswith('.py'):
+        return find_test_path(spec), None
+    # bare test name: grep the tests/ tree for its definition
+    for root, _dirs, files in os.walk(os.path.join(os.getcwd(), 'tests')):
+        for f in files:
+            if not f.endswith('.py'):
+                continue
+            p = os.path.join(root, f)
+            with open(p, encoding='utf-8') as fh:
+                if f'def {spec}(' in fh.read():
+                    return p, spec
+    raise ValueError(f'could not locate a test named {spec!r}')
+
+
+def run_trials(path, name, num_trials, seed, verbosity):
+    target = f'{path}::{name}' if name else path
+    rng = random.Random(seed)
+    failures = 0
+    for trial in range(num_trials):
+        trial_seed = rng.randrange(2 ** 31)
+        env = dict(os.environ, MXNET_TEST_SEED=str(trial_seed))
+        cmd = [sys.executable, '-m', 'pytest', '-q', target]
+        if verbosity > 2:
+            cmd.remove('-q')
+        res = subprocess.run(cmd, env=env, capture_output=verbosity <= 2)
+        status = 'PASS' if res.returncode == 0 else 'FAIL'
+        if res.returncode != 0:
+            failures += 1
+            logger.info('trial %d seed %d: FAIL', trial, trial_seed)
+            if verbosity >= 2 and res.stdout:
+                sys.stdout.write(res.stdout.decode(errors='replace')[-4000:])
+        else:
+            logger.debug('trial %d seed %d: %s', trial, trial_seed, status)
+    logger.info('%d/%d trials failed', failures, num_trials)
+    return failures
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description='Check a test for flakiness')
+    parser.add_argument('test', help='test spec: file.py::name, '
+                        'file.py, or bare test function name')
+    parser.add_argument('-n', '--num-trials', type=int,
+                        default=DEFAULT_NUM_TRIALS)
+    parser.add_argument('-s', '--seed', type=int, default=None,
+                        help='seed for the trial-seed sequence '
+                        '(reproducible rerun of a flaky batch)')
+    parser.add_argument('-v', '--verbosity', type=int, default=2)
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    path, name = parse_test_spec(args.test)
+    failures = run_trials(path, name, args.num_trials, args.seed,
+                          args.verbosity)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == '__main__':
+    main()
