@@ -4,7 +4,7 @@ TPU-native replacement for the reference's 201 kLoC ``src/operator/`` tree
 (584 NNVM_REGISTER_OP sites — SURVEY §2.1). Roughly 90% of those ops are
 thin wrappers over jax.numpy / jax.lax, which XLA fuses and tiles onto the
 MXU; the remainder (fused attention, specialized reductions) get Pallas
-kernels under :mod:`mxnet_tpu.ops.pallas_kernels`.
+kernels under :mod:`mxnet_tpu.ops.pallas` (flash attention, fused norms).
 
 Importing this package registers all ops into the global registry; the
 frontend namespaces (mx.nd, mx.np, mx.npx) are then code-generated from the

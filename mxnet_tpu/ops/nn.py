@@ -366,8 +366,13 @@ def batch_norm_train(x, gamma, beta, eps=1e-5, axis=1, fix_gamma=False):
 
 @register('layer_norm', aliases=('LayerNorm',))
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
-    """Reference: src/operator/nn/layer_norm.cc — fused by XLA into two
-    passes over the row; a Pallas fused variant lives in pallas_kernels."""
+    """Reference: src/operator/nn/layer_norm.cc (hand-fused CUDA kernel).
+    Last-axis norms take the Pallas single-HBM-pass kernel on TPU
+    (ops/pallas/fused_norms.py, fp32 statistics, custom recompute
+    backward); other axes and non-tiling widths use the XLA lowering."""
+    if axis in (-1, data.ndim - 1) and gamma.ndim == 1:
+        from .pallas.fused_norms import fused_layer_norm
+        return fused_layer_norm(data, gamma, beta, eps)
     mean = jnp.mean(data, axis=axis, keepdims=True)
     var = jnp.var(data, axis=axis, keepdims=True)
     out = (data - mean) * lax.rsqrt(var + eps)
@@ -443,7 +448,11 @@ def moments(data, axes=None, keepdims=False):
 
 @register('rms_norm')
 def rms_norm(data, gamma, axis=-1, eps=1e-6):
-    """New (no reference analog): RMSNorm for the LLM stack."""
+    """New (no reference analog): RMSNorm for the LLM stack. Last-axis
+    case takes the Pallas single-pass kernel (ops/pallas/fused_norms.py)."""
+    if axis in (-1, data.ndim - 1) and gamma.ndim == 1:
+        from .pallas.fused_norms import fused_rms_norm
+        return fused_rms_norm(data, gamma, eps)
     ms = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
     out = data * lax.rsqrt(ms + eps)
     shape = [1] * data.ndim
