@@ -239,16 +239,41 @@ def bench_op(mx, name, runs=10, warmup=3, backward=True):
         else spec.get('kwargs', {})
     fn = getattr(mx.npx, name, None) or getattr(mx.np, name)
 
-    def fwd():
-        out = fn(*args, **kwargs)
+    # Per-run value perturbation: the dev tunnel content-caches identical
+    # (program, inputs) executions, so repeat runs of byte-identical args
+    # would time the cache. All perturbed variants of the first float
+    # tensor (a ~1e-6 relative shrink per run, staying inside op domains)
+    # are materialized BEFORE the timed loops so the multiply is never
+    # part of a measured run, and the fwd and fwd+bwd phases draw from
+    # disjoint variant ranges so no (program, inputs) pair ever repeats.
+    fidx = next((j for j, a in enumerate(args)
+                 if hasattr(a, 'dtype') and
+                 str(a.dtype).startswith('float')), None)
+    n_variants = 2 * (warmup + runs)
+    if fidx is not None:
+        variants = [args[fidx] * (1.0 - (i + 1) * 2.0 ** -20)
+                    for i in range(n_variants)]
+        for v in variants:
+            v.wait_to_read()
+    else:
+        variants = None
+
+    def perturbed(i):
+        a = list(args)
+        if variants is not None:
+            a[fidx] = variants[i]
+        return a
+
+    def fwd(i):
+        out = fn(*perturbed(i), **kwargs)
         (out[0] if isinstance(out, (tuple, list)) else out).wait_to_read()
         return out
 
-    for _ in range(warmup):
-        fwd()
+    for i in range(warmup):
+        fwd(i)
     t0 = time.perf_counter()
-    for _ in range(runs):
-        fwd()
+    for i in range(runs):
+        fwd(warmup + i)
     fwd_ms = (time.perf_counter() - t0) / runs * 1e3
 
     bwd_ms = None
@@ -259,20 +284,28 @@ def bench_op(mx, name, runs=10, warmup=3, backward=True):
         grads_on = [a for a in args if hasattr(a, 'attach_grad')]
         for a in grads_on:
             a.attach_grad()
+        if variants is not None:
+            for v in variants:
+                if hasattr(v, 'attach_grad'):
+                    v.attach_grad()
 
-        def step():
+        def step(i):
+            a = perturbed(i)
+            sync = a[fidx] if variants is not None and \
+                hasattr(a[fidx], 'attach_grad') else grads_on[0]
             with autograd.record():
-                out = fn(*args, **kwargs)
+                out = fn(*a, **kwargs)
                 first = out[0] if isinstance(out, (tuple, list)) else out
                 loss = (first * first).sum()
             loss.backward()
-            grads_on[0].grad.wait_to_read()
+            sync.grad.wait_to_read()
 
-        for _ in range(warmup):
-            step()
+        base = warmup + runs    # disjoint from the fwd phase's variants
+        for i in range(warmup):
+            step(base + i)
         t0 = time.perf_counter()
-        for _ in range(runs):
-            step()
+        for i in range(runs):
+            step(base + warmup + i)
         bwd_ms = (time.perf_counter() - t0) / runs * 1e3
 
     return {'op': name, 'fwd_ms': round(fwd_ms, 4),

@@ -120,3 +120,45 @@ def test_concurrent_eager_ops():
         t.join()
     for i, r in enumerate(results):
         assert r == 64.0 * 64 * 64 * (i + 1) ** 2
+
+
+def test_multithreaded_hybridized_inference():
+    """Concurrent forward on ONE hybridized model from several threads
+    (reference thread-safe CachedOp, cached_op_threadsafe.cc +
+    example/multi_threaded_inference): results must match the
+    single-threaded answers for each thread's own input."""
+    import threading
+
+    import numpy as onp
+
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation='relu'))
+    net.add(mx.gluon.nn.Dense(4))
+    net.initialize()
+    net.hybridize(static_alloc=True)
+
+    rng = onp.random.default_rng(0)
+    inputs = [mx.np.array(rng.standard_normal((8, 32)).astype('float32'))
+              for _ in range(8)]
+    net(inputs[0]).wait_to_read()                 # compile once up front
+    expected = [net(x).asnumpy() for x in inputs]
+
+    results = [None] * len(inputs)
+    errors = []
+
+    def worker(idx):
+        try:
+            for _ in range(5):                    # hammer the cache
+                results[idx] = net(inputs[idx]).asnumpy()
+        except Exception as e:                    # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(inputs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for got, want in zip(results, expected):
+        onp.testing.assert_allclose(got, want, rtol=1e-6)
