@@ -43,17 +43,27 @@ class Context:
         return self.devstr2type[self.device_type]
 
     def to_jax(self):
-        """Resolve to a concrete ``jax.Device`` (lazily, cached)."""
+        """Resolve to a concrete ``jax.Device`` (lazily, cached).
+
+        Always a process-LOCAL device: under multi-host SPMD
+        (jax.distributed), jax.devices() lists every process's devices
+        and indexing it would hand a remote (non-addressable) device to
+        eager ops — each host's Context must map to its own chips (the
+        reference's per-worker ctx in dist training behaves the same)."""
         if self._jax_device is None:
             import jax
             kind = self.device_type
             if kind in ('cpu', 'cpu_pinned', 'cpu_shared'):
-                devs = jax.devices('cpu') if _has_platform('cpu') else jax.devices()
+                # backend='cpu' queries the CPU client explicitly — the
+                # default-backend list has no CPU devices on TPU hosts
+                devs = jax.local_devices(backend='cpu') \
+                    if _has_platform('cpu') else jax.local_devices()
             else:
                 # tpu (or gpu alias): any non-cpu accelerator backend
-                devs = [d for d in jax.devices() if d.platform != 'cpu']
+                devs = [d for d in jax.local_devices()
+                        if d.platform != 'cpu']
                 if not devs:
-                    devs = jax.devices()
+                    devs = jax.local_devices()
             self._jax_device = devs[self.device_id % len(devs)]
         return self._jax_device
 

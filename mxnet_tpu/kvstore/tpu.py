@@ -84,15 +84,25 @@ class KVStoreTPUSync(KVStoreLocal):
             for t in targets:
                 t._rebind(result)
 
+    def _bcast0(self, raw):
+        """Rank-0's value to every process, as a host-local array.
+        broadcast_one_to_all returns a global-spanning (fully replicated)
+        jax.Array that plain device_get refuses; the local replica is
+        read out via its addressable shard — one broadcast's worth of
+        DCN traffic, not an allgather."""
+        from jax.experimental import multihost_utils
+        arr = multihost_utils.broadcast_one_to_all(raw)
+        if getattr(arr, 'is_fully_addressable', True):
+            return jnp.asarray(arr)
+        return jnp.asarray(arr.addressable_data(0))
+
     def init(self, key, value):
         """Rank-0's value is authoritative (reference KVStoreDist::Init):
         hosts that seeded independently converge here."""
         super().init(key, value)
         if self._nproc > 1:
-            from jax.experimental import multihost_utils
             for k, _ in _group(key, value):
-                self._store[k]._rebind(multihost_utils.broadcast_one_to_all(
-                    self._store[k]._data))
+                self._store[k]._rebind(self._bcast0(self._store[k]._data))
 
     def push(self, key, value, priority=0):
         for k, vals in _group(key, value):
@@ -108,10 +118,8 @@ class KVStoreTPUSync(KVStoreLocal):
     def broadcast(self, key, value, out, priority=0):
         """Rank-0's value wins (reference KVStoreDist::Init semantics)."""
         if self._nproc > 1:
-            from jax.experimental import multihost_utils
             for k, vals in _group(key, value):
-                v = multihost_utils.broadcast_one_to_all(vals[0]._data)
-                self._store[k] = NDArray(v)
+                self._store[k] = NDArray(self._bcast0(vals[0]._data))
         else:
             self.init(key, value)
         self.pull(key, out=out, priority=priority)
