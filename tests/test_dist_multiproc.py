@@ -34,3 +34,24 @@ def test_two_process_dist_sync_kvstore():
     assert res.returncode == 0, out[-4000:]
     assert 'worker 0/2: all dist kvstore assertions passed' in out
     assert 'worker 1/2: all dist kvstore assertions passed' in out
+
+
+@pytest.mark.timeout(240)
+def test_two_process_dist_training_convergence():
+    """End-to-end Trainer over dist_tpu_sync across 2 processes: each
+    rank trains on its own shard, parameters stay bit-identical, and
+    the shared model fits the global data (reference
+    dist_device_sync_kvstore.py + tests/python/train convergence runs)."""
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'tools', 'launch.py'),
+         '-n', '2', '--launcher', 'local', '--port', '49912',
+         sys.executable,
+         os.path.join(ROOT, 'tests', 'nightly',
+                      'dist_device_sync_training.py')],
+        capture_output=True, text=True, timeout=220, env=env, cwd=ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    for r in range(2):
+        assert f'worker {r}/2: dist training converged' in out
