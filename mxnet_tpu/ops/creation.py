@@ -14,7 +14,7 @@ from .registry import get_op, register
 
 # replayable creation ops for symbol execution (named _creation_<jnp name>)
 for _nm in ('zeros', 'ones', 'full', 'arange', 'linspace', 'logspace',
-            'eye', 'tri', 'indices'):
+            'eye', 'tri', 'indices', 'blackman', 'hamming', 'hanning'):
     register(f'_creation_{_nm}', namespaces=(),
              differentiable=False)(getattr(jnp, _nm))
 
@@ -132,3 +132,24 @@ FRONTEND_CREATORS = {
 @register('vander')
 def vander(x, N=None, increasing=False):
     return jnp.vander(x, N=N, increasing=increasing)
+
+
+def _window(fn_name):
+    base = _creator(getattr(jnp, fn_name))   # records under graph capture
+
+    def wrapper(M, dtype='float32', ctx=None, device=None):
+        out = base(M, ctx=ctx, device=device)
+        return out.astype(dtype) if dtype else out
+    wrapper.__name__ = fn_name
+    wrapper.__doc__ = (
+        f'Reference: _npi_{fn_name} (src/operator/numpy/np_window_op.cc) '
+        f'— the {fn_name} window function.')
+    return wrapper
+
+
+blackman = _window('blackman')
+hamming = _window('hamming')
+hanning = _window('hanning')
+
+FRONTEND_CREATORS.update(blackman=blackman, hamming=hamming,
+                         hanning=hanning)

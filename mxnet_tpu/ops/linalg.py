@@ -249,3 +249,26 @@ def linalg_makediag(a, offset=0):
 @register('linalg_sumlogdiag')
 def linalg_sumlogdiag(A):
     return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register('khatri_rao')
+def khatri_rao(*args):
+    """Reference: src/operator/contrib/krprod.cc khatri_rao —
+    column-wise Kronecker product of matrices with equal col count."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum('ik,jk->ijk', out, m).reshape(-1, out.shape[1])
+    return out
+
+
+@register('linalg_potri', aliases=('potri',))
+def linalg_potri(a, lower=True):
+    """Reference: src/operator/tensor/la_op.cc _linalg_potri — inverse of
+    A from its Cholesky factor: (L L^T)^-1 given L."""
+    from jax.scipy.linalg import solve_triangular
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = solve_triangular(a, eye, lower=lower)
+    lt = jnp.swapaxes(linv, -1, -2)
+    return (lt @ linv) if lower else (linv @ lt)

@@ -513,3 +513,131 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, ctx=None, axis=None):
     n = data.shape[axis]
     idx = jnp.arange(n) // repeat
     return start + step * idx.astype(data.dtype)
+
+
+@register('around', aliases=('round_',))
+def around(x, decimals=0):
+    """NumPy-parity alias (reference _npi_around,
+    src/operator/numpy/np_elemwise_unary_op_basic.cc)."""
+    return jnp.round(x, decimals)
+
+
+@register('reshape_like')
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reference: src/operator/tensor/elemwise_unary_op_basic.cc
+    reshape_like — reshape lhs to rhs's shape (optionally only a dim
+    range of each)."""
+    if lhs_begin is None and rhs_begin is None:
+        return lhs.reshape(rhs.shape)
+    ls, le = lhs_begin or 0, lhs_end if lhs_end is not None else lhs.ndim
+    rs, re = rhs_begin or 0, rhs_end if rhs_end is not None else rhs.ndim
+    new_shape = lhs.shape[:ls] + rhs.shape[rs:re] + lhs.shape[le:]
+    return lhs.reshape(new_shape)
+
+
+@register('broadcast_like')
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    """Reference: src/operator/tensor/broadcast_reduce_op_value.cc
+    broadcast_like."""
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    target = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        target[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(target))
+
+
+@register('shape_array', differentiable=False)
+def shape_array(data):
+    """Reference: src/operator/tensor/elemwise_unary_op_basic.cc
+    shape_array. int32 here — the package runs without x64 (the NDArray
+    layer downcasts int64 throughout, ndarray.py)."""
+    return jnp.asarray(data.shape, jnp.int32)
+
+
+@register('size_array', differentiable=False)
+def size_array(data):
+    """Reference: elemwise_unary_op_basic.cc size_array (int32, as
+    shape_array)."""
+    n = 1
+    for d in data.shape:
+        n *= d
+    return jnp.asarray([n], jnp.int32)
+
+
+@register('add_n', aliases=('ElementWiseSum',))
+def add_n(*args):
+    """Reference: src/operator/tensor/elemwise_sum.cc add_n — sum of N
+    arrays in one fused kernel (the gradient-aggregation workhorse)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register('batch_take')
+def batch_take(a, indices):
+    """Reference: src/operator/tensor/indexing_op.cc batch_take —
+    per-row element pick: out[i] = a[i, indices[i]]."""
+    idx = indices.astype(jnp.int32)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register('hsplit', n_out=_split_n_out)
+def hsplit(x, indices_or_sections):
+    """Reference: _npi_hsplit (src/operator/numpy/np_matrix_op.cc)."""
+    return tuple(jnp.hsplit(x, indices_or_sections))
+
+
+@register('dsplit', n_out=_split_n_out)
+def dsplit(x, indices_or_sections):
+    return tuple(jnp.dsplit(x, indices_or_sections))
+
+
+@register('vsplit', n_out=_split_n_out)
+def vsplit(x, indices_or_sections):
+    return tuple(jnp.vsplit(x, indices_or_sections))
+
+
+@register('tril_indices', differentiable=False, n_out=2)
+def tril_indices(n, k=0, m=None):
+    """Reference: _npi_tril_indices (src/operator/numpy/np_matrix_op.cc)."""
+    return tuple(jnp.tril_indices(n, k, m))
+
+
+@register('triu_indices', differentiable=False, n_out=2)
+def triu_indices(n, k=0, m=None):
+    return tuple(jnp.triu_indices(n, k, m))
+
+
+@register('diag_indices_from', differentiable=False)
+def diag_indices_from(arr):
+    """Reference: _npi_diag_indices_from."""
+    return tuple(jnp.diag_indices_from(arr))
+
+
+@register('polyval')
+def polyval(p, x):
+    """Reference: _npi_polyval (src/operator/numpy/np_polynomial_op.cc)."""
+    return jnp.polyval(p, x)
+
+
+@register('index_update', differentiable=False)
+def index_update(data, indices, val):
+    """Reference: _npx_index_update (src/operator/numpy_extension) —
+    functional scatter-set, the TPU-native form of indexed assignment.
+    ``indices``: (K, N) dims-first, same convention as gather_nd /
+    scatter_nd above."""
+    idx = indices.astype(jnp.int32)
+    key = tuple(idx[i] for i in range(idx.shape[0])) \
+        if idx.ndim > 1 else (idx,)
+    return data.at[key].set(val)
+
+
+@register('constraint_check', differentiable=False)
+def constraint_check(data, msg='constraint violated'):
+    """Reference: _npx_constraint_check — all(data) as a bool scalar.
+    (The reference aborts the kernel on failure; here the consumer can
+    branch on the returned flag — aborting inside jit is not a thing.)"""
+    return jnp.all(data)

@@ -767,3 +767,12 @@ def col2im(data, output_size=None, kernel=None, stride=None, dilate=None,
                               dilate and tuple(dilate), pad and tuple(pad)),
         zero)
     return vjp(data)[0]
+
+
+@register('softmin')
+def softmin(data, axis=-1, length=None, temperature=None, use_length=False,
+            dtype=None):
+    """Reference: src/operator/nn/softmax.cc softmin — softmax of -x,
+    sharing softmax's length-masking path (same SoftmaxParam)."""
+    return softmax(-data, axis=axis, length=length, temperature=temperature,
+                   use_length=use_length, dtype=dtype)

@@ -161,3 +161,10 @@ def percentile(x, q, axis=None, keepdims=False, interpolation='linear'):
 def quantile(x, q, axis=None, keepdims=False, interpolation='linear'):
     return jnp.quantile(x, q, axis=axis, keepdims=keepdims,
                         method=interpolation)
+
+
+@register('argmax_channel', differentiable=False)
+def argmax_channel(data):
+    """Reference: src/operator/tensor/broadcast_reduce_op_index.cc
+    argmax_channel — argmax over axis 1, legacy classifier helper."""
+    return jnp.argmax(data, axis=1).astype(data.dtype)
