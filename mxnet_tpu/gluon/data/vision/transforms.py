@@ -23,6 +23,33 @@ class Compose(Sequential):
             self.add(t)
 
 
+#: reference transforms HybridCompose — every transform here is traceable,
+#: so the hybrid variant is the same class
+HybridCompose = Compose
+
+
+class RandomApply(Sequential):
+    """Reference transforms/__init__.py:138 — apply ``transforms`` with
+    probability ``p`` (host-side coin flip, like the reference)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        for t in (transforms if isinstance(transforms, (list, tuple))
+                  else [transforms]):
+            self.add(t)          # registered children: init/cast/save see them
+        self.p = p
+
+    def forward(self, x, *args):
+        import random as _random
+        if self.p >= _random.random():
+            for t in self._children.values():
+                x = t(x)
+        return (x,) + args if args else x
+
+
+HybridRandomApply = RandomApply
+
+
 class Cast(HybridBlock):
     def __init__(self, dtype='float32'):
         super().__init__()

@@ -12,9 +12,9 @@ from ...ops.registry import get_op, invoke
 from ... import _tape
 
 __all__ = ['Sequential', 'HybridSequential', 'Dense', 'Dropout', 'BatchNorm',
-           'SyncBatchNorm', 'LayerNorm', 'GroupNorm', 'InstanceNorm',
-           'Embedding', 'Flatten', 'HybridLambda', 'Lambda', 'Identity',
-           'Concatenate', 'HybridConcatenate', 'RMSNorm']
+           'BatchNormReLU', 'SyncBatchNorm', 'LayerNorm', 'GroupNorm',
+           'InstanceNorm', 'Embedding', 'Flatten', 'HybridLambda', 'Lambda',
+           'Identity', 'Concatenate', 'HybridConcatenate', 'RMSNorm']
 
 
 def _op(name, *args, **kw):
@@ -193,6 +193,16 @@ class BatchNorm(HybridBlock):
                    self.beta.data(), self.running_mean.data(),
                    self.running_var.data(), eps=self._epsilon,
                    axis=self._axis, fix_gamma=not self._scale)
+
+
+class BatchNormReLU(BatchNorm):
+    """Fused BN+ReLU (reference basic_layers.py:449 BatchNormReLU over
+    _contrib_BatchNormWithReLU). On TPU the relu fuses into the BN
+    elementwise epilogue inside the compiled graph — same single kernel
+    the reference's hand-fused op achieves."""
+
+    def forward(self, x):
+        return _op('relu', super().forward(x))
 
 
 class SyncBatchNorm(BatchNorm):

@@ -1,6 +1,10 @@
 """``gluon.rnn`` (reference python/mxnet/gluon/rnn/)."""
 
 from .rnn_cell import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
-                       DropoutCell, ZoneoutCell, ResidualCell,
+                       DropoutCell, ModifierCell, ZoneoutCell, ResidualCell,
                        BidirectionalCell, HybridRecurrentCell, RecurrentCell)
 from .rnn_layer import RNN, LSTM, GRU
+
+# reference rnn_cell.py:755 — hybrid variant is the same class here (every
+# cell is traceable)
+HybridSequentialRNNCell = SequentialRNNCell

@@ -246,21 +246,26 @@ class F1(EvalMetric):
                     ((pred != c) & (label == c)).sum())
             self.num_inst += 1
 
-    def _f1_of(self, c):
-        tp, fp, fn = self._tp.get(c, 0), self._fp.get(c, 0), \
-            self._fn.get(c, 0)
+    #: F-beta weight; F1 is beta=1, Fbeta overrides (reference Fbeta
+    #: subclasses F1 the same way)
+    _beta = 1.0
+
+    @staticmethod
+    def _fbeta_score(tp, fp, fn, beta):
         prec = tp / max(tp + fp, 1)
         rec = tp / max(tp + fn, 1)
-        return 2 * prec * rec / max(prec + rec, 1e-12)
+        b2 = beta * beta
+        return (1 + b2) * prec * rec / max(b2 * prec + rec, 1e-12)
+
+    def _f1_of(self, c):
+        return self._fbeta_score(self._tp.get(c, 0), self._fp.get(c, 0),
+                                 self._fn.get(c, 0), self._beta)
 
     def get(self):
         if self.average == 'micro':
-            tp = sum(self._tp.values())
-            fp = sum(self._fp.values())
-            fn = sum(self._fn.values())
-            prec = tp / max(tp + fp, 1)
-            rec = tp / max(tp + fn, 1)
-            return (self.name, 2 * prec * rec / max(prec + rec, 1e-12))
+            return (self.name, self._fbeta_score(
+                sum(self._tp.values()), sum(self._fp.values()),
+                sum(self._fn.values()), self._beta))
         if self.average == 'macro':
             classes = sorted(self._tp)
             if not classes:
@@ -362,3 +367,124 @@ def np(numpy_feval, name='custom', allow_extra_outputs=False):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+class Fbeta(F1):
+    """Reference metric.py:815 — harmonic precision/recall mean weighted
+    by beta^2."""
+
+    def __init__(self, name='fbeta', beta=1, average='binary', **kw):
+        super().__init__(name=name, average=average, **kw)
+        self.beta = beta
+        self._beta = float(beta)
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Reference metric.py:876 — accuracy of thresholded binary/multilabel
+    predictions."""
+
+    def __init__(self, name='binary_accuracy', threshold=0.5, **kw):
+        super().__init__(name, **kw)
+        self.threshold = threshold
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel()
+            pred = (_to_np(pred).ravel() > self.threshold)
+            self.sum_metric += float((pred == (label > 0.5)).sum())
+            self.num_inst += label.size
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Reference metric.py:1197 — mean p-norm distance over the last axis."""
+
+    def __init__(self, name='mpd', p=2, **kw):
+        super().__init__(name, **kw)
+        self.p = p
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            d = (_np.abs(pred - label) ** self.p).sum(axis=-1) ** \
+                (1.0 / self.p)
+            self.sum_metric += float(d.sum())
+            self.num_inst += int(d.size)
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Reference metric.py:1263 — cosine similarity over the last axis."""
+
+    def __init__(self, name='cos_sim', eps=1e-8, **kw):
+        super().__init__(name, **kw)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            num = (label * pred).sum(axis=-1)
+            den = _np.maximum(
+                _np.linalg.norm(label, axis=-1) *
+                _np.linalg.norm(pred, axis=-1), self.eps)
+            sim = num / den
+            self.sum_metric += float(sim.sum())
+            self.num_inst += int(sim.size)
+
+
+@register
+class PCC(EvalMetric):
+    """Reference metric.py:1586 — multiclass Matthews/Pearson correlation
+    from the running confusion matrix."""
+
+    def __init__(self, name='pcc', **kw):
+        super().__init__(name, **kw)
+        self._cm = _np.zeros((0, 0), dtype=_np.int64)
+
+    def reset(self):
+        super().reset()
+        self._cm = _np.zeros((0, 0), dtype=_np.int64)
+
+    def _grow(self, k):
+        if k > self._cm.shape[0]:
+            cm = _np.zeros((k, k), dtype=_np.int64)
+            n = self._cm.shape[0]
+            cm[:n, :n] = self._cm
+            self._cm = cm
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel().astype('int64')
+            pred = _to_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.ravel().astype('int64')
+            k = int(max(label.max(initial=0), pred.max(initial=0))) + 1
+            self._grow(k)
+            _np.add.at(self._cm, (label, pred), 1)
+            self.num_inst += label.size
+
+    def get(self):
+        c = self._cm.astype(_np.float64)
+        n = c.sum()
+        if n == 0:
+            return (self.name, float('nan'))
+        t = c.sum(axis=1)            # true counts per class
+        p = c.sum(axis=0)            # predicted counts per class
+        cov_tp = (c.trace() * n - (t * p).sum())
+        cov_tt = (n * n - (t * t).sum())
+        cov_pp = (n * n - (p * p).sum())
+        den = _np.sqrt(cov_tt * cov_pp)
+        return (self.name, float(cov_tp / den) if den else float('nan'))
+
+
+@register
+class Torch(Loss):
+    """Reference metric.py:1734 — dummy metric for torch criterions."""
+
+    def __init__(self, name='torch', **kw):
+        super().__init__(name=name, **kw)

@@ -336,3 +336,30 @@ def test_hybridize_structure_dependent_outputs_not_confused():
     with autograd.record():                   # cache-hit train call again
         o3 = net(x)
     assert isinstance(o3, tuple) and len(o3) == 2
+
+
+def test_batchnorm_relu_layer():
+    """Reference basic_layers.py:449 BatchNormReLU
+    (_contrib_BatchNormWithReLU): BN then fused relu."""
+    import numpy as onp
+    net = mx.gluon.nn.BatchNormReLU()
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(0).randn(4, 3, 5, 5).astype('f'))
+    out = net(x).asnumpy()
+    assert (out >= 0).all()
+    bn = mx.gluon.nn.BatchNorm()
+    bn.initialize()
+    ref = onp.maximum(bn(x).asnumpy(), 0)
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_hybrid_sequential_rnn_cell_alias():
+    cell = mx.gluon.rnn.HybridSequentialRNNCell()
+    cell.add(mx.gluon.rnn.LSTMCell(8))
+    cell.add(mx.gluon.rnn.LSTMCell(8))
+    cell.initialize()
+    import numpy as onp
+    x = mx.np.array(onp.ones((2, 4), 'f'))
+    out, states = cell(x, cell.begin_state(batch_size=2))
+    assert out.shape == (2, 8)
+    assert isinstance(cell, mx.gluon.rnn.SequentialRNNCell)

@@ -221,3 +221,71 @@ def test_prefetching_iter_merges_multiple_iters():
     assert len(batch.data) == 2
     assert float(batch.data[0].asnumpy()[0, 0]) == 0.0
     assert float(batch.data[1].asnumpy()[0, 0]) == 1.0
+
+
+def test_fbeta_binary_accuracy():
+    m = mx.metric.Fbeta(beta=2, average='binary')
+    m.update(mx.np.array(np.array([1, 0, 1, 1])),
+             mx.np.array(np.array([[0.2, 0.8], [0.7, 0.3],
+                                   [0.4, 0.6], [0.9, 0.1]], 'f')))
+    name, v = m.get()
+    # tp=2 fp=0 fn=1: prec 1, rec 2/3; fbeta(2) = 5*2/3 / (4+2/3)
+    np.testing.assert_allclose(v, (5 * (2 / 3)) / (4 + 2 / 3), rtol=1e-6)
+
+    ba = mx.metric.BinaryAccuracy(threshold=0.4)
+    ba.update(mx.np.array(np.array([1, 0, 1, 0])),
+              mx.np.array(np.array([0.5, 0.3, 0.2, 0.6], 'f')))
+    assert ba.get()[1] == 0.5
+
+
+def test_distance_similarity_metrics():
+    mpd = mx.metric.MeanPairwiseDistance()
+    mpd.update(mx.np.array(np.zeros((2, 3), 'f')),
+               mx.np.array(np.ones((2, 3), 'f')))
+    np.testing.assert_allclose(mpd.get()[1], np.sqrt(3.0), rtol=1e-6)
+
+    cs = mx.metric.MeanCosineSimilarity()
+    a = np.array([[1.0, 0.0], [0.0, 2.0]], 'f')
+    cs.update(mx.np.array(a), mx.np.array(a))
+    np.testing.assert_allclose(cs.get()[1], 1.0, rtol=1e-6)
+
+
+def test_pcc_matches_mcc_binary():
+    """PCC on binary problems equals MCC (reference docstring claim)."""
+    labels = np.array([0, 1, 1, 0, 1, 0, 1, 1])
+    preds = np.array([0, 1, 0, 0, 1, 1, 1, 1])
+    pcc = mx.metric.PCC()
+    onehot = np.eye(2, dtype='f')[preds]
+    pcc.update(mx.np.array(labels), mx.np.array(onehot))
+    tp = int(((preds == 1) & (labels == 1)).sum())
+    tn = int(((preds == 0) & (labels == 0)).sum())
+    fp = int(((preds == 1) & (labels == 0)).sum())
+    fn = int(((preds == 0) & (labels == 1)).sum())
+    mcc = (tp * tn - fp * fn) / np.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    np.testing.assert_allclose(pcc.get()[1], mcc, rtol=1e-6)
+
+
+def test_random_apply_transform():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    t = T.RandomApply([T.Cast('float32')], p=1.0)
+    out = t(mx.np.array(np.zeros((2, 2), 'int32')))
+    assert str(out.dtype) == 'float32'
+    t0 = T.RandomApply([T.Cast('float32')], p=0.0)
+    out0 = t0(mx.np.array(np.zeros((2, 2), 'int32')))
+    assert str(out0.dtype) == 'int32'
+    assert T.HybridCompose is T.Compose
+
+
+def test_fbeta_micro_respects_beta():
+    """The pooled (micro) branch must weight by beta^2. (For single-label
+    argmax updates pooled fp == fn so fbeta == f1 numerically; check the
+    score function itself with asymmetric counts.)"""
+    s_f1 = mx.metric.F1._fbeta_score(2, 0, 1, beta=1.0)
+    s_fb = mx.metric.F1._fbeta_score(2, 0, 1, beta=2.0)
+    np.testing.assert_allclose(s_f1, 0.8, rtol=1e-6)
+    np.testing.assert_allclose(s_fb, 5 / 7, rtol=1e-6)   # (1+4)*1*(2/3)/(4+2/3)
+    fb = mx.metric.Fbeta(beta=2, average='micro')
+    fb._tp, fb._fp, fb._fn = {1: 2}, {1: 0}, {1: 1}
+    fb.num_inst = 1
+    np.testing.assert_allclose(fb.get()[1], 5 / 7, rtol=1e-6)
