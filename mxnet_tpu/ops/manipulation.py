@@ -641,3 +641,30 @@ def constraint_check(data, msg='constraint violated'):
     (The reference aborts the kernel on failure; here the consumer can
     branch on the returned flag — aborting inside jit is not a thing.)"""
     return jnp.all(data)
+
+
+@register('empty_like')
+def empty_like(prototype, dtype=None, order='C', subok=False, shape=None):
+    """Reference: _npi_zeros_like family (np_init_op.cc) — uninitialized
+    ≙ zeros on XLA (no uninitialized buffers)."""
+    return jnp.zeros(shape or prototype.shape,
+                     dtype=dtype or prototype.dtype)
+
+
+@register('flatnonzero', differentiable=False,
+          dynamic_shape=lambda args, kw: kw.get('size') is None)
+def flatnonzero(a, size=None):
+    """Reference: np.flatnonzero via _npi_nonzero."""
+    return jnp.flatnonzero(a, size=size)
+
+
+@register('row_stack')
+def row_stack(*arrays):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return jnp.vstack(arrays)
+
+
+@register('triu_indices_from', differentiable=False, n_out=2)
+def triu_indices_from(arr, k=0):
+    return tuple(jnp.triu_indices_from(arr, k=k))
