@@ -81,7 +81,7 @@ def stack(*arrays, axis=0):
     return jnp.stack(arrays, axis=axis)
 
 
-@register('vstack')
+@register('vstack', aliases=('row_stack',))
 def vstack(*arrays):
     if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
         arrays = arrays[0]
@@ -647,22 +647,15 @@ def constraint_check(data, msg='constraint violated'):
 def empty_like(prototype, dtype=None, order='C', subok=False, shape=None):
     """Reference: _npi_zeros_like family (np_init_op.cc) — uninitialized
     ≙ zeros on XLA (no uninitialized buffers)."""
-    return jnp.zeros(shape or prototype.shape,
+    return jnp.zeros(prototype.shape if shape is None else shape,
                      dtype=dtype or prototype.dtype)
 
 
 @register('flatnonzero', differentiable=False,
-          dynamic_shape=lambda args, kw: kw.get('size') is None)
+          dynamic_shape=_dyn_unless_size)
 def flatnonzero(a, size=None):
     """Reference: np.flatnonzero via _npi_nonzero."""
     return jnp.flatnonzero(a, size=size)
-
-
-@register('row_stack')
-def row_stack(*arrays):
-    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
-        arrays = arrays[0]
-    return jnp.vstack(arrays)
 
 
 @register('triu_indices_from', differentiable=False, n_out=2)

@@ -35,11 +35,14 @@ def _on_tpu():
 
 # ------------------------------------------------------------------ kernel
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale,
-                      causal, q_offset):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref=None, l_ref=None, *,
+                      block_k, sm_scale, causal, q_offset):
     """One (batch·head, q-block) program: stream key blocks, online softmax.
 
     q_ref: (1, block_q, d); k_ref/v_ref: (1, S, d); o_ref: (1, block_q, d).
+    With m_ref/l_ref supplied, o is left UNNORMALIZED and the running
+    row max/denominator are written out — the ring-attention form where
+    blocks from other devices still need merging.
     """
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
     block_q, d = q.shape
@@ -82,15 +85,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale,
     else:
         m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
 
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if m_ref is None:
+        o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    else:
+        o_ref[0] = acc.astype(o_ref.dtype)
+        m_ref[0] = m[:, 0]
+        l_ref[0] = l[:, 0]
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    """q: (BH, T, d), k/v: (BH, S, d) → (BH, T, d).
+def _flash_call(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                q_offset, return_stats):
+    """Shared pallas_call scaffolding for both kernel variants.
 
-    Block sizes must divide T/S exactly (flash_attention() guarantees this
-    via _choose_block). Causal masking aligns bottom-right when T < S,
-    matching the XLA fallback's ``tril(k=S-T)``.
+    q: (BH, T, d), k/v: (BH, S, d). Block sizes must divide T/S exactly
+    (callers guarantee via _choose_block). ``return_stats`` selects the
+    3-output form: unnormalized acc + row max + row denominator.
     """
     bh, t, d = q.shape
     s = k.shape[1]
@@ -99,19 +108,94 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     grid = (bh, t // block_q)
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, sm_scale=sm_scale,
-        causal=causal, q_offset=s - t)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
+        causal=causal, q_offset=q_offset)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+    ]
+    if return_stats:
+        out_specs = [
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-        interpret=interpret,
-    )(q, k, v)
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ]
+    else:
+        out_specs = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+        out_shape = jax.ShapeDtypeStruct((bh, t, d), q.dtype)
+    return pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret)(q, k, v)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    """Normalized single-device form; bottom-right causal when T < S."""
+    return _flash_call(q, k, v, sm_scale, causal, block_q, block_k,
+                       interpret, q_offset=k.shape[1] - q.shape[1],
+                       return_stats=False)
+
+
+def _stats_xla(q, k, v, sm_scale, causal):
+    """Pure-XLA twin of the stats kernel — the differentiation path
+    (recompute backward, mirroring _flash3_bwd's choice) and the
+    off-TPU fallback. Diagonal-block causal: q_pos >= k_pos."""
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    s = jnp.einsum('bqd,bkd->bqk', qf, kf) * sm_scale
+    if causal:
+        t, src = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t, src), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum('bqk,bkd->bqd', p, vf)
+    return acc, m, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_stats(q, k, v, sm_scale, causal=False, interpret=False):
+    """Blockwise attention that returns (acc, m, l): UNNORMALIZED output
+    plus the online-softmax row statistics, so the caller can merge
+    results across devices (ring attention over the sp axis,
+    parallel/ring_attention.py). q: (BH, T, d); k/v: (BH, S, d).
+    Causal here is the DIAGONAL-block form: positions align 1:1 (T == S,
+    same shard), mask is q_pos >= k_pos.
+
+    Differentiable: backward recomputes through the pure-XLA twin
+    (_stats_xla), the same recompute-over-store trade as _flash3."""
+    if _on_tpu() and not interpret:
+        bq = _choose_block(q.shape[1], 128)
+        bk = _choose_block(k.shape[1], 128)
+        if bq >= 32 and bk >= 32:
+            return tuple(_flash_call(q, k, v, sm_scale, causal, bq, bk,
+                                     False, q_offset=0, return_stats=True))
+        return _stats_xla(q, k, v, sm_scale, causal)
+    if interpret:
+        bq = _choose_block(q.shape[1], 128)
+        bk = _choose_block(k.shape[1], 128)
+        return tuple(_flash_call(q, k, v, sm_scale, causal, bq, bk,
+                                 True, q_offset=0, return_stats=True))
+    return _stats_xla(q, k, v, sm_scale, causal)
+
+
+def _stats_fwd(q, k, v, sm_scale, causal, interpret):
+    return flash_attention_stats(q, k, v, sm_scale, causal, interpret), \
+        (q, k, v)
+
+
+def _stats_bwd(sm_scale, causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _stats_xla(q_, k_, v_, sm_scale,
+                                                   causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention_stats.defvjp(_stats_fwd, _stats_bwd)
 
 
 def _reference_attention(q, k, v, sm_scale, causal):

@@ -42,27 +42,77 @@ def _online_block(q, k, v, m_prev, l_prev, o_prev, scale, mask=None):
     return m_new, l_new, o_new
 
 
-def ring_attention_kernel(q, k, v, axis_name='sp', causal=False):
+def _merge_stats(m, l, o, acc_b, m_b, l_b):
+    """Fold one block's (unnormalized out, max, denom) into the running
+    accumulator — the cross-device half of the online softmax."""
+    m_new = jnp.maximum(m, m_b)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    a = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    b = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - m_safe), 0.0)
+    l_new = a * l + b * l_b
+    o_new = a[..., None] * o + b[..., None] * acc_b
+    return m_new, l_new, o_new
+
+
+def ring_attention_kernel(q, k, v, axis_name='sp', causal=False,
+                          use_flash=None):
     """Per-shard ring attention body — call inside shard_map over 'sp'.
 
     q, k, v: (B, H, S_local, D) — this device's sequence shard.
+
+    ``use_flash`` (default: on TPU) computes each local block with the
+    Pallas flash kernel returning online-softmax stats
+    (flash_attention_stats), so the (S_local, S_local) score matrix
+    never hits HBM; the XLA blockwise path remains for CPU/virtual-mesh
+    testing where interpret-mode Pallas would dominate test time.
     """
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
     B, H, Sl, D = q.shape
+    if use_flash is None:
+        from ..ops.pallas.flash_attention import _on_tpu
+        use_flash = _on_tpu() and D % 128 == 0 and Sl % 128 == 0
 
     m = jnp.full((B, H, Sl), -jnp.inf, dtype=jnp.float32)
     l = jnp.zeros((B, H, Sl), dtype=jnp.float32)
     o = jnp.zeros((B, H, Sl, D), dtype=jnp.float32)
     qf = q.astype(jnp.float32)
 
+    def _flash_block(mlo, kb, vb, diag):
+        from ..ops.pallas.flash_attention import (_on_tpu,
+                                                 flash_attention_stats)
+        m_, l_, o_ = mlo
+        acc, mb, lb = flash_attention_stats(
+            qf.reshape(B * H, Sl, D), kb.reshape(B * H, Sl, D),
+            vb.reshape(B * H, Sl, D), scale, causal=diag,
+            interpret=not _on_tpu())
+        return _merge_stats(m_, l_, o_,
+                            acc.reshape(B, H, Sl, D),
+                            mb.reshape(B, H, Sl), lb.reshape(B, H, Sl))
+
     def body(i, carry):
         m, l, o, k_blk, v_blk = carry
         src_idx = (my_idx - i) % axis_size  # whose K/V we now hold
         kf = k_blk.astype(jnp.float32)
         vf = v_blk.astype(jnp.float32)
-        if causal:
+        if use_flash and causal:
+            def full_block(mlo):
+                return _flash_block(mlo, kf, vf, False)
+
+            def diag_block(mlo):
+                return _flash_block(mlo, kf, vf, True)
+
+            def skip_block(mlo):
+                return mlo
+
+            case = jnp.where(src_idx > my_idx, 2,
+                             jnp.where(src_idx == my_idx, 1, 0))
+            m, l, o = lax.switch(case, [full_block, diag_block, skip_block],
+                                 (m, l, o))
+        elif use_flash:
+            m, l, o = _flash_block((m, l, o), kf, vf, False)
+        elif causal:
             # block-level causality: src > mine → fully masked (SKIP the
             # matmuls — half the ring steps); src == mine → diagonal mask;
             # src < mine → fully visible, no mask needed
@@ -94,7 +144,8 @@ def ring_attention_kernel(q, k, v, axis_name='sp', causal=False):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis_name='sp', causal=False, spec=None):
+def ring_attention(q, k, v, mesh, axis_name='sp', causal=False, spec=None,
+                   use_flash=None):
     """Sharded full attention: q/k/v (B, H, S, D) with S sharded over
     ``axis_name``. Returns output with identical sharding.
 
@@ -123,6 +174,6 @@ def ring_attention(q, k, v, mesh, axis_name='sp', causal=False, spec=None):
                 f'{axis_name!r} and leave head_dim unsharded, got {spec}')
     fn = _shard_map()(
         functools.partial(ring_attention_kernel, axis_name=axis_name,
-                          causal=causal),
+                          causal=causal, use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

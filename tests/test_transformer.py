@@ -302,3 +302,55 @@ def test_sliding_window_attention_matches_dense_band():
     mn = m.asnumpy()
     assert mn[0].astype(bool).sum() == band[0, 0].sum() * 2  # both heads
     assert not mn[1, 0, 6:, :].any()          # beyond valid_length 5
+
+
+def test_flash_stats_merge_equals_single_shot():
+    """flash_attention_stats blocks merged with _merge_stats must equal
+    full softmax attention — the ring-attention correctness core."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.flash_attention import (
+        flash_attention_stats, _reference_attention)
+    from mxnet_tpu.parallel.ring_attention import _merge_stats
+
+    rng = onp.random.default_rng(0)
+    bh, t, d = 2, 8, 4
+    q = jnp.asarray(rng.standard_normal((bh, t, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((bh, 2 * t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((bh, 2 * t, d)), jnp.float32)
+    scale = d ** -0.5
+
+    # two key blocks computed independently, then merged
+    acc1, m1, l1 = flash_attention_stats(q, k[:, :t], v[:, :t], scale,
+                                         interpret=True)
+    acc2, m2, l2 = flash_attention_stats(q, k[:, t:], v[:, t:], scale,
+                                         interpret=True)
+    m0 = jnp.full((bh, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bh, t), jnp.float32)
+    o0 = jnp.zeros((bh, t, d), jnp.float32)
+    m, l, o = _merge_stats(m0, l0, o0, acc1, m1, l1)
+    m, l, o = _merge_stats(m, l, o, acc2, m2, l2)
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    ref = _reference_attention(q, k, v, scale, causal=False)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_stats_causal_diagonal():
+    """Diagonal-block causal stats (q_pos >= k_pos, same shard) match the
+    masked reference."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.flash_attention import (
+        flash_attention_stats, _reference_attention)
+
+    rng = onp.random.default_rng(1)
+    bh, t, d = 2, 8, 4
+    q = jnp.asarray(rng.standard_normal((bh, t, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((bh, t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((bh, t, d)), jnp.float32)
+    scale = d ** -0.5
+    acc, m, l = flash_attention_stats(q, k, v, scale, causal=True,
+                                      interpret=True)
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    ref = _reference_attention(q, k, v, scale, causal=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
