@@ -10,3 +10,5 @@ from .vision import get_model
 from .bert import BERTModel, bert_12_768_12, bert_24_1024_16, get_bert_model
 from .llama import (LlamaConfig, LlamaForCausalLM, llama_tiny, llama2_7b,
                     llama3_8b, get_llama, llama_partition_rules)
+from .yolo import Darknet53, YOLOv3, darknet53, yolo3_darknet53
+from .transformer import TransformerMT, transformer_base_mt

@@ -1,0 +1,143 @@
+"""YOLOv3 + Transformer-MT model-zoo additions (BASELINE.json configs
+"GluonCV: YOLOv3" and "GluonNLP: Transformer-base MT")."""
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo import (TransformerMT, yolo3_darknet53,
+                                       darknet53)
+
+
+def test_darknet53_stages():
+    net = darknet53()
+    net.initialize()
+    x = mx.np.array(onp.zeros((1, 3, 256, 256), 'f'))
+    c3, c4, c5 = net(x)
+    assert c3.shape == (1, 256, 32, 32)     # stride 8
+    assert c4.shape == (1, 512, 16, 16)     # stride 16
+    assert c5.shape == (1, 1024, 8, 8)      # stride 32
+
+
+def test_yolo3_inference_and_training_modes():
+    net = yolo3_darknet53(classes=20, nms_topk=50)
+    net.initialize()
+    rng = onp.random.default_rng(0)
+    x = mx.np.array(rng.standard_normal((2, 3, 256, 256)).astype('f'))
+
+    ids, scores, boxes = net(x)
+    n = (256 // 32) ** 2 * 3 + (256 // 16) ** 2 * 3 + (256 // 8) ** 2 * 3
+    assert ids.shape == (2, n)
+    assert scores.shape == (2, n)
+    assert boxes.shape == (2, n, 4)
+    s = scores.asnumpy()
+    live = s[s >= 0]
+    assert ((live >= 0) & (live <= 1)).all()
+    b = boxes.asnumpy()
+    assert (b[..., 2] >= b[..., 0])[s >= 0].all()   # x2 >= x1 on live boxes
+
+    with autograd.record():
+        preds = net(x)
+        loss = sum((p * p).mean() for p in preds)
+    loss.backward()
+    assert len(preds) == 3
+    assert preds[0].shape == (2, 75, 8, 8)
+    g = net.backbone.first[0].weight.grad()
+    assert onp.isfinite(g.asnumpy()).all() and (g.asnumpy() != 0).any()
+
+
+def test_transformer_mt_copy_task_learns():
+    """Tiny copy task: loss must drop steeply in a few steps."""
+    onp.random.seed(0)
+    net = TransformerMT(src_vocab=20, tgt_vocab=20, units=32,
+                        hidden_size=64, num_layers=1, num_heads=4,
+                        dropout=0.0, max_length=16)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 1e-2})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for step in range(60):
+        seq = onp.random.randint(4, 20, (8, 6)).astype('f')
+        src = mx.np.array(seq)
+        tgt_in = mx.np.array(
+            onp.concatenate([onp.full((8, 1), 2.0, 'f'), seq[:, :-1]], 1))
+        tgt_out = mx.np.array(seq)
+        with autograd.record():
+            logits = net(src, tgt_in)
+            loss = loss_fn(logits, tgt_out).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_transformer_mt_valid_length_mask():
+    """Padding positions beyond valid_length must not affect the output."""
+    net = TransformerMT(src_vocab=50, tgt_vocab=50, units=32,
+                        hidden_size=64, num_layers=2, num_heads=4,
+                        dropout=0.0, max_length=16)
+    net.initialize()
+    rng = onp.random.default_rng(1)
+    base = rng.integers(4, 50, (1, 4))
+    pad_a = onp.concatenate([base, onp.full((1, 3), 7)], 1).astype('f')
+    pad_b = onp.concatenate([base, onp.full((1, 3), 13)], 1).astype('f')
+    tgt = mx.np.array(rng.integers(4, 50, (1, 5)).astype('f'))
+    vl = mx.np.array(onp.array([4], 'f'))
+    out_a = net(mx.np.array(pad_a), tgt, valid_length=vl).asnumpy()
+    out_b = net(mx.np.array(pad_b), tgt, valid_length=vl).asnumpy()
+    onp.testing.assert_allclose(out_a, out_b, rtol=1e-5, atol=1e-5)
+
+
+def test_transformer_mt_translate():
+    net = TransformerMT(src_vocab=30, tgt_vocab=30, units=32,
+                        hidden_size=64, num_layers=1, num_heads=4,
+                        dropout=0.0, max_length=16)
+    net.initialize()
+    src = mx.np.array(onp.random.default_rng(2).integers(
+        4, 30, (2, 5)).astype('f'))
+    out = net.translate(src, max_new_tokens=4, bos_id=2)
+    assert out.shape == (2, 5)
+    assert (out.asnumpy()[:, 0] == 2).all()
+
+
+def test_yolo3_rectangular_input():
+    """Non-square inputs decode consistently (anchors in pixel units,
+    no canvas rescale)."""
+    net = yolo3_darknet53(classes=5, nms_topk=20)
+    net.initialize()
+    x = mx.np.array(onp.zeros((1, 3, 256, 512), 'f'))
+    ids, scores, boxes = net(x)
+    n = sum((256 // s) * (512 // s) * 3 for s in (32, 16, 8))
+    assert boxes.shape == (1, n, 4)
+
+
+def test_transformer_translate_eos_stops():
+    net = TransformerMT(src_vocab=10, tgt_vocab=10, units=16,
+                        hidden_size=32, num_layers=1, num_heads=2,
+                        dropout=0.0, max_length=16)
+    net.initialize()
+    src = mx.np.array(onp.ones((2, 3), 'f'))
+    out = net.translate(src, max_new_tokens=8, bos_id=2, eos_id=3)
+    o = out.asnumpy()
+    # after the first eos in a row, everything must be eos
+    for row in o:
+        seen = False
+        for t in row[1:]:
+            if seen:
+                assert t == 3
+            seen = seen or t == 3
+
+
+def test_decoder_without_src_tokens():
+    """decode(tgt, mem, valid_length=...) works from encoder output
+    alone — mem carries the source shape."""
+    net = TransformerMT(src_vocab=10, tgt_vocab=10, units=16,
+                        hidden_size=32, num_layers=1, num_heads=2,
+                        dropout=0.0, max_length=16)
+    net.initialize()
+    src = mx.np.array(onp.ones((1, 4), 'f'))
+    mem = net.encode(src, valid_length=mx.np.array(onp.array([3], 'f')))
+    out = net.decode(mx.np.array(onp.ones((1, 2), 'f')), mem,
+                     valid_length=mx.np.array(onp.array([3], 'f')))
+    assert out.shape == (1, 2, 10)
