@@ -12,3 +12,4 @@ from .llama import (LlamaConfig, LlamaForCausalLM, llama_tiny, llama2_7b,
                     llama3_8b, get_llama, llama_partition_rules)
 from .yolo import Darknet53, YOLOv3, darknet53, yolo3_darknet53
 from .transformer import TransformerMT, transformer_base_mt
+from .rcnn import FasterRCNN, faster_rcnn_resnet50_v1

@@ -1,0 +1,142 @@
+"""Faster R-CNN with a ResNet-50 C4 backbone.
+
+Reference: the BASELINE.json "GluonCV: Faster-RCNN" config over the
+reference repo's detection operators — RPN proposals
+(src/operator/contrib/proposal.cc) and ROIAlign
+(src/operator/contrib/roi_align.cc). TPU re-design: the proposal op is
+already static-shape (fixed post-NMS count), ROI pooling is a batched
+bilinear gather, and the per-ROI head is a dense stack — so the whole
+inference path is one compiled graph of fixed shapes; no dynamic box
+counts anywhere (the reference pads/copies on the fly instead).
+"""
+
+import numpy as _np
+
+from .. import nn
+from ..block import HybridBlock
+from .vision import resnet50_v1
+from .yolo import _op, nms_detection_output
+
+__all__ = ['FasterRCNN', 'faster_rcnn_resnet50_v1']
+
+
+# bbox regression normalization (GluonCV/Detectron convention)
+_BOX_STDS = (0.1, 0.1, 0.2, 0.2)
+
+
+class RPN(HybridBlock):
+    """Region proposal network head: 3x3 conv + 1x1 objectness/regression."""
+
+    def __init__(self, channels=512, num_anchors=9, **kwargs):
+        super().__init__(**kwargs)
+        self._num_anchors = num_anchors
+        self.conv = nn.Conv2D(channels, kernel_size=3, padding=1,
+                              activation='relu')
+        self.cls = nn.Conv2D(2 * num_anchors, kernel_size=1)
+        self.reg = nn.Conv2D(4 * num_anchors, kernel_size=1)
+
+    def forward(self, feat):
+        from ... import npx
+        h = self.conv(feat)
+        raw_cls = self.cls(h)                     # (N, 2A, H, W)
+        reg = self.reg(h)                         # (N, 4A, H, W)
+        N, _, H, W = raw_cls.shape
+        A = self._num_anchors
+        prob = npx.softmax(
+            raw_cls.reshape(N, 2, A, H, W), axis=1).reshape(N, 2 * A, H, W)
+        return raw_cls, prob, reg
+
+
+class FasterRCNN(HybridBlock):
+    """Two-stage detector: RPN proposals → ROIAlign → 2-FC head.
+
+    Inference returns ``(ids, scores, boxes)`` of fixed shape
+    (B, post_nms * classes kept via per-class NMS topk). Training mode
+    (autograd recording) returns the raw stage outputs for the loss:
+    ``(rpn_cls_raw, rpn_reg, cls_scores, bbox_deltas, rois)``.
+    """
+
+    def __init__(self, classes=20, rpn_channels=512, post_nms=128,
+                 scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                 nms_thresh=0.5, nms_topk=100, roi_size=7, **kwargs):
+        super().__init__(**kwargs)
+        self._classes = classes
+        self._post_nms = post_nms
+        self._scales = scales
+        self._ratios = ratios
+        self._nms_thresh = nms_thresh
+        self._nms_topk = nms_topk
+        self._roi_size = roi_size
+        base = resnet50_v1()
+        self.features = nn.HybridSequential()
+        for layer in list(base.features._children.values())[:7]:
+            self.features.add(layer)              # C4: stride 16, 1024ch
+        self.rpn = RPN(rpn_channels, len(scales) * len(ratios))
+        self.head = nn.HybridSequential()
+        self.head.add(nn.Dense(1024, flatten=True, activation='relu'))
+        self.head.add(nn.Dense(1024, activation='relu'))
+        self.cls_pred = nn.Dense(classes + 1)     # + background
+        self.box_pred = nn.Dense(4 * classes)
+
+    def _decode_boxes(self, rois, deltas, im_h, im_w):
+        """Apply per-class deltas to ROI boxes and clip to image bounds
+        (corner in → corner out; GluonCV BBoxClipToImage parity)."""
+        from ... import np as mnp
+        x1, y1, x2, y2 = (rois[:, 1], rois[:, 2], rois[:, 3], rois[:, 4])
+        w = mnp.maximum(x2 - x1, 1.0)
+        h = mnp.maximum(y2 - y1, 1.0)
+        cx = x1 + 0.5 * w
+        cy = y1 + 0.5 * h
+        d = deltas.reshape(deltas.shape[0], self._classes, 4)
+        dx = d[:, :, 0] * _BOX_STDS[0]
+        dy = d[:, :, 1] * _BOX_STDS[1]
+        dw = mnp.clip(d[:, :, 2] * _BOX_STDS[2], -10.0, 4.0)
+        dh = mnp.clip(d[:, :, 3] * _BOX_STDS[3], -10.0, 4.0)
+        ncx = cx[:, None] + dx * w[:, None]
+        ncy = cy[:, None] + dy * h[:, None]
+        nw = w[:, None] * _op('exp', dw)
+        nh = h[:, None] * _op('exp', dh)
+        bx1 = mnp.clip(ncx - nw / 2, 0.0, im_w - 1.0)
+        by1 = mnp.clip(ncy - nh / 2, 0.0, im_h - 1.0)
+        bx2 = mnp.clip(ncx + nw / 2, 0.0, im_w - 1.0)
+        by2 = mnp.clip(ncy + nh / 2, 0.0, im_h - 1.0)
+        return mnp.stack([bx1, by1, bx2, by2], axis=-1)  # (R, classes, 4)
+
+    def forward(self, x):
+        from ... import _tape, npx
+        from ... import np as mnp
+        B, _, H, W = x.shape
+        feat = self.features(x)
+        rpn_raw, rpn_prob, rpn_reg = self.rpn(feat)
+        im_info = mnp.array(
+            _np.tile(_np.asarray([[H, W, 1.0]], 'float32'), (B, 1)))
+        rois = _op('proposal', rpn_prob, rpn_reg, im_info,
+                   rpn_post_nms_top_n=self._post_nms,
+                   scales=self._scales, ratios=self._ratios,
+                   feature_stride=16)             # (B, R, 5)
+        flat_rois = rois.reshape(-1, 5)
+        pooled = _op('roi_align', feat, flat_rois,
+                     (self._roi_size, self._roi_size), 1.0 / 16)
+        h = self.head(pooled)
+        cls_scores = self.cls_pred(h)             # (B*R, C+1)
+        deltas = self.box_pred(h)                 # (B*R, 4C)
+
+        if _tape.is_recording():
+            return rpn_raw, rpn_reg, cls_scores, deltas, rois
+
+        probs = npx.softmax(cls_scores, axis=-1)[:, 1:]   # drop background
+        boxes = self._decode_boxes(flat_rois, deltas, H, W)  # (B*R, C, 4)
+        R = self._post_nms
+        C = self._classes
+        cls_ids = mnp.broadcast_to(
+            mnp.arange(C).reshape(1, C), (B * R, C)).astype(x.dtype)
+        dets = _op('concatenate',
+                   [mnp.expand_dims(cls_ids, -1),
+                    mnp.expand_dims(probs, -1), boxes], axis=-1)
+        dets = dets.reshape(B, R * C, 6)
+        return nms_detection_output(dets, self._nms_thresh, self._nms_topk)
+
+
+def faster_rcnn_resnet50_v1(classes=20, **kwargs):
+    """GluonCV-parity constructor name."""
+    return FasterRCNN(classes=classes, **kwargs)

@@ -24,6 +24,16 @@ def _op(name, *args, **kw):
     return invoke(get_op(name), args, kw)
 
 
+def nms_detection_output(dets, nms_thresh, nms_topk):
+    """Shared detector tail: (B, N, [id, score, x1, y1, x2, y2]) →
+    per-class NMS → ``(ids, scores, boxes)``. Used by YOLOv3 and
+    Faster R-CNN."""
+    dets = _op('box_nms', dets, overlap_thresh=nms_thresh,
+               valid_thresh=0.01, topk=nms_topk,
+               coord_start=2, score_index=1, id_index=0)
+    return (dets[:, :, 0], dets[:, :, 1], dets[:, :, 2:6])
+
+
 def _conv_bn_leaky(channels, kernel, stride=1, padding=0):
     """Darknet conv unit: conv → BN → LeakyReLU(0.1)."""
     cell = nn.HybridSequential()
@@ -196,10 +206,7 @@ class YOLOv3(HybridBlock):
         ids = mnp.expand_dims(scores.argmax(axis=-1), -1).astype(x.dtype)
         best = mnp.max(scores, axis=-1, keepdims=True)
         dets = _op('concatenate', [ids, best, boxes], axis=-1)
-        dets = _op('box_nms', dets, overlap_thresh=self._nms_thresh,
-                   valid_thresh=0.01, topk=self._nms_topk,
-                   coord_start=2, score_index=1, id_index=0)
-        return (dets[:, :, 0], dets[:, :, 1], dets[:, :, 2:6])
+        return nms_detection_output(dets, self._nms_thresh, self._nms_topk)
 
 
 def darknet53(**kwargs):

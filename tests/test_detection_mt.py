@@ -141,3 +141,49 @@ def test_decoder_without_src_tokens():
     out = net.decode(mx.np.array(onp.ones((1, 2), 'f')), mem,
                      valid_length=mx.np.array(onp.array([3], 'f')))
     assert out.shape == (1, 2, 10)
+
+
+def test_faster_rcnn_inference_and_training():
+    """BASELINE.json "GluonCV: Faster-RCNN" config — two-stage detector
+    over the framework's proposal/roi_align ops, static shapes
+    throughout."""
+    from mxnet_tpu.gluon.model_zoo import faster_rcnn_resnet50_v1
+    net = faster_rcnn_resnet50_v1(classes=5, post_nms=16, nms_topk=10)
+    net.initialize()
+    rng = onp.random.default_rng(0)
+    x = mx.np.array(rng.standard_normal((1, 3, 224, 224)).astype('f'))
+
+    ids, scores, boxes = net(x)
+    assert ids.shape == (1, 16 * 5)
+    assert boxes.shape == (1, 16 * 5, 4)
+    s = scores.asnumpy()
+    live = s[s >= 0]
+    assert ((live >= 0) & (live <= 1)).all()
+
+    with autograd.record():
+        rpn_raw, rpn_reg, cls_scores, deltas, rois = net(x)
+        loss = (cls_scores * cls_scores).mean() + (deltas * deltas).mean()
+    loss.backward()
+    assert cls_scores.shape == (16, 6)
+    assert deltas.shape == (16, 20)
+    assert rois.shape == (1, 16, 5)
+    # RPN weights get no grad from this head-only loss (proposal is
+    # non-differentiable by design, reference MakeZeroGradNodes)
+    g = net.rpn.conv.weight.grad()
+    assert (g.asnumpy() == 0).all()
+    gh = net.cls_pred.weight.grad()
+    assert onp.isfinite(gh.asnumpy()).all() and (gh.asnumpy() != 0).any()
+
+
+def test_faster_rcnn_boxes_clipped():
+    from mxnet_tpu.gluon.model_zoo import faster_rcnn_resnet50_v1
+    net = faster_rcnn_resnet50_v1(classes=3, post_nms=8, nms_topk=8)
+    net.initialize()
+    x = mx.np.array(onp.random.default_rng(1).standard_normal(
+        (1, 3, 224, 224)).astype('f') * 5)
+    _, scores, boxes = net(x)
+    b = boxes.asnumpy()
+    live = scores.asnumpy() >= 0
+    assert (b[live] >= 0).all()
+    assert (b[live][:, [0, 2]] <= 223).all()
+    assert (b[live][:, [1, 3]] <= 223).all()
