@@ -201,6 +201,48 @@ def bench_kvstore(args):
     }
 
 
+def bench_yolo(args, mx):
+    """YOLOv3 end-to-end detection throughput (decode + NMS inside the
+    compiled graph). vs_baseline anchors to GluonCV's published V100
+    yolo3_darknet53_coco ~67 img/s inference rate."""
+    from mxnet_tpu.gluon.model_zoo import yolo3_darknet53
+
+    dtype = 'bfloat16' if args.dtype == 'bf16' else 'float32'
+    net = yolo3_darknet53(classes=80)
+    net.initialize()
+    net(mx.np.ones((1, 3, 416, 416)))
+    if dtype != 'float32':
+        net.cast(dtype)
+    net.hybridize(static_alloc=True)
+
+    batch = min(args.batch, 8)
+    x = mx.np.ones((batch, 3, 416, 416), dtype=dtype)
+    eps = mx.np.full((1,), 2.0 ** -6, dtype=dtype)
+
+    def batch_i(i):
+        return x + eps * float(i + 1)
+
+    outs = net(batch_i(0))          # compile (also covers --warmup 0)
+    for i in range(args.warmup):
+        outs = net(batch_i(i + 1))
+    outs[1].wait_to_read()
+    t0 = time.perf_counter()
+    results = []
+    for i in range(args.iters):
+        # offset past every warmup index so no timed input repeats one
+        results.append(net(batch_i(args.warmup + 1 + i)))
+    for r in results:
+        r[1].wait_to_read()
+    dt = time.perf_counter() - t0
+    ips = batch * args.iters / dt
+    return {
+        'metric': f'yolo3_darknet53_inference_{args.dtype}_batch{batch}',
+        'value': round(ips, 2),
+        'unit': 'img/s',
+        'vs_baseline': round(ips / 67.0, 3),
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='resnet50_v1')
@@ -224,6 +266,8 @@ def main():
         result = bench_kvstore(args)
     elif args.model in ('llama_decode', 'llama'):
         result = bench_llama_decode(args, mx)
+    elif args.model in ('yolo3', 'yolo'):
+        result = bench_yolo(args, mx)
     else:
         result = bench_resnet(args, mx)
     print(json.dumps(result))

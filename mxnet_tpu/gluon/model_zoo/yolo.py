@@ -24,10 +24,24 @@ def _op(name, *args, **kw):
     return invoke(get_op(name), args, kw)
 
 
-def nms_detection_output(dets, nms_thresh, nms_topk):
+def nms_detection_output(dets, nms_thresh, nms_topk, pre_nms=400):
     """Shared detector tail: (B, N, [id, score, x1, y1, x2, y2]) →
     per-class NMS → ``(ids, scores, boxes)``. Used by YOLOv3 and
-    Faster R-CNN."""
+    Faster R-CNN.
+
+    The suppression step is quadratic in candidate count (box_nms builds
+    an IoU matrix), so the N raw candidates are first cut to the
+    ``pre_nms`` best by score — one lax.top_k — keeping the whole tail
+    static-shape and HBM-sized (10k+ raw anchors would need a ~60 GB
+    matrix otherwise)."""
+    from ... import np as mnp
+    n = dets.shape[1]
+    if pre_nms and n > pre_nms:
+        scores = dets[:, :, 1]
+        idx = _op('topk', scores, axis=1, k=pre_nms, ret_typ='indices',
+                  is_ascend=False, dtype='int32')
+        dets = _op('take_along_axis', dets,
+                   mnp.expand_dims(idx, -1).astype('int32'), 1)
     dets = _op('box_nms', dets, overlap_thresh=nms_thresh,
                valid_thresh=0.01, topk=nms_topk,
                coord_start=2, score_index=1, id_index=0)
