@@ -1,0 +1,63 @@
+"""Object detection with the YOLOv3 / Faster R-CNN zoo models.
+
+Run:
+    python examples/object_detection.py --cpu           # YOLOv3
+    python examples/object_detection.py --cpu --model faster_rcnn
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='yolo3',
+                        choices=['yolo3', 'faster_rcnn'])
+    parser.add_argument('--size', type=int, default=256)
+    parser.add_argument('--classes', type=int, default=20)
+    parser.add_argument('--cpu', action='store_true')
+    args = parser.parse_args()
+
+    if args.cpu:
+        import os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import _cpu_guard
+        _cpu_guard.force_cpu()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import (faster_rcnn_resnet50_v1,
+                                           yolo3_darknet53)
+
+    if args.model == 'yolo3':
+        net = yolo3_darknet53(classes=args.classes, nms_topk=50)
+    else:
+        net = faster_rcnn_resnet50_v1(classes=args.classes, post_nms=64,
+                                      nms_topk=50)
+    net.initialize()
+
+    rng = np.random.default_rng(0)
+    x = mx.np.array(rng.standard_normal(
+        (1, 3, args.size, args.size)).astype('float32'))
+
+    t0 = time.perf_counter()
+    ids, scores, boxes = net(x)
+    s = scores.asnumpy()[0]
+    dt = time.perf_counter() - t0
+    live = (s >= 0.01)
+    print(f'{args.model}: {int(live.sum())} detections above 0.01 '
+          f'in {dt:.2f}s (random weights — scores are noise)',
+          file=sys.stderr)
+    top = np.argsort(-s)[:5]
+    for i in top:
+        b = boxes.asnumpy()[0, i]
+        print(f'  class={int(ids.asnumpy()[0, i])} score={s[i]:.3f} '
+              f'box=({b[0]:.0f},{b[1]:.0f},{b[2]:.0f},{b[3]:.0f})')
+    print('done')
+
+
+if __name__ == '__main__':
+    main()

@@ -179,13 +179,16 @@ class YOLOv3(HybridBlock):
                        ).reshape(1, 1, 1, n_a, 1).astype(pred.dtype)
         ah = mnp.array(_np.asarray([a[1] for a in anchors], 'float32')
                        ).reshape(1, 1, 1, n_a, 1).astype(pred.dtype)
-        bw = _op('exp', wh[..., 0:1]) * aw
-        bh = _op('exp', wh[..., 1:2]) * ah
+        # clamp the log-size before exp: keeps garbage weights (or early
+        # training) from emitting inf-sized boxes into NMS
+        bw = _op('exp', _op('clip', wh[..., 0:1], -10.0, 8.0)) * aw
+        bh = _op('exp', _op('clip', wh[..., 1:2], -10.0, 8.0)) * ah
 
-        x1 = cx - bw / 2
-        y1 = cy - bh / 2
-        x2 = cx + bw / 2
-        y2 = cy + bh / 2
+        im_h, im_w = H * stride, W * stride
+        x1 = _op('clip', cx - bw / 2, 0.0, im_w - 1.0)
+        y1 = _op('clip', cy - bh / 2, 0.0, im_h - 1.0)
+        x2 = _op('clip', cx + bw / 2, 0.0, im_w - 1.0)
+        y2 = _op('clip', cy + bh / 2, 0.0, im_h - 1.0)
         out = _op('concatenate', [obj, cls, x1, y1, x2, y2], axis=-1)
         return out.reshape(B, H * W * n_a, 1 + self._classes + 4)
 
