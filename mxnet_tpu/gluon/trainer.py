@@ -103,6 +103,14 @@ class Trainer:
             self._update_on_kvstore = bool(config['update_on_kvstore']) \
                 if config['update_on_kvstore'] is not None else False
             if self._update_on_kvstore:
+                if any(p._grad_stype == 'row_sparse' for p in self._params):
+                    import warnings
+                    warnings.warn(
+                        'update_on_kvstore=True densifies row_sparse '
+                        'gradients: lazy row-wise update semantics '
+                        '(no wd/momentum on untouched rows) are lost. '
+                        'Use update_on_kvstore=False to keep the sparse '
+                        'path.', UserWarning, stacklevel=3)
                 self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = True
 
@@ -160,22 +168,39 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self):
-        """Reference trainer.py:385 — per-param pushpull, priority −i."""
+        """Reference trainer.py:385 — pushpull with priority −i.
+
+        All dense params go through ONE ``fused_pushpull`` call: the
+        kvstore coalesces them into fusion buffers and issues a handful
+        of async collectives in priority order (the comm/compute overlap
+        the reference's per-key priority machinery bought), instead of
+        hundreds of per-key dispatches."""
         if self._kvstore is None:
             return
+        entries = []
         for i, param in enumerate(self._params):
             if param.grad_req != 'null':
                 grads = param.list_grad()
-                if not grads:
-                    continue
-                if self._update_on_kvstore:
-                    # server-side update: fresh weights land in the param
-                    # arrays directly (reference trainer.py:385 out=data)
-                    self._kvstore.pushpull(i, grads,
-                                           out=param.list_data(),
-                                           priority=-i)
-                else:
-                    self._kvstore.pushpull(i, grads, priority=-i)
+                if grads:
+                    entries.append((i, param, grads))
+        if not entries:
+            return
+        if hasattr(self._kvstore, 'fused_pushpull'):
+            self._kvstore.fused_pushpull(
+                [i for i, _, _ in entries],
+                [g for _, _, g in entries],
+                outs=[p.list_data() for _, p, _ in entries]
+                if self._update_on_kvstore else None,
+                priorities=[-i for i, _, _ in entries])
+            return
+        for i, param, grads in entries:
+            if self._update_on_kvstore:
+                # server-side update: fresh weights land in the param
+                # arrays directly (reference trainer.py:385 out=data)
+                self._kvstore.pushpull(i, grads, out=param.list_data(),
+                                       priority=-i)
+            else:
+                self._kvstore.pushpull(i, grads, priority=-i)
 
     def _update(self, ignore_stale_grad=False):
         """Reference trainer.py:444 — run optimizer per device replica.

@@ -109,6 +109,47 @@ class KVStoreLocal(KVStoreBase):
         self.init(key, value)
         self.pull(key, out=out, priority=priority)
 
+    # ---------------------------------------------------------- fused path
+    def fused_pushpull(self, keys, values, outs=None, priorities=None):
+        """Multi-key pushpull in as few device programs as possible.
+
+        ``values[i]`` is the replica list for ``keys[i]``. All keys'
+        replica reductions run in ONE jitted executable (the role the
+        reference's per-key ``CommDevice::Reduce`` + engine bulking
+        played); the distributed subclass adds bucketed cross-process
+        collectives on top. ``priorities`` is accepted for API parity;
+        ordering only matters in the distributed subclass, where it
+        sequences bucket dispatch (reference Trainer's ``priority=-i``).
+        """
+        vals_lists = [v if isinstance(v, (list, tuple)) else [v]
+                      for v in values]
+        merged = self._merge_local(keys, vals_lists)
+        self._apply_merged(keys, merged, vals_lists, outs)
+
+    def _merge_local(self, keys, vals_lists):
+        from . import fusion
+        raws = [[v._data for v in vs] for vs in vals_lists]
+        if any(len(r) > 1 for r in raws):
+            return fusion._fused_replica_sum(raws)
+        return [r[0] for r in raws]
+
+    def _apply_merged(self, keys, merged, vals_lists, outs):
+        for i, k in enumerate(keys):
+            if self._updater is not None:
+                if k not in self._store:
+                    raise ValueError(
+                        f'pushpull with an updater requires key {k!r} to '
+                        'be initialized first (init/broadcast)')
+                self._updater(k, NDArray(merged[i]), self._store[k])
+                result = self._store[k]._data
+            else:
+                result = merged[i]
+            targets = outs[i] if outs is not None else vals_lists[i]
+            if not isinstance(targets, (list, tuple)):
+                targets = [targets]
+            for t in targets:
+                t._rebind(result)
+
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (reference kvstore.py
         row_sparse_pull → PullRowSparse, include/mxnet/kvstore.h:221).

@@ -74,6 +74,52 @@ def main():
                                 onp.full((2,), 10.0 - 0.5 * size),
                                 rtol=1e-6)
 
+    # --- fused (bucketed) pushpull: many keys, one collective per fusion
+    # buffer (reference PushPullDefault + P3 slicing, here XLA psum)
+    fkeys = list(range(20, 27))
+    fvals = [mx.np.array(onp.full((5, 3), (rank + 1.0) * (k - 19), 'f'))
+             for k in fkeys]
+    fouts = [mx.np.zeros((5, 3)) for _ in fkeys]
+    kv.set_gradient_compression({'type': 'none'})
+    kv.fused_pushpull(fkeys, fvals, outs=[[o] for o in fouts],
+                      priorities=[-i for i in range(len(fkeys))])
+    for k, o in zip(fkeys, fouts):
+        want = sum((r + 1.0) * (k - 19) for r in range(size))
+        onp.testing.assert_allclose(o.asnumpy(), onp.full((5, 3), want),
+                                    rtol=1e-6)
+
+    # --- fused + 2-bit compression: words cross the wire, decode+sum on
+    # device; each worker contributes +-threshold after quantization
+    kvc = kvstore.create('dist_tpu_sync')
+    kvc.set_gradient_compression({'type': '2bit', 'threshold': 0.5})
+    cg = [mx.np.array(onp.array([0.6, -0.7, 0.1, 0.0], 'f')),
+          mx.np.array(onp.array([[0.9, -0.1], [0.0, 0.55]], 'f'))]
+    couts = [mx.np.zeros((4,)), mx.np.zeros((2, 2))]
+    kvc.fused_pushpull([70, 71], cg, outs=couts)
+    onp.testing.assert_allclose(
+        couts[0].asnumpy(), [0.5 * size, -0.5 * size, 0.0, 0.0], atol=1e-6)
+    onp.testing.assert_allclose(
+        couts[1].asnumpy(),
+        [[0.5 * size, 0.0], [0.0, 0.5 * size]], atol=1e-6)
+
+    # --- ZeRO-1 sharded optimizer-on-store: updater runs once per key
+    # globally (on its owner), weights all_gather back; every rank must
+    # see identical post-update weights
+    kvz = kvstore.create('dist_tpu_sync')
+    kvz.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    zkeys = [0, 1, 2]
+    for k in zkeys:
+        kvz.init(k, mx.np.array(onp.full((3,), 10.0 * (k + 1), 'f')))
+    zgrads = [mx.np.array(onp.full((3,), 1.0 * (k + 1), 'f'))
+              for k in zkeys]
+    zouts = [mx.np.zeros((3,)) for _ in zkeys]
+    kvz.fused_pushpull(zkeys, zgrads, outs=zouts)
+    for k, o in zip(zkeys, zouts):
+        # merged grad = size*(k+1); w <- 10(k+1) - 0.5*size*(k+1)
+        want = 10.0 * (k + 1) - 0.5 * size * (k + 1)
+        onp.testing.assert_allclose(o.asnumpy(), onp.full((3,), want),
+                                    rtol=1e-6)
+
     # --- row_sparse_pull across processes: store holds the full (dense)
     # table, each rank pulls its own row ids (reference PullRowSparse)
     kv.init('emb', mx.np.array(

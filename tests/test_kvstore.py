@@ -221,3 +221,78 @@ def test_gradient_compression_dequantize_sum():
     fused = gc.dequantize_sum(stacked, (5,))
     ref = gc.dequantize(w1, (5,)) + gc.dequantize(w2, (5,))
     np.testing.assert_allclose(np.asarray(fused), np.asarray(ref))
+
+
+def test_fused_pushpull_local_replicas():
+    """Fused path: all keys' replica sums in one executable, outs rebound."""
+    kv = kvstore.create('device')
+    keys = [0, 1, 2]
+    vals = [[mx.np.ones((3, 2)) * (k + 1) for _ in range(4)] for k in keys]
+    outs = [mx.np.zeros((3, 2)) for _ in keys]
+    kv.fused_pushpull(keys, vals, outs=[[o] for o in outs],
+                      priorities=[0, -1, -2])
+    for k, o in zip(keys, outs):
+        np.testing.assert_allclose(o.asnumpy(), np.full((3, 2), 4.0 * (k + 1)))
+
+
+def test_fused_pushpull_rebinds_values_without_out():
+    kv = kvstore.create('local')
+    vals = [[mx.np.ones((4,)), mx.np.ones((4,)) * 3]]
+    kv.fused_pushpull([9], vals)
+    for v in vals[0]:
+        np.testing.assert_allclose(v.asnumpy(), np.full((4,), 4.0))
+
+
+def test_fused_pushpull_with_updater():
+    kv = kvstore.create('device')
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.init(0, mx.np.ones((2,)) * 10)
+    kv.init(1, mx.np.ones((3,)) * 20)
+    outs = [mx.np.zeros((2,)), mx.np.zeros((3,))]
+    kv.fused_pushpull([0, 1], [mx.np.ones((2,)), mx.np.ones((3,)) * 2],
+                      outs=outs)
+    np.testing.assert_allclose(outs[0].asnumpy(), np.full((2,), 9.5))
+    np.testing.assert_allclose(outs[1].asnumpy(), np.full((3,), 19.0))
+
+
+def test_fused_pushpull_updater_requires_init():
+    kv = kvstore.create('local')
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    import pytest
+    with pytest.raises(ValueError):
+        kv.fused_pushpull([99], [mx.np.ones((2,))])
+
+
+def test_fused_pushpull_dist_single_process():
+    """dist_tpu_sync with one process: bucketed path degenerates to local."""
+    kv = kvstore.create('dist_tpu_sync')
+    keys = list(range(5))
+    vals = [mx.np.ones((7,)) * (k + 1) for k in keys]
+    outs = [mx.np.zeros((7,)) for _ in keys]
+    kv.fused_pushpull(keys, vals, outs=outs,
+                      priorities=[-k for k in keys])
+    for k, o in zip(keys, outs):
+        np.testing.assert_allclose(o.asnumpy(), np.full((7,), float(k + 1)))
+
+
+def test_fused_pushpull_dist_compressed_single_process():
+    """2-bit compression through the fused path keeps per-key error
+    feedback semantics (same result as per-key pushpull)."""
+    kv = kvstore.create('dist_tpu_sync')
+    kv.set_gradient_compression({'type': '2bit', 'threshold': 0.5})
+    g = mx.np.array(np.array([0.6, -0.7, 0.1, 0.0], 'f'))
+    out = mx.np.zeros((4,))
+    kv.fused_pushpull([7], [g], outs=[out])
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0],
+                               atol=1e-6)
+
+
+def test_fusion_bucketing_units():
+    from mxnet_tpu.kvstore import fusion
+    assert fusion.make_buckets([10, 10, 10], 25) == [[0, 1], [2]]
+    assert fusion.make_buckets([30, 10], 25) == [[0], [1]]
+    assert fusion.make_buckets([], 25) == []
+    owners = fusion.assign_owners([100, 1, 1, 1], 2)
+    assert owners[0] == 0 and set(owners[1:]) == {1}
+    # deterministic
+    assert owners == fusion.assign_owners([100, 1, 1, 1], 2)

@@ -24,6 +24,7 @@ import numpy as np  # noqa: E402
 
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import kvstore  # noqa: E402
+from mxnet_tpu.ndarray.ndarray import NDArray as _ND  # noqa: E402
 
 
 def parse_args(argv=None):
@@ -39,6 +40,20 @@ def parse_args(argv=None):
     parser.add_argument('--num-keys', type=int, default=50,
                         help='key count when --network uniform')
     parser.add_argument('--disp-batches', type=int, default=1)
+    parser.add_argument('--per-key', action='store_true',
+                        help='issue one pushpull per key (round-1 path) '
+                             'instead of one fused_pushpull call')
+    parser.add_argument('--replicas', type=int, default=0,
+                        help='device-replica copies per key to reduce; '
+                             '0 = one per local device (min 2, so the '
+                             'measurement always moves real bytes)')
+    parser.add_argument('--device-only', action='store_true',
+                        help='measure the pure device-side reduce as one '
+                             'on-device loop (no per-iter host dispatch): '
+                             'the roofline-relative number. Through the '
+                             'axon tunnel, per-call/per-buffer RPC costs '
+                             '~ms and dominates the end-to-end modes; on '
+                             'directly-attached TPUs they converge.')
     return parser.parse_args(argv)
 
 
@@ -53,6 +68,51 @@ def grad_shapes(args):
     return [p.data().shape for p in net.collect_params().values()]
 
 
+def device_only_bench(args, total_bytes, n_rep):
+    """K chained replica-reduce rounds inside ONE executable
+    (lax.fori_loop): measures what the fused reduce costs on device with
+    host dispatch out of the picture. Each round's replicas are rolls of
+    the evolving buffer — real memory traffic XLA cannot simplify away,
+    and values change every round so the tunnel content cache never hits."""
+    import time
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = total_bytes // 4
+    k_inner = 25
+
+    def round_(i, buf):
+        fi = (i + 1).astype(jnp.float32)
+        reps = [jnp.roll(buf, 4096 * (r + 1)) * (1.0 + 1e-6 * fi * (r + 1))
+                for r in range(n_rep)]
+        s = reps[0]
+        for r in reps[1:]:
+            s = s + r
+        return s / n_rep  # keep magnitudes bounded
+
+    fn = jax.jit(lambda b: lax.fori_loop(0, k_inner, round_, b))
+    buf = jnp.ones((S,), jnp.float32) * 0.5
+    float(fn(buf)[::8192].sum())  # compile + warm
+    t0 = time.perf_counter()
+    out = fn(buf)
+    s = float(out[::8192].sum())
+    dt = time.perf_counter() - t0
+    per_round = dt / k_inner
+    moved = total_bytes * (n_rep + 1)
+    import json
+    print(f'{k_inner} on-device rounds: {dt * 1e3:.1f} ms total, '
+          f'{per_round * 1e3:.2f} ms/round (checksum {s:.3f})',
+          file=sys.stderr)
+    print(json.dumps({'metric': 'kvstore_reduce_device_bandwidth',
+                      'value': round(moved / per_round / 1e9, 3),
+                      'unit': 'GB/s',
+                      'mean_ms': round(per_round * 1e3, 3),
+                      'total_mb': round(total_bytes / 1e6, 1),
+                      'replicas': n_rep}))
+    return 0
+
+
 def main(argv=None):
     args = parse_args(argv)
     shapes = grad_shapes(args)
@@ -62,45 +122,82 @@ def main(argv=None):
     print(f'{len(shapes)} keys, {total_bytes / 1e6:.1f} MB total, '
           f'{n_dev} devices, kvstore={args.kv_store}', file=sys.stderr)
 
+    # replica copies per key: the reduce across them is the real work the
+    # kvstore does on a host (CommDevice::Reduce); with a single device
+    # and one replica a pushpull is just a handle rebind, which would
+    # measure nothing but Python dispatch
+    n_rep = args.replicas or max(n_dev, 2)
+
+    if args.device_only:
+        return device_only_bench(args, total_bytes, n_rep)
+
     kv = kvstore.create(args.kv_store)
     rng = np.random.RandomState(0)
-    grads = [mx.np.array(rng.uniform(-1, 1, s).astype('float32'))
-             for s in shapes]
+    grads = [[mx.np.array(rng.uniform(-1, 1, s).astype('float32'))
+              for _ in range(n_rep)] for s in shapes]
     for i, g in enumerate(grads):
-        kv.init(i, g)
+        kv.init(i, g[0])
+    fused = hasattr(kv, 'fused_pushpull') and not args.per_key
+    print(f'{n_rep} replicas/key, path={"fused" if fused else "per-key"}',
+          file=sys.stderr)
 
-    times = []
-    for it in range(args.warmup + args.num_batches):
-        outs = [mx.np.zeros(g.shape) for g in grads]
-        # value-distinct gradients every iteration: the dev tunnel
-        # content-caches identical executions, which would turn repeat
-        # pushpulls of the same values into cache hits
-        grads = [g * 1.0001 for g in grads]
-        for g in grads:
-            g.wait_to_read()
-        for o in outs:
-            o.wait_to_read()
-        t0 = time.perf_counter()
-        for i, g in enumerate(grads):
-            kv.pushpull(i, g, out=outs[i], priority=-i)
-        for o in outs:
-            o.wait_to_read()
-        dt = time.perf_counter() - t0
-        if it >= args.warmup:
-            times.append(dt)
-            if (it - args.warmup) % args.disp_batches == 0:
-                print(f'iter {it - args.warmup}: {dt * 1e3:.2f} ms',
-                      file=sys.stderr)
+    keys = list(range(len(grads)))
+    prios = [-i for i in keys]
 
-    mean_t = sum(times) / len(times)
-    # standard allreduce cost model: each byte crosses the link 2(n-1)/n times
-    algbw = 2 * total_bytes * (n_dev - 1) / max(n_dev, 1) / mean_t if n_dev > 1 \
-        else total_bytes / mean_t
+    import jax
+    # all replica perturbations in ONE dispatch (per-op dispatch costs
+    # ~ms through the tunnel and would swamp the measurement), scaled
+    # back by the fan-in so chained values stay finite — overflow to inf
+    # would make every later iteration bitwise-identical and
+    # content-cacheable by the tunnel
+    n_total = n_rep * max(kv.num_workers, 1)
+    perturb = jax.jit(lambda raws: [
+        [r * ((1.0 + 1e-4 * (k + 1)) / n_total) for k in range(n_rep)]
+        for r in raws])
+
+    def run_iters(n, outs):
+        """n chained pushpull rounds. Each round's gradients derive from
+        the previous round's outputs: values stay distinct (the dev
+        tunnel content-caches identical executions) AND the whole chain
+        is one dependency graph, so ONE readback at the end times real
+        device work — per-round host syncs would measure only the
+        tunnel's ~80 ms RPC latency (block_until_ready through the
+        tunnel returns before device-only work actually runs)."""
+        for _ in range(n):
+            cur = [[_ND(g) for g in gs]
+                   for gs in perturb([o._data for o in outs])]
+            if fused:
+                kv.fused_pushpull(keys, cur, outs=[[o] for o in outs],
+                                  priorities=prios)
+            else:
+                for i, gs in enumerate(cur):
+                    kv.pushpull(i, gs, out=outs[i], priority=-i)
+        # dependent readback forces the chain to completion
+        acc = sum(o._data.reshape(-1)[::8192].sum() for o in outs)
+        return float(acc)
+
+    outs = [mx.np.ones(s) * 1e-3 for s in shapes]
+    run_iters(args.warmup, outs)                      # compile + warm
+    t0 = time.perf_counter()
+    run_iters(args.num_batches, outs)
+    dt = time.perf_counter() - t0
+    mean_t = dt / args.num_batches
+    print(f'{args.num_batches} chained iters: {dt * 1e3:.1f} ms total, '
+          f'{mean_t * 1e3:.2f} ms/iter', file=sys.stderr)
+    # bytes actually moved per iteration: the replica reduce reads
+    # n_rep x S and writes S; the cross-device allreduce costs the
+    # standard 2(n-1)/n on top
+    moved = total_bytes * (n_rep + 1)
+    if n_dev > 1:
+        moved += 2 * total_bytes * (n_dev - 1) / n_dev
+    algbw = moved / mean_t
     import json
     print(json.dumps({'metric': 'kvstore_pushpull_bandwidth',
                       'value': round(algbw / 1e9, 3), 'unit': 'GB/s',
                       'mean_ms': round(mean_t * 1e3, 3),
-                      'total_mb': round(total_bytes / 1e6, 1)}))
+                      'total_mb': round(total_bytes / 1e6, 1),
+                      'replicas': n_rep,
+                      'fused': fused}))
     return 0
 
 
