@@ -16,6 +16,7 @@ from . import utils
 from . import rnn
 from . import model_zoo
 from . import contrib
+from . import probability
 from .. import metric  # gluon.metric is the reference's home for metrics
 
 ParameterDict = dict

@@ -1,0 +1,16 @@
+"""``mx.gluon.probability`` — distributions, transformations, KL
+registry, and stochastic blocks.
+
+Reference: ``python/mxnet/gluon/probability/__init__.py`` (5.5 kLoC
+package: 25+ distributions, biject_to/transform_to domain maps,
+StochasticBlock). TPU-native re-design: every density/statistic is pure
+``mx.np`` math over jax (differentiable through the tape, traceable
+under hybridize/jit), sampling draws from the Context-scoped PRNG, and
+the gamma family gets pathwise gradients through an
+implicit-reparameterized sampler op instead of the reference's
+score-function fallback.
+"""
+
+from .distributions import *
+from .transformation import *
+from .block import *

@@ -1,0 +1,4 @@
+"""Stochastic blocks (reference
+``python/mxnet/gluon/probability/block/__init__.py``)."""
+
+from .stochastic_block import *

@@ -1,0 +1,96 @@
+"""StochasticBlock — HybridBlock with in-forward loss accumulation.
+
+Reference: ``python/mxnet/gluon/probability/block/stochastic_block.py``
+(StochasticBlock.collectLoss decorator + add_loss + .losses;
+StochasticSequential). Used for Bayesian layers where the objective is
+task loss + accumulated KL terms. Works under hybridize: the decorated
+forward returns ``(out, losses)``, so the captured jit graph carries the
+loss tensors as extra outputs — the same trick the reference plays with
+CachedOp multi-outputs.
+"""
+
+from functools import wraps
+
+from ...block import HybridBlock
+
+__all__ = ['StochasticBlock', 'StochasticSequential']
+
+
+class StochasticBlock(HybridBlock):
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+        self._flag = False  # whether collectLoss ran this call
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    @staticmethod
+    def collectLoss(func):
+        """Decorate ``forward`` so losses added via ``add_loss`` during
+        the call are returned alongside the output."""
+
+        @wraps(func)
+        def inner(self, *args, **kwargs):
+            func_out = func(self, *args, **kwargs)
+            collected_loss = self._losscache
+            self._losscache = []
+            self._flag = True
+            return (func_out, collected_loss)
+
+        return inner
+
+    def __call__(self, *args, **kwargs):
+        self._flag = False
+        out = super().__call__(*args, **kwargs)
+        if not self._flag:
+            raise ValueError('The forward function should be decorated by '
+                             'StochasticBlock.collectLoss')
+        self._losses = out[1]
+        return out[0]
+
+    @property
+    def losses(self):
+        return self._losses
+
+
+class StochasticSequential(StochasticBlock):
+    """Stack StochasticBlocks sequentially (reference
+    StochasticSequential)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            self._layers.append(block)
+            self.register_child(block)
+
+    @StochasticBlock.collectLoss
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = []
+            if isinstance(x, (tuple, list)):
+                args = x[1:]
+                x = x[0]
+        if args:
+            x = tuple([x] + list(args))
+        for block in self._layers:
+            if hasattr(block, '_losses'):
+                self.add_loss(block._losses)
+        return x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)()
+            net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)

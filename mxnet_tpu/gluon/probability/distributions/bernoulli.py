@@ -1,0 +1,89 @@
+"""Bernoulli distribution (reference
+``python/mxnet/gluon/probability/distributions/bernoulli.py`` — dual
+prob/logit parameterization with lazy conversion)."""
+
+from .... import numpy as np
+from .... import numpy_extension as npx
+from .exp_family import ExponentialFamily
+from .constraint import Boolean, UnitInterval, Real
+from .utils import (as_array, cached_property, prob2logit, logit2prob,
+                    sample_n_shape_converter)
+
+__all__ = ['Bernoulli']
+
+
+class Bernoulli(ExponentialFamily):
+    has_enumerate_support = True
+    support = Boolean()
+    arg_constraints = {'prob': UnitInterval(), 'logit': Real()}
+
+    def __init__(self, prob=None, logit=None, F=None, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                'Either `prob` or `logit` must be specified, but not both.')
+        if prob is not None:
+            self.prob = as_array(prob)
+        else:
+            self.logit = as_array(logit)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, True)
+
+    def _batch_shape(self):
+        p = self.__dict__.get('prob')
+        return (p if p is not None else self.logit).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        logit = self.logit
+        # x*logit - softplus(logit), stable in both tails
+        return value * logit - npx.softplus(logit)
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        return np.random.bernoulli(self.prob, shape)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        if 'prob' in self.__dict__:
+            new.prob = np.broadcast_to(self.prob, batch_shape)
+            new.__dict__.pop('logit', None)
+        else:
+            new.logit = np.broadcast_to(self.logit, batch_shape)
+            new.__dict__.pop('prob', None)
+        return new
+
+    def enumerate_support(self):
+        batch = self._batch_shape()
+        return np.stack([np.zeros(batch), np.ones(batch)])
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return self.prob * (1 - self.prob)
+
+    def entropy(self):
+        return (npx.softplus(self.logit)
+                - self.prob * self.logit)
+
+    @property
+    def _natural_params(self):
+        return (self.logit,)
+
+    def _log_normalizer(self, x):
+        return npx.softplus(x)

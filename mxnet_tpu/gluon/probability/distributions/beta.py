@@ -1,0 +1,67 @@
+"""Beta distribution (reference
+``python/mxnet/gluon/probability/distributions/beta.py``). Sampled as a
+ratio of reparameterized gammas, so pathwise gradients flow to both
+concentrations."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Positive, UnitInterval
+from .utils import (as_array, sample_n_shape_converter, gammaln, digamma,
+                    rgamma)
+
+__all__ = ['Beta']
+
+
+def _betaln(a, b):
+    return gammaln(a) + gammaln(b) - gammaln(a + b)
+
+
+class Beta(Distribution):
+    has_grad = True
+    support = UnitInterval()
+    arg_constraints = {'alpha': Positive(), 'beta': Positive()}
+
+    def __init__(self, alpha, beta, F=None, validate_args=None):
+        self.alpha = as_array(alpha)
+        self.beta = as_array(beta)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return (self.alpha + self.beta).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        a, b = self.alpha, self.beta
+        return ((a - 1) * np.log(value) + (b - 1) * np.log1p(-value)
+                - _betaln(a, b))
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        ga = rgamma(np.broadcast_to(self.alpha * np.ones_like(self.beta),
+                                    shape), shape)
+        gb = rgamma(np.broadcast_to(self.beta * np.ones_like(self.alpha),
+                                    shape), shape)
+        return ga / (ga + gb)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'alpha', 'beta')
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        a, b = self.alpha, self.beta
+        return a * b / ((a + b) ** 2 * (a + b + 1))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return (_betaln(a, b) - (a - 1) * digamma(a)
+                - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b))

@@ -1,0 +1,85 @@
+"""Binomial distribution (reference
+``python/mxnet/gluon/probability/distributions/binomial.py`` — `n` must
+be a non-negative integer scalar)."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import UnitInterval, Real, IntegerInterval
+from .utils import (as_array, cached_property, prob2logit, logit2prob,
+                    sample_n_shape_converter, gammaln)
+
+__all__ = ['Binomial']
+
+
+class Binomial(Distribution):
+    arg_constraints = {'prob': UnitInterval(), 'logit': Real()}
+
+    def __init__(self, n=1, prob=None, logit=None, F=None,
+                 validate_args=None):
+        if (n < 0) or (n % 1 != 0):
+            raise ValueError(
+                'Expect `n` to be non-negative integer, received n={}'
+                .format(n))
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                'Either `prob` or `logit` must be specified, but not both.')
+        self.n = int(n)
+        if prob is not None:
+            self.prob = as_array(prob)
+        else:
+            self.logit = as_array(logit)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    @property
+    def support(self):
+        return IntegerInterval(0, self.n)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, True)
+
+    def _batch_shape(self):
+        p = self.__dict__.get('prob')
+        return (p if p is not None else self.logit).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        coef = (gammaln(np.array(self.n + 1.0)) - gammaln(1 + value)
+                - gammaln(self.n - value + 1))
+        return (coef + value * np.log(self.prob)
+                + (self.n - value) * np.log1p(-self.prob))
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        # sum of n Bernoulli draws in one fused program (n is static)
+        p = np.broadcast_to(self.prob, shape)
+        trials = np.random.uniform(0.0, 1.0, (self.n,) + tuple(shape))
+        return (trials < p).astype('float32').sum(0)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        if 'prob' in self.__dict__:
+            new.prob = np.broadcast_to(self.prob, batch_shape)
+            new.__dict__.pop('logit', None)
+        else:
+            new.logit = np.broadcast_to(self.logit, batch_shape)
+            new.__dict__.pop('prob', None)
+        return new
+
+    @property
+    def mean(self):
+        return self.n * self.prob
+
+    @property
+    def variance(self):
+        return self.n * self.prob * (1 - self.prob)

@@ -1,0 +1,105 @@
+"""Categorical distribution (reference
+``python/mxnet/gluon/probability/distributions/categorical.py`` —
+samples are indices in [0, num_events), float dtype)."""
+
+from .... import numpy as np
+from .... import numpy_extension as npx
+from .distribution import Distribution
+from .constraint import Simplex, Real, IntegerInterval
+from .utils import (as_array, cached_property, prob2logit, logit2prob,
+                    sample_n_shape_converter, sum_right_most)
+
+__all__ = ['Categorical']
+
+
+class Categorical(Distribution):
+    has_enumerate_support = True
+    arg_constraints = {'prob': Simplex(), 'logit': Real()}
+
+    def __init__(self, num_events, prob=None, logit=None, F=None,
+                 validate_args=None):
+        num_events = int(num_events)
+        if num_events < 1:
+            raise ValueError('`num_events` should be greater than zero.')
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                'Either `prob` or `logit` must be specified, but not both.')
+        self.num_events = num_events
+        if prob is not None:
+            self.prob = as_array(prob)
+        else:
+            self.logit = as_array(logit)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    @property
+    def support(self):
+        return IntegerInterval(0, self.num_events - 1)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, False)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, False)
+
+    def _params(self):
+        p = self.__dict__.get('prob')
+        return p if p is not None else self.logit
+
+    def _batch_shape(self):
+        return self._params().shape[:-1]
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        logp = npx.log_softmax(self.logit, axis=-1)
+        idx = npx.one_hot(value.astype('int32'), self.num_events)
+        return sum_right_most(logp * idx, 1)
+
+    def sample(self, size=None):
+        logits = npx.log_softmax(self.logit, axis=-1)
+        if size is None:
+            return np.random.categorical(logits).astype('float32')
+        size = (size,) if isinstance(size, int) else tuple(size)
+        batch = self._batch_shape()
+        n = len(batch)
+        prefix = size[:len(size) - n] if n else size
+        # broadcast batch params then draw one index per position
+        tgt = prefix + batch + (self.num_events,)
+        logits = np.broadcast_to(logits, tgt)
+        return np.random.categorical(logits).astype('float32')
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        full = tuple(batch_shape) + (self.num_events,)
+        if 'prob' in self.__dict__:
+            new.prob = np.broadcast_to(self.prob, full)
+            new.__dict__.pop('logit', None)
+        else:
+            new.logit = np.broadcast_to(self.logit, full)
+            new.__dict__.pop('prob', None)
+        return new
+
+    def enumerate_support(self):
+        batch = self._batch_shape()
+        values = np.arange(self.num_events, dtype='float32')
+        return values.reshape((self.num_events,) + (1,) * len(batch)) * \
+            np.ones((self.num_events,) + batch)
+
+    @property
+    def mean(self):
+        raise NotImplementedError  # undefined for categorical indices
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        logp = npx.log_softmax(self.logit, axis=-1)
+        return -sum_right_most(np.exp(logp) * logp, 1)

@@ -1,0 +1,62 @@
+"""Cauchy distribution (reference
+``python/mxnet/gluon/probability/distributions/cauchy.py``)."""
+
+import math
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Real, Positive
+from .utils import as_array, sample_n_shape_converter
+
+__all__ = ['Cauchy']
+
+
+class Cauchy(Distribution):
+    has_grad = True
+    support = Real()
+    arg_constraints = {'loc': Real(), 'scale': Positive()}
+
+    def __init__(self, loc=0.0, scale=1.0, F=None, validate_args=None):
+        self.loc = as_array(loc)
+        self.scale = as_array(scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return (self.loc + self.scale).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        z = (value - self.loc) / self.scale
+        return (-math.log(math.pi) - np.log(self.scale)
+                - np.log1p(z ** 2))
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        # inverse-CDF reparameterization
+        u = np.random.uniform(0.0, 1.0, shape)
+        return self.loc + self.scale * np.tan(math.pi * (u - 0.5))
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'loc', 'scale')
+
+    def cdf(self, value):
+        return np.arctan((value - self.loc) / self.scale) / math.pi + 0.5
+
+    def icdf(self, value):
+        return self.loc + self.scale * np.tan(math.pi * (value - 0.5))
+
+    @property
+    def mean(self):
+        return np.full(self._batch_shape(), float('nan'))
+
+    @property
+    def variance(self):
+        return np.full(self._batch_shape(), float('nan'))
+
+    def entropy(self):
+        return np.log(4 * math.pi * self.scale) * np.ones_like(self.loc)

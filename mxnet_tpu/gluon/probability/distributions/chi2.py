@@ -1,0 +1,21 @@
+"""Chi-squared distribution (reference
+``python/mxnet/gluon/probability/distributions/chi2.py`` —
+Chi2(df) = Gamma(df/2, 2))."""
+
+from .gamma import Gamma
+from .constraint import Positive
+from .utils import as_array
+
+__all__ = ['Chi2']
+
+
+class Chi2(Gamma):
+    arg_constraints = {'df': Positive()}
+
+    def __init__(self, df, F=None, validate_args=None):
+        df = as_array(df)
+        super().__init__(df / 2, 2.0, F, validate_args)
+
+    @property
+    def df(self):
+        return self.shape * 2

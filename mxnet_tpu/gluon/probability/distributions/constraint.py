@@ -1,0 +1,298 @@
+"""Constraint zoo for distribution argument/support validation.
+
+Reference: ``python/mxnet/gluon/probability/distributions/constraint.py``
+(Real/Interval/Simplex/LowerCholesky/... classes whose ``check`` raises on
+violation via the constraint_check op). Same class surface here; checks
+run eagerly on host when values are concrete and are skipped under jit
+tracing (XLA graphs cannot raise data-dependent errors).
+"""
+
+from .... import numpy as np
+from .utils import as_array, constraint_check
+
+__all__ = ['Constraint', 'Real', 'Boolean', 'Interval', 'OpenInterval',
+           'HalfOpenInterval', 'IntegerInterval', 'IntegerOpenInterval',
+           'IntegerHalfOpenInterval', 'GreaterThan', 'GreaterThanEq',
+           'LessThan', 'LessThanEq', 'IntegerGreaterThan',
+           'IntegerGreaterThanEq', 'IntegerLessThan', 'IntegerLessThanEq',
+           'Positive', 'NonNegative', 'PositiveInteger',
+           'NonNegativeInteger', 'UnitInterval', 'Simplex',
+           'LowerTriangular', 'LowerCholesky', 'PositiveDefinite',
+           'Cat', 'Stack', 'dependent', 'dependent_property']
+
+
+class Constraint:
+    """Base class: ``check(value)`` validates and returns the value."""
+
+    def check(self, value):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.__class__.__name__ + '()'
+
+
+class _Dependent(Constraint):
+    """Placeholder for constraints that depend on other arguments."""
+
+    def check(self, value):
+        raise ValueError('cannot determine validity of dependent constraint')
+
+
+class _DependentProperty(property, _Dependent):
+    """``@dependent_property`` — a property that is also a (dependent)
+    constraint, used for e.g. Uniform.support depending on low/high."""
+
+
+dependent = _Dependent()
+dependent_property = _DependentProperty
+
+
+def _ok(cond, msg):
+    constraint_check(cond, msg)
+
+
+class Real(Constraint):
+    def check(self, value):
+        value = as_array(value)
+        _ok(value == value, 'value must be a real tensor (got NaN)')
+        return value
+
+
+class Boolean(Constraint):
+    def check(self, value):
+        value = as_array(value)
+        _ok((value == 0) | (value == 1), 'value must be 0 or 1')
+        return value
+
+
+class Interval(Constraint):
+    def __init__(self, lower_bound, upper_bound):
+        self._low, self._high = lower_bound, upper_bound
+
+    def check(self, value):
+        value = as_array(value)
+        _ok((value >= self._low) & (value <= self._high),
+            f'value must be in [{self._low}, {self._high}]')
+        return value
+
+    def __repr__(self):
+        return f'{type(self).__name__}({self._low}, {self._high})'
+
+
+class OpenInterval(Interval):
+    def check(self, value):
+        value = as_array(value)
+        _ok((value > self._low) & (value < self._high),
+            f'value must be in ({self._low}, {self._high})')
+        return value
+
+
+class HalfOpenInterval(Interval):
+    def check(self, value):
+        value = as_array(value)
+        _ok((value >= self._low) & (value < self._high),
+            f'value must be in [{self._low}, {self._high})')
+        return value
+
+
+def _integral(value):
+    return value == np.floor(value)
+
+
+class IntegerInterval(Interval):
+    def check(self, value):
+        value = as_array(value)
+        _ok(_integral(value) & (value >= self._low) & (value <= self._high),
+            f'value must be an integer in [{self._low}, {self._high}]')
+        return value
+
+
+class IntegerOpenInterval(Interval):
+    def check(self, value):
+        value = as_array(value)
+        _ok(_integral(value) & (value > self._low) & (value < self._high),
+            f'value must be an integer in ({self._low}, {self._high})')
+        return value
+
+
+class IntegerHalfOpenInterval(Interval):
+    def check(self, value):
+        value = as_array(value)
+        _ok(_integral(value) & (value >= self._low) & (value < self._high),
+            f'value must be an integer in [{self._low}, {self._high})')
+        return value
+
+
+class GreaterThan(Constraint):
+    def __init__(self, lower_bound):
+        self._low = lower_bound
+
+    def check(self, value):
+        value = as_array(value)
+        _ok(value > self._low, f'value must be > {self._low}')
+        return value
+
+    def __repr__(self):
+        return f'{type(self).__name__}({self._low})'
+
+
+class GreaterThanEq(GreaterThan):
+    def check(self, value):
+        value = as_array(value)
+        _ok(value >= self._low, f'value must be >= {self._low}')
+        return value
+
+
+class LessThan(Constraint):
+    def __init__(self, upper_bound):
+        self._high = upper_bound
+
+    def check(self, value):
+        value = as_array(value)
+        _ok(value < self._high, f'value must be < {self._high}')
+        return value
+
+    def __repr__(self):
+        return f'{type(self).__name__}({self._high})'
+
+
+class LessThanEq(LessThan):
+    def check(self, value):
+        value = as_array(value)
+        _ok(value <= self._high, f'value must be <= {self._high}')
+        return value
+
+
+class IntegerGreaterThan(GreaterThan):
+    def check(self, value):
+        value = as_array(value)
+        _ok(_integral(value) & (value > self._low),
+            f'value must be an integer > {self._low}')
+        return value
+
+
+class IntegerGreaterThanEq(GreaterThan):
+    def check(self, value):
+        value = as_array(value)
+        _ok(_integral(value) & (value >= self._low),
+            f'value must be an integer >= {self._low}')
+        return value
+
+
+class IntegerLessThan(LessThan):
+    def check(self, value):
+        value = as_array(value)
+        _ok(_integral(value) & (value < self._high),
+            f'value must be an integer < {self._high}')
+        return value
+
+
+class IntegerLessThanEq(LessThan):
+    def check(self, value):
+        value = as_array(value)
+        _ok(_integral(value) & (value <= self._high),
+            f'value must be an integer <= {self._high}')
+        return value
+
+
+class Positive(GreaterThan):
+    def __init__(self):
+        super().__init__(0)
+
+
+class NonNegative(GreaterThanEq):
+    def __init__(self):
+        super().__init__(0)
+
+
+class PositiveInteger(IntegerGreaterThan):
+    def __init__(self):
+        super().__init__(0)
+
+
+class NonNegativeInteger(IntegerGreaterThanEq):
+    def __init__(self):
+        super().__init__(0)
+
+
+class UnitInterval(Interval):
+    def __init__(self):
+        super().__init__(0, 1)
+
+
+class Simplex(Constraint):
+    def check(self, value):
+        value = as_array(value)
+        _ok((value >= 0) & (np.abs(value.sum(-1) - 1) < 1e-6),
+            'value must lie on the probability simplex')
+        return value
+
+
+class LowerTriangular(Constraint):
+    def check(self, value):
+        value = as_array(value)
+        _ok(np.abs(np.triu(value, 1)).sum((-2, -1)) < 1e-6,
+            'value must be lower-triangular')
+        return value
+
+
+class LowerCholesky(Constraint):
+    def check(self, value):
+        value = as_array(value)
+        _ok(np.abs(np.triu(value, 1)).sum((-2, -1)) < 1e-6,
+            'value must be lower-triangular')
+        _ok(np.diagonal(value, axis1=-2, axis2=-1) > 0,
+            'diagonal of a Cholesky factor must be positive')
+        return value
+
+
+class PositiveDefinite(Constraint):
+    def check(self, value):
+        value = as_array(value)
+        # symmetric + positive leading eigenvalue proxy: all eigvals > 0
+        _ok(np.abs(value - np.swapaxes(value, -1, -2)).sum((-2, -1))
+            < 1e-5, 'value must be symmetric')
+        import numpy as _onp
+        try:
+            w = _onp.linalg.eigvalsh(value.asnumpy())
+            _ok(bool((w > 0).all()), 'value must be positive definite')
+        except Exception:
+            pass  # abstract under trace
+        return value
+
+
+class Cat(Constraint):
+    """Apply child constraints to contiguous slices along `axis`
+    (reference constraint.Cat)."""
+
+    def __init__(self, constraints, axis=0, lengths=None):
+        self._constraints = list(constraints)
+        self._axis = axis
+        self._lengths = lengths or [1] * len(self._constraints)
+
+    def check(self, value):
+        value = as_array(value)
+        start = 0
+        for c, n in zip(self._constraints, self._lengths):
+            idx = [slice(None)] * value.ndim
+            idx[self._axis] = slice(start, start + n)
+            c.check(value[tuple(idx)])
+            start += n
+        return value
+
+
+class Stack(Constraint):
+    """Apply child constraints to indexed slices along `axis`
+    (reference constraint.Stack)."""
+
+    def __init__(self, constraints, axis=0):
+        self._constraints = list(constraints)
+        self._axis = axis
+
+    def check(self, value):
+        value = as_array(value)
+        for i, c in enumerate(self._constraints):
+            idx = [slice(None)] * value.ndim
+            idx[self._axis] = i
+            c.check(value[tuple(idx)])
+        return value

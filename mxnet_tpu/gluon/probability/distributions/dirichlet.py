@@ -1,0 +1,63 @@
+"""Dirichlet distribution (reference
+``python/mxnet/gluon/probability/distributions/dirichlet.py``).
+Sampled as normalized reparameterized gammas (pathwise gradients)."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Positive, Simplex
+from .utils import (as_array, sample_n_shape_converter, gammaln, digamma,
+                    rgamma, sum_right_most)
+
+__all__ = ['Dirichlet']
+
+
+class Dirichlet(Distribution):
+    has_grad = True
+    support = Simplex()
+    arg_constraints = {'alpha': Positive()}
+
+    def __init__(self, alpha, F=None, validate_args=None):
+        self.alpha = as_array(alpha)
+        super().__init__(F=F, event_dim=1, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.alpha.shape[:-1]
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        a = self.alpha
+        return (sum_right_most((a - 1) * np.log(value), 1)
+                - sum_right_most(gammaln(a), 1)
+                + gammaln(sum_right_most(a, 1)))
+
+    def sample(self, size=None):
+        full = (size + self.alpha.shape[-1:]) if size is not None \
+            else self.alpha.shape
+        g = rgamma(np.broadcast_to(self.alpha, full), full)
+        return g / g.sum(-1, keepdims=True)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(
+            tuple(batch_shape) + self.alpha.shape[-1:], 'alpha')
+
+    @property
+    def mean(self):
+        return self.alpha / self.alpha.sum(-1, keepdims=True)
+
+    @property
+    def variance(self):
+        a0 = self.alpha.sum(-1, keepdims=True)
+        return self.alpha * (a0 - self.alpha) / (a0 ** 2 * (a0 + 1))
+
+    def entropy(self):
+        a = self.alpha
+        k = a.shape[-1]
+        a0 = a.sum(-1)
+        return (sum_right_most(gammaln(a), 1) - gammaln(a0)
+                + (a0 - k) * digamma(a0)
+                - sum_right_most((a - 1) * digamma(a), 1))

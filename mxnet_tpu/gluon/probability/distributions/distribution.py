@@ -1,0 +1,122 @@
+"""Distribution base class.
+
+Reference: ``python/mxnet/gluon/probability/distributions/distribution.py``
+(Distribution: log_prob/pdf/cdf/icdf/sample/sample_n/broadcast_to/
+enumerate_support/mean/variance/stddev/support/entropy/perplexity).
+
+TPU-native notes: one array namespace (mx.np over jax) — the reference's
+``F`` mode switch is accepted and ignored; every method is pure NDArray
+math, so log_prob/entropy differentiate through the autograd tape and the
+whole object works under ``hybridize``/jit tracing. Sampling draws keys
+from the Context-scoped PRNG resource (mxnet_tpu/_rng.py), never from
+user-managed key plumbing.
+"""
+
+from .... import numpy as np
+
+__all__ = ['Distribution']
+
+
+class Distribution:
+    """Base class for probability distributions."""
+
+    # whether `sample()` is reparameterized (pathwise gradients flow to
+    # the distribution parameters)
+    has_grad = False
+    has_enumerate_support = False
+    arg_constraints = {}
+    _validate_args = False
+
+    @staticmethod
+    def set_default_validate_args(value):
+        if value not in (True, False):
+            raise ValueError
+        Distribution._validate_args = value
+
+    def __init__(self, F=None, event_dim=None, validate_args=None):
+        self.F = F or np
+        self.event_dim = event_dim
+        if validate_args is not None:
+            self._validate_args = validate_args
+        if self._validate_args:
+            for param, constraint in self.arg_constraints.items():
+                if param not in self.__dict__:
+                    continue  # lazily-derived parameter
+                constraint.check(getattr(self, param))
+        super().__init__()
+
+    # ----------------------------------------------------------- densities
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def pdf(self, value):
+        return np.exp(self.log_prob(value))
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ sampling
+    def sample(self, size=None):
+        """Draw a sample of shape `size` (None → broadcasted batch
+        shape). `size` must include the batch shape, numpy-style."""
+        raise NotImplementedError
+
+    def sample_n(self, size=None):
+        """Draw samples with an iid prefix of shape `size` prepended to
+        the batch shape (reference sample_n)."""
+        raise NotImplementedError
+
+    def broadcast_to(self, batch_shape):
+        """Return a new distribution with parameters broadcast to
+        `batch_shape` (reference Distribution.broadcast_to)."""
+        raise NotImplementedError
+
+    def enumerate_support(self):
+        raise NotImplementedError
+
+    # ---------------------------------------------------------- statistics
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return np.sqrt(self.variance)
+
+    @property
+    def support(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def perplexity(self):
+        return np.exp(self.entropy())
+
+    # ------------------------------------------------------------- helpers
+    def _validate_samples(self, value):
+        return self.support.check(value)
+
+    def __repr__(self):
+        args = ', '.join(
+            f'{p}={getattr(self, p)!r}' for p in self.arg_constraints
+            if p in self.__dict__)
+        return f'{type(self).__name__}({args})'
+
+    def _broadcast_args(self, batch_shape, *names):
+        """Shared broadcast_to body: returns a shallow copy with the
+        named parameters broadcast."""
+        import copy
+        new = copy.copy(self)
+        for n in names:
+            v = getattr(self, n)
+            if v is not None:
+                setattr(new, n, np.broadcast_to(v, batch_shape))
+        return new

@@ -1,0 +1,274 @@
+"""KL divergence registry (reference
+``python/mxnet/gluon/probability/distributions/divergence.py`` —
+``register_kl(P, Q)`` decorator + name-based dispatch + ``empirical_kl``
+Monte-Carlo fallback). All closed forms below are standard results; each
+is a pure NDArray program, differentiable end-to-end (the ELBO use case)."""
+
+import math
+
+from .... import numpy as np
+from .... import numpy_extension as npx
+from .utils import gammaln, digamma, sum_right_most, EULER
+
+from .normal import Normal
+from .bernoulli import Bernoulli
+from .categorical import Categorical
+from .one_hot_categorical import OneHotCategorical
+from .uniform import Uniform
+from .cauchy import Cauchy
+from .laplace import Laplace
+from .poisson import Poisson
+from .geometric import Geometric
+from .exponential import Exponential
+from .pareto import Pareto
+from .gumbel import Gumbel
+from .gamma import Gamma
+from .beta import Beta
+from .dirichlet import Dirichlet
+from .half_normal import HalfNormal
+from .binomial import Binomial
+from .multivariate_normal import MultivariateNormal
+
+__all__ = ['register_kl', 'kl_divergence', 'empirical_kl']
+
+_KL_REGISTRY = {}
+
+
+def empirical_kl(p, q, n_samples=1):
+    """Monte-Carlo KL(p||q) = E_p[log p(x) − log q(x)] — works for any
+    pair with log_prob + sampling (reference empirical_kl)."""
+    samples = p.sample_n((n_samples,))
+    return (p.log_prob(samples) - q.log_prob(samples)).mean(0)
+
+
+def register_kl(typeP, typeQ):
+    """Decorator registering KL(P||Q) (reference register_kl)."""
+
+    def deco(func):
+        _KL_REGISTRY[(typeP.__name__, typeQ.__name__)] = func
+        return func
+
+    return deco
+
+
+def kl_divergence(p, q):
+    r"""KL(p||q), dispatched on the pair of distribution types."""
+    func = _dispatch_kl(p.__class__.__name__, q.__class__.__name__)
+    return func(p, q)
+
+
+def _dispatch_kl(type_p, type_q):
+    func = _KL_REGISTRY.get((type_p, type_q))
+    if func is None:
+        raise NotImplementedError(
+            'KL divergence between {} and {} is not implemented.'
+            .format(type_p, type_q))
+    return func
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - np.log(var_ratio))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    # xlogy-safe: the p=0 / p=1 limits contribute 0, not 0*(-inf)=nan
+    pp, qp = p.prob, q.prob
+    t1 = np.where(pp > 0, pp * (np.log(np.maximum(pp, 1e-38))
+                                - np.log(qp)), np.zeros_like(pp))
+    t0 = np.where(pp < 1, (1 - pp) * (np.log1p(-np.minimum(pp, 1 - 1e-7))
+                                      - np.log1p(-qp)),
+                  np.zeros_like(pp))
+    return t1 + t0
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    lp = npx.log_softmax(p.logit, axis=-1)
+    lq = npx.log_softmax(q.logit, axis=-1)
+    return sum_right_most(np.exp(lp) * (lp - lq), 1)
+
+
+@register_kl(OneHotCategorical, OneHotCategorical)
+def _kl_onehotcategorical_onehotcategorical(p, q):
+    return _kl_categorical_categorical(p._categorical, q._categorical)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    # finite iff q's support contains p's
+    result = np.log((q.high - q.low) / (p.high - p.low))
+    return np.where((q.low <= p.low) & (q.high >= p.high), result,
+                    np.full(result.shape, float('inf')))
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019)
+    t1 = np.log((p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2)
+    t2 = np.log(4 * p.scale * q.scale)
+    return t1 - t2
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_diff = np.abs(p.loc - q.loc) / q.scale
+    return (-np.log(scale_ratio) - 1 + loc_diff
+            + scale_ratio * np.exp(-loc_diff / scale_ratio))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return p.rate * (np.log(p.rate) - np.log(q.rate)) - (p.rate - q.rate)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    return (-p.entropy() - np.log(q.prob)
+            - (1 - p.prob) / p.prob * np.log1p(-q.prob))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    # KL = log(sq/sp) + sp/sq - 1 (rates lambda = 1/scale)
+    scale_ratio = p.scale / q.scale
+    return scale_ratio - 1 - np.log(scale_ratio)
+
+
+@register_kl(Pareto, Pareto)
+def _kl_pareto_pareto(p, q):
+    scale_ratio = p.scale / q.scale
+    alpha_ratio = q.alpha / p.alpha
+    t1 = q.alpha * np.log(scale_ratio)
+    t2 = -np.log(alpha_ratio)
+    result = t1 + t2 + alpha_ratio - 1
+    return np.where(p.scale >= q.scale, result,
+                    np.full(result.shape, float('inf')))
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    # log(b2/b1) + γ(b1/b2 − 1) + (μ1−μ2)/b2
+    #   + exp((μ2−μ1)/b2 + lgamma(1 + b1/b2)) − 1
+    beta_ratio = p.scale / q.scale
+    loc_diff = (p.loc - q.loc) / q.scale
+    return (-np.log(beta_ratio) + EULER * (beta_ratio - 1) + loc_diff
+            + np.exp(-loc_diff + gammaln(1 + beta_ratio)) - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    # (shape a, scale s) parameterization
+    ap, bp = p.shape, 1 / p.scale
+    aq, bq = q.shape, 1 / q.scale
+    return ((ap - aq) * digamma(ap) - gammaln(ap) + gammaln(aq)
+            + aq * (np.log(bp) - np.log(bq))
+            + ap * (bq / bp - 1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def betaln(a, b):
+        return gammaln(a) + gammaln(b) - gammaln(a + b)
+
+    sp = p.alpha + p.beta
+    return (betaln(q.alpha, q.beta) - betaln(p.alpha, p.beta)
+            + (p.alpha - q.alpha) * digamma(p.alpha)
+            + (p.beta - q.beta) * digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * digamma(sp))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    a0 = p.alpha.sum(-1)
+    return (gammaln(a0) - sum_right_most(gammaln(p.alpha), 1)
+            - gammaln(q.alpha.sum(-1))
+            + sum_right_most(gammaln(q.alpha), 1)
+            + sum_right_most(
+                (p.alpha - q.alpha)
+                * (digamma(p.alpha) - digamma(a0)[..., None]), 1))
+
+
+@register_kl(HalfNormal, HalfNormal)
+def _kl_halfNormal_halfNormal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    return 0.5 * (var_ratio - 1 - np.log(var_ratio))
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial_binomial(p, q):
+    if p.n != q.n:
+        raise ValueError('KL between binomials with different trial '
+                         'counts is not implemented')
+    return p.n * (p.prob * (np.log(p.prob) - np.log(q.prob))
+                  + (1 - p.prob) * (np.log1p(-p.prob)
+                                    - np.log1p(-q.prob)))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    k = p.loc.shape[-1]
+    half_p = p._half_log_det()
+    half_q = q._half_log_det()
+    qinv = q.precision
+    diff = q.loc - p.loc
+    tr = np.einsum('...ij,...ji->...', qinv, p.cov)
+    maha = np.einsum('...i,...ij,...j->...', diff, qinv, diff)
+    return half_q - half_p + 0.5 * (tr + maha - k)
+
+
+@register_kl(Uniform, Normal)
+def _kl_uniform_normal(p, q):
+    # -H(p) + E_p[-log q]
+    width = p.high - p.low
+    e2 = (p.high ** 3 - p.low ** 3) / (3 * width)  # E[x^2]
+    mean = (p.high + p.low) / 2
+    cross = (0.5 * math.log(2 * math.pi) + np.log(q.scale)
+             + (e2 - 2 * mean * q.loc + q.loc ** 2)
+             / (2 * q.scale ** 2))
+    return -np.log(width) + cross
+
+
+@register_kl(Uniform, Gumbel)
+def _kl_uniform_gumbel(p, q):
+    # E_p[-log q] with q Gumbel(mu, beta): log beta + E[z] + E[e^{-z}]
+    width = p.high - p.low
+    zl = (p.low - q.loc) / q.scale
+    zh = (p.high - q.loc) / q.scale
+    mean_z = (zl + zh) / 2
+    e_exp = (np.exp(-zl) - np.exp(-zh)) * q.scale / width
+    return (-np.log(width) + np.log(q.scale) + mean_z + e_exp)
+
+
+@register_kl(Exponential, Gumbel)
+def _kl_exponential_gumbel(p, q):
+    # p Exp(scale s); q Gumbel(mu, b). E[x] = s.
+    s, mu, b = p.scale, q.loc, q.scale
+    t1 = -np.log(s) - 1                        # -H(p) = -(1+log s)
+    t2 = np.log(b) + (s - mu * np.ones_like(s)) / b
+    # E[e^{-(x-mu)/b}] = e^{mu/b} * (1/(1+s/b))
+    t3 = np.exp(mu / b) / (1 + s / b)
+    return t1 + t2 + t3
+
+
+@register_kl(Exponential, Normal)
+def _kl_exponential_normal(p, q):
+    # E_p[x]=s, E_p[x^2]=2s^2
+    s = p.scale
+    var = q.scale ** 2
+    return (-np.log(s) - 1
+            + 0.5 * math.log(2 * math.pi) + np.log(q.scale)
+            + (2 * s ** 2 - 2 * q.loc * s + q.loc ** 2) / (2 * var))
+
+
+@register_kl(Exponential, Gamma)
+def _kl_exponential_gamma(p, q):
+    # p = Gamma(1, s): E_p[log x] = log s − γ, H(p) = 1 + log s
+    s = p.scale
+    aq, sq = q.shape, q.scale
+    return (-np.log(s) - 1 + gammaln(aq) + aq * np.log(sq)
+            - (aq - 1) * (np.log(s) - EULER) + s / sq)

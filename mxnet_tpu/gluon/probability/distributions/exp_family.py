@@ -1,0 +1,30 @@
+"""Exponential-family base.
+
+Reference: ``python/mxnet/gluon/probability/distributions/exp_family.py``
+— defines the natural-parameter API (``_natural_params``,
+``_log_normalizer``, ``_mean_carrier_measure``) and derives ``entropy``
+via the Bregman divergence of the log-normalizer using autograd.
+
+Here members override ``entropy`` with closed forms (cheaper and exact —
+no autograd round-trip inside a metric), and the natural-parameter hooks
+remain for subclasses that expose them (Normal does).
+"""
+
+from .distribution import Distribution
+
+__all__ = ['ExponentialFamily']
+
+
+class ExponentialFamily(Distribution):
+    r"""p(x|θ) = h(x) exp(<η(θ), t(x)> − A(η))."""
+
+    @property
+    def _natural_params(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError

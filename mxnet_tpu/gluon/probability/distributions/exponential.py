@@ -1,0 +1,64 @@
+"""Exponential distribution (reference
+``python/mxnet/gluon/probability/distributions/exponential.py`` —
+parameterized by *scale* = 1/rate)."""
+
+from .... import numpy as np
+from .exp_family import ExponentialFamily
+from .constraint import Positive, NonNegative
+from .utils import as_array, sample_n_shape_converter
+
+__all__ = ['Exponential']
+
+
+class Exponential(ExponentialFamily):
+    has_grad = True
+    support = NonNegative()
+    arg_constraints = {'scale': Positive()}
+
+    def __init__(self, scale=1.0, F=None, validate_args=None):
+        self.scale = as_array(scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.scale.shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        return -np.log(self.scale) - value / self.scale
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        u = np.random.uniform(0.0, 1.0, shape)
+        return -self.scale * np.log1p(-u)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'scale')
+
+    def cdf(self, value):
+        return -np.expm1(-value / self.scale)
+
+    def icdf(self, value):
+        return -self.scale * np.log1p(-value)
+
+    @property
+    def mean(self):
+        return self.scale
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def entropy(self):
+        return 1 + np.log(self.scale)
+
+    @property
+    def _natural_params(self):
+        return (-1 / self.scale,)
+
+    def _log_normalizer(self, x):
+        return -np.log(-x)

@@ -1,0 +1,63 @@
+"""Fisher–Snedecor (F) distribution (reference
+``python/mxnet/gluon/probability/distributions/fishersnedecor.py``).
+Sampled as a ratio of reparameterized chi-squareds."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Positive
+from .utils import as_array, sample_n_shape_converter, gammaln, rgamma
+
+__all__ = ['FisherSnedecor']
+
+
+class FisherSnedecor(Distribution):
+    has_grad = True
+    support = Positive()
+    arg_constraints = {'df1': Positive(), 'df2': Positive()}
+
+    def __init__(self, df1, df2, F=None, validate_args=None):
+        self.df1 = as_array(df1)
+        self.df2 = as_array(df2)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return (self.df1 + self.df2).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        d1, d2 = self.df1, self.df2
+        betaln = (gammaln(d1 / 2) + gammaln(d2 / 2)
+                  - gammaln((d1 + d2) / 2))
+        return (0.5 * (d1 * np.log(d1) + d1 * np.log(value)
+                       + d2 * np.log(d2)
+                       - (d1 + d2) * np.log(d1 * value + d2))
+                - np.log(value) - betaln)
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        ones = np.ones(shape) if shape else np.array(1.0)
+        d1 = np.broadcast_to(self.df1 * ones, shape)
+        d2 = np.broadcast_to(self.df2 * ones, shape)
+        x1 = rgamma(d1 / 2, shape) * 2 / d1
+        x2 = rgamma(d2 / 2, shape) * 2 / d2
+        return x1 / x2
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'df1', 'df2')
+
+    @property
+    def mean(self):
+        m = self.df2 / (self.df2 - 2)
+        return np.where(self.df2 > 2, m, np.full(m.shape, float('nan')))
+
+    @property
+    def variance(self):
+        d1, d2 = self.df1, self.df2
+        v = (2 * d2 ** 2 * (d1 + d2 - 2)
+             / (d1 * (d2 - 2) ** 2 * (d2 - 4)))
+        return np.where(d2 > 4, v, np.full(v.shape, float('nan')))

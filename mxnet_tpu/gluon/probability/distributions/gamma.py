@@ -1,0 +1,59 @@
+"""Gamma distribution (reference
+``python/mxnet/gluon/probability/distributions/gamma.py`` — (shape,
+scale) parameterization). Sampling is pathwise-differentiable via the
+implicit-reparameterized gamma op (utils.rgamma)."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Positive
+from .utils import (as_array, sample_n_shape_converter, gammaln, digamma,
+                    rgamma)
+
+__all__ = ['Gamma']
+
+
+class Gamma(Distribution):
+    has_grad = True
+    support = Positive()
+    arg_constraints = {'shape': Positive(), 'scale': Positive()}
+
+    def __init__(self, shape, scale=1.0, F=None, validate_args=None):
+        self.shape = as_array(shape)
+        self.scale = as_array(scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return (self.shape + self.scale).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        a, s = self.shape, self.scale
+        return ((a - 1) * np.log(value) - value / s - gammaln(a)
+                - a * np.log(s))
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        alpha = np.broadcast_to(self.shape * np.ones_like(self.scale),
+                                shape)
+        return rgamma(alpha, shape) * self.scale
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'shape', 'scale')
+
+    @property
+    def mean(self):
+        return self.shape * self.scale
+
+    @property
+    def variance(self):
+        return self.shape * self.scale ** 2
+
+    def entropy(self):
+        a = self.shape * np.ones_like(self.scale)
+        return (a + np.log(self.scale * np.ones_like(a)) + gammaln(a)
+                + (1 - a) * digamma(a))

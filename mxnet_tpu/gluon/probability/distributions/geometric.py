@@ -1,0 +1,75 @@
+"""Geometric distribution (reference
+``python/mxnet/gluon/probability/distributions/geometric.py`` — number
+of failures before the first success)."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import UnitInterval, Real, NonNegativeInteger
+from .utils import (as_array, cached_property, prob2logit, logit2prob,
+                    sample_n_shape_converter)
+
+__all__ = ['Geometric']
+
+
+class Geometric(Distribution):
+    support = NonNegativeInteger()
+    arg_constraints = {'prob': UnitInterval(), 'logit': Real()}
+
+    def __init__(self, prob=None, logit=None, F=None, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                'Either `prob` or `logit` must be specified, but not both.')
+        if prob is not None:
+            self.prob = as_array(prob)
+        else:
+            self.logit = as_array(logit)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, True)
+
+    def _batch_shape(self):
+        p = self.__dict__.get('prob')
+        return (p if p is not None else self.logit).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        return value * np.log1p(-self.prob) + np.log(self.prob)
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        u = np.clip(np.random.uniform(0.0, 1.0, shape), 1e-7, 1 - 1e-7)
+        return np.floor(np.log(u) / np.log1p(-self.prob))
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        if 'prob' in self.__dict__:
+            new.prob = np.broadcast_to(self.prob, batch_shape)
+            new.__dict__.pop('logit', None)
+        else:
+            new.logit = np.broadcast_to(self.logit, batch_shape)
+            new.__dict__.pop('prob', None)
+        return new
+
+    @property
+    def mean(self):
+        return (1 - self.prob) / self.prob
+
+    @property
+    def variance(self):
+        return (1 - self.prob) / self.prob ** 2
+
+    def entropy(self):
+        p = self.prob
+        return -((1 - p) * np.log1p(-p) + p * np.log(p)) / p

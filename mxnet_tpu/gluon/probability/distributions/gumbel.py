@@ -1,0 +1,64 @@
+"""Gumbel distribution (reference
+``python/mxnet/gluon/probability/distributions/gumbel.py``)."""
+
+import math
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Real, Positive
+from .utils import as_array, sample_n_shape_converter, EULER
+
+__all__ = ['Gumbel']
+
+
+class Gumbel(Distribution):
+    has_grad = True
+    support = Real()
+    arg_constraints = {'loc': Real(), 'scale': Positive()}
+
+    def __init__(self, loc, scale=1, F=None, validate_args=None):
+        self.loc = as_array(loc)
+        self.scale = as_array(scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return (self.loc + self.scale).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        z = (value - self.loc) / self.scale
+        return -(z + np.exp(-z)) - np.log(self.scale)
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        u = np.clip(np.random.uniform(0.0, 1.0, shape), 1e-7, 1 - 1e-7)
+        return self.loc - self.scale * np.log(-np.log(u))
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'loc', 'scale')
+
+    def cdf(self, value):
+        return np.exp(-np.exp(-(value - self.loc) / self.scale))
+
+    def icdf(self, value):
+        return self.loc - self.scale * np.log(-np.log(value))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * EULER
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+    @property
+    def stddev(self):
+        return math.pi / math.sqrt(6) * self.scale
+
+    def entropy(self):
+        return np.log(self.scale) + 1 + EULER

@@ -1,0 +1,62 @@
+"""Half-Cauchy distribution (reference
+``python/mxnet/gluon/probability/distributions/half_cauchy.py`` — the
+reference builds it as TransformedDistribution(Cauchy, AbsTransform);
+here closed forms are used directly, same API)."""
+
+import math
+
+from .... import numpy as np
+from .distribution import Distribution
+from .cauchy import Cauchy
+from .constraint import NonNegative, Positive
+from .utils import as_array, sample_n_shape_converter
+
+__all__ = ['HalfCauchy']
+
+
+class HalfCauchy(Distribution):
+    has_grad = True
+    support = NonNegative()
+    arg_constraints = {'scale': Positive()}
+
+    def __init__(self, scale=1.0, F=None, validate_args=None):
+        self.scale = as_array(scale)
+        self._base = Cauchy(0.0, self.scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.scale.shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        return math.log(2) + self._base.log_prob(value)
+
+    def sample(self, size=None):
+        return np.abs(self._base.sample(size))
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        new = self._broadcast_args(batch_shape, 'scale')
+        new._base = Cauchy(0.0, new.scale)
+        return new
+
+    def cdf(self, value):
+        return 2 * np.arctan(value / self.scale) / math.pi
+
+    def icdf(self, value):
+        return self.scale * np.tan(math.pi * value / 2)
+
+    @property
+    def mean(self):
+        return np.full(self._batch_shape(), float('nan'))
+
+    @property
+    def variance(self):
+        return np.full(self._batch_shape(), float('nan'))
+
+    def entropy(self):
+        return np.log(2 * math.pi * self.scale)

@@ -1,0 +1,60 @@
+"""Half-Normal distribution (reference
+``python/mxnet/gluon/probability/distributions/half_normal.py``)."""
+
+import math
+
+from .... import numpy as np
+from .distribution import Distribution
+from .normal import Normal
+from .constraint import NonNegative, Positive
+from .utils import as_array, erf, erfinv, sample_n_shape_converter
+
+__all__ = ['HalfNormal']
+
+
+class HalfNormal(Distribution):
+    has_grad = True
+    support = NonNegative()
+    arg_constraints = {'scale': Positive()}
+
+    def __init__(self, scale=1.0, F=None, validate_args=None):
+        self.scale = as_array(scale)
+        self._base = Normal(0.0, self.scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.scale.shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        return math.log(2) + self._base.log_prob(value)
+
+    def sample(self, size=None):
+        return np.abs(self._base.sample(size))
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        new = self._broadcast_args(batch_shape, 'scale')
+        new._base = Normal(0.0, new.scale)
+        return new
+
+    def cdf(self, value):
+        return erf(value / (self.scale * math.sqrt(2)))
+
+    def icdf(self, value):
+        return self.scale * math.sqrt(2) * erfinv(value)
+
+    @property
+    def mean(self):
+        return self.scale * math.sqrt(2 / math.pi)
+
+    @property
+    def variance(self):
+        return self.scale ** 2 * (1 - 2 / math.pi)
+
+    def entropy(self):
+        return (0.5 * np.log(math.pi * self.scale ** 2 / 2) + 0.5)

@@ -1,0 +1,49 @@
+"""Independent — reinterpret batch dims as event dims (reference
+``python/mxnet/gluon/probability/distributions/independent.py``)."""
+
+from .distribution import Distribution
+from .utils import sum_right_most
+
+__all__ = ['Independent']
+
+
+class Independent(Distribution):
+
+    def __init__(self, base_distribution, reinterpreted_batch_ndims,
+                 validate_args=None):
+        self.base_dist = base_distribution
+        self.reinterpreted_batch_ndims = reinterpreted_batch_ndims
+        event_dim = reinterpreted_batch_ndims + \
+            (base_distribution.event_dim or 0)
+        super().__init__(F=base_distribution.F, event_dim=event_dim,
+                         validate_args=validate_args)
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    @property
+    def support(self):
+        return self.base_dist.support
+
+    def log_prob(self, value):
+        return sum_right_most(self.base_dist.log_prob(value),
+                              self.reinterpreted_batch_ndims)
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def sample_n(self, size=None):
+        return self.base_dist.sample_n(size)
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def entropy(self):
+        return sum_right_most(self.base_dist.entropy(),
+                              self.reinterpreted_batch_ndims)

@@ -1,0 +1,65 @@
+"""Laplace distribution (reference
+``python/mxnet/gluon/probability/distributions/laplace.py``)."""
+
+import math
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Real, Positive
+from .utils import as_array, sample_n_shape_converter
+
+__all__ = ['Laplace']
+
+
+class Laplace(Distribution):
+    has_grad = True
+    support = Real()
+    arg_constraints = {'loc': Real(), 'scale': Positive()}
+
+    def __init__(self, loc=0.0, scale=1.0, F=None, validate_args=None):
+        self.loc = as_array(loc)
+        self.scale = as_array(scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return (self.loc + self.scale).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        return (-np.abs(value - self.loc) / self.scale
+                - np.log(2 * self.scale))
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        # inverse-CDF from U(-1/2, 1/2): loc - b*sign(u)*log1p(-2|u|)
+        u = np.random.uniform(-0.5, 0.5, shape)
+        return self.loc - self.scale * np.sign(u) * np.log1p(
+            -2 * np.abs(u))
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'loc', 'scale')
+
+    def cdf(self, value):
+        z = (value - self.loc) / self.scale
+        return 0.5 - 0.5 * np.sign(z) * np.expm1(-np.abs(z))
+
+    def icdf(self, value):
+        u = value - 0.5
+        return self.loc - self.scale * np.sign(u) * np.log1p(
+            -2 * np.abs(u))
+
+    @property
+    def mean(self):
+        return self.loc * np.ones_like(self.scale)
+
+    @property
+    def variance(self):
+        return 2 * (self.scale ** 2) * np.ones_like(self.loc)
+
+    def entropy(self):
+        return 1 + np.log(2 * self.scale) * np.ones_like(self.loc)

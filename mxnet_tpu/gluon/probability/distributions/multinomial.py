@@ -1,0 +1,74 @@
+"""Multinomial distribution (reference
+``python/mxnet/gluon/probability/distributions/multinomial.py``)."""
+
+from .... import numpy as np
+from .... import numpy_extension as npx
+from .distribution import Distribution
+from .categorical import Categorical
+from .constraint import Simplex, Real, NonNegativeInteger
+from .utils import (as_array, sample_n_shape_converter, gammaln,
+                    sum_right_most)
+
+__all__ = ['Multinomial']
+
+
+class Multinomial(Distribution):
+    support = NonNegativeInteger()
+    arg_constraints = {'prob': Simplex(), 'logit': Real()}
+
+    def __init__(self, num_events, prob=None, logit=None, total_count=1,
+                 F=None, validate_args=None):
+        if (total_count < 0) or (total_count % 1 != 0):
+            raise ValueError(
+                'Expect `total_count` to be non-negative integer.')
+        self.total_count = int(total_count)
+        self._categorical = Categorical(num_events, prob, logit)
+        self.num_events = self._categorical.num_events
+        super().__init__(F=F, event_dim=1, validate_args=validate_args)
+
+    @property
+    def prob(self):
+        return self._categorical.prob
+
+    @property
+    def logit(self):
+        return self._categorical.logit
+
+    def _batch_shape(self):
+        return self._categorical._batch_shape()
+
+    def log_prob(self, value):
+        logp = npx.log_softmax(self.logit, axis=-1)
+        n = value.sum(-1)
+        return (gammaln(n + 1) - sum_right_most(gammaln(value + 1), 1)
+                + sum_right_most(value * logp, 1))
+
+    def sample(self, size=None):
+        # total_count iid categorical draws per output position,
+        # scattered to counts; `size` includes the batch shape
+        if size is None:
+            return self.sample_n(())
+        size = (size,) if isinstance(size, int) else tuple(size)
+        batch = self._batch_shape()
+        prefix = size[:len(size) - len(batch)] if batch else size
+        return self.sample_n(prefix)
+
+    def sample_n(self, size=None):
+        prefix = sample_n_shape_converter(size)
+        idx = self._categorical.sample_n((self.total_count,) + prefix)
+        counts = npx.one_hot(idx.astype('int32'), self.num_events)
+        return counts.sum(0)
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        new._categorical = self._categorical.broadcast_to(batch_shape)
+        return new
+
+    @property
+    def mean(self):
+        return self.total_count * self.prob
+
+    @property
+    def variance(self):
+        return self.total_count * self.prob * (1 - self.prob)

@@ -1,0 +1,111 @@
+"""Multivariate normal distribution (reference
+``python/mxnet/gluon/probability/distributions/multivariate_normal.py``
+— exactly one of cov / precision / scale_tril given). All three
+parameterizations are normalized to the Cholesky factor once; log_prob
+and sampling are einsum programs that XLA maps onto the MXU."""
+
+import math
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Real, PositiveDefinite, LowerCholesky
+from .utils import as_array, cached_property, sample_n_shape_converter
+
+__all__ = ['MultivariateNormal']
+
+
+class MultivariateNormal(Distribution):
+    has_grad = True
+    support = Real()
+    arg_constraints = {'loc': Real(), 'cov': PositiveDefinite(),
+                       'precision': PositiveDefinite(),
+                       'scale_tril': LowerCholesky()}
+
+    def __init__(self, loc, cov=None, precision=None, scale_tril=None,
+                 F=None, validate_args=None):
+        if (cov is not None) + (precision is not None) + \
+                (scale_tril is not None) != 1:
+            raise ValueError('Exactly one of `cov` or `precision` or '
+                             '`scale_tril` may be specified.')
+        self.loc = as_array(loc)
+        if cov is not None:
+            self.cov = as_array(cov)
+        elif precision is not None:
+            self.precision = as_array(precision)
+        else:
+            self.scale_tril = as_array(scale_tril)
+        super().__init__(F=F, event_dim=1, validate_args=validate_args)
+
+    # lazy conversions between the three parameterizations
+    @cached_property
+    def scale_tril(self):
+        if 'cov' in self.__dict__:
+            return np.linalg.cholesky(self.cov)
+        # precision given: L_prec = chol(P); scale_tril = inv(L_prec)^T
+        lp = np.linalg.cholesky(self.precision)
+        eye = np.broadcast_to(np.eye(lp.shape[-1]), lp.shape)
+        return np.swapaxes(np.linalg.trsm(lp, eye), -1, -2)
+
+    @cached_property
+    def cov(self):
+        L = self.scale_tril
+        return np.einsum('...ik,...jk->...ij', L, L)
+
+    @cached_property
+    def precision(self):
+        return np.linalg.inv(self.cov)
+
+    def _batch_shape(self):
+        import numpy as _onp
+        return _onp.broadcast_shapes(self.loc.shape[:-1],
+                                     self.scale_tril.shape[:-2])
+
+    def _half_log_det(self):
+        return np.log(np.diagonal(self.scale_tril, axis1=-2,
+                                  axis2=-1)).sum(-1)
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        k = self.loc.shape[-1]
+        diff = value - self.loc
+        # triangular solve L z = diff (no explicit inverse): the
+        # registered la_op trsm kernel, batched over leading dims
+        L = np.broadcast_to(
+            self.scale_tril, diff.shape[:-1] + self.scale_tril.shape[-2:])
+        z = np.linalg.trsm(L, diff[..., None])[..., 0]
+        maha = (z ** 2).sum(-1)
+        return (-0.5 * (k * math.log(2 * math.pi) + maha)
+                - self._half_log_det())
+
+    def sample(self, size=None):
+        batch = size if size is not None else self._batch_shape()
+        shape = tuple(batch) + self.loc.shape[-1:]
+        eps = np.random.normal(0.0, 1.0, shape)
+        return self.loc + np.einsum('...ij,...j->...i', self.scale_tril,
+                                    eps)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        k = self.loc.shape[-1]
+        new.loc = np.broadcast_to(self.loc, tuple(batch_shape) + (k,))
+        return new
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return np.diagonal(self.cov, axis1=-2, axis2=-1) * \
+            np.ones_like(self.loc)
+
+    def entropy(self):
+        k = self.loc.shape[-1]
+        return (0.5 * k * (1 + math.log(2 * math.pi))
+                + self._half_log_det())

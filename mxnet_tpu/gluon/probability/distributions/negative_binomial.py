@@ -1,0 +1,83 @@
+"""Negative binomial distribution (reference
+``python/mxnet/gluon/probability/distributions/negative_binomial.py`` —
+number of failures before the n-th success; ``prob`` is the success
+probability, matching scipy.stats.nbinom)."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import (UnitInterval, Real, NonNegativeInteger,
+                         PositiveInteger)
+from .utils import (as_array, cached_property, prob2logit, logit2prob,
+                    sample_n_shape_converter, gammaln)
+
+__all__ = ['NegativeBinomial']
+
+
+class NegativeBinomial(Distribution):
+    support = NonNegativeInteger()
+    arg_constraints = {'n': PositiveInteger(), 'prob': UnitInterval(),
+                       'logit': Real()}
+
+    def __init__(self, n, prob=None, logit=None, F=None,
+                 validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                'Either `prob` or `logit` must be specified, but not both.')
+        self.n = as_array(n)
+        if prob is not None:
+            self.prob = as_array(prob)
+        else:
+            self.logit = as_array(logit)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, True)
+
+    def _batch_shape(self):
+        return (self.n + self.prob).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        coef = (gammaln(value + self.n) - gammaln(1 + value)
+                - gammaln(self.n))
+        return (coef + self.n * np.log(self.prob)
+                + value * np.log1p(-self.prob))
+
+    def sample(self, size=None):
+        # gamma–Poisson mixture (the reference op's sampling path,
+        # src/operator/random/sample_op.cc negative_binomial)
+        shape = size if size is not None else self._batch_shape()
+        lam = np.random.gamma(
+            np.broadcast_to(self.n * np.ones_like(self.prob), shape),
+            (1 - self.prob) / self.prob, shape)
+        return np.random.poisson(lam, shape).astype('float32')
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        new.n = np.broadcast_to(self.n, batch_shape)
+        if 'prob' in self.__dict__:
+            new.prob = np.broadcast_to(self.prob, batch_shape)
+            new.__dict__.pop('logit', None)
+        else:
+            new.logit = np.broadcast_to(self.logit, batch_shape)
+            new.__dict__.pop('prob', None)
+        return new
+
+    @property
+    def mean(self):
+        return self.n * (1 - self.prob) / self.prob
+
+    @property
+    def variance(self):
+        return self.n * (1 - self.prob) / self.prob ** 2

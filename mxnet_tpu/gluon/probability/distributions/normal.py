@@ -1,0 +1,76 @@
+"""Normal distribution (reference
+``python/mxnet/gluon/probability/distributions/normal.py``)."""
+
+import math
+
+from .... import numpy as np
+from .exp_family import ExponentialFamily
+from .constraint import Real, Positive
+from .utils import as_array, erf, erfinv
+
+__all__ = ['Normal']
+
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
+
+
+class Normal(ExponentialFamily):
+    has_grad = True
+    support = Real()
+    arg_constraints = {'loc': Real(), 'scale': Positive()}
+
+    def __init__(self, loc=0.0, scale=1.0, F=None, validate_args=None):
+        self.loc = as_array(loc)
+        self.scale = as_array(scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return (self.loc + self.scale).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        z = (value - self.loc) / self.scale
+        return -0.5 * z ** 2 - np.log(self.scale) - _HALF_LOG_2PI
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        eps = np.random.normal(0.0, 1.0, shape)
+        return self.loc + self.scale * eps
+
+    def sample_n(self, size=None):
+        from .utils import sample_n_shape_converter
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'loc', 'scale')
+
+    def cdf(self, value):
+        return 0.5 * (1 + erf((value - self.loc) /
+                              (self.scale * math.sqrt(2))))
+
+    def icdf(self, value):
+        return self.loc + self.scale * math.sqrt(2) * erfinv(2 * value - 1)
+
+    @property
+    def mean(self):
+        return self.loc * np.ones_like(self.scale)
+
+    @property
+    def stddev(self):
+        return self.scale * np.ones_like(self.loc)
+
+    @property
+    def variance(self):
+        return self.stddev ** 2
+
+    def entropy(self):
+        return 0.5 + _HALF_LOG_2PI + np.log(self.scale * np.ones_like(
+            self.loc))
+
+    @property
+    def _natural_params(self):
+        return (self.loc / self.scale ** 2, -0.5 / self.scale ** 2)
+
+    def _log_normalizer(self, x, y):
+        return -0.25 * x ** 2 / y + 0.5 * np.log(-math.pi / y)

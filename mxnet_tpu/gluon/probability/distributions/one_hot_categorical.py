@@ -1,0 +1,71 @@
+"""One-hot categorical distribution (reference
+``python/mxnet/gluon/probability/distributions/one_hot_categorical.py``)."""
+
+from .... import numpy as np
+from .... import numpy_extension as npx
+from .categorical import Categorical
+from .distribution import Distribution
+from .constraint import Simplex, Real
+from .utils import sample_n_shape_converter, sum_right_most
+
+__all__ = ['OneHotCategorical']
+
+
+class OneHotCategorical(Distribution):
+    has_enumerate_support = True
+    support = Simplex()
+    arg_constraints = {'prob': Simplex(), 'logit': Real()}
+
+    def __init__(self, num_events, prob=None, logit=None, F=None,
+                 validate_args=None):
+        self._categorical = Categorical(num_events, prob, logit)
+        self.num_events = self._categorical.num_events
+        super().__init__(F=F, event_dim=1, validate_args=validate_args)
+
+    @property
+    def prob(self):
+        return self._categorical.prob
+
+    @property
+    def logit(self):
+        return self._categorical.logit
+
+    def _batch_shape(self):
+        return self._categorical._batch_shape()
+
+    def log_prob(self, value):
+        logp = npx.log_softmax(self.logit, axis=-1)
+        return sum_right_most(logp * value, 1)
+
+    def sample(self, size=None):
+        idx = self._categorical.sample(size)
+        return npx.one_hot(idx.astype('int32'), self.num_events)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        new._categorical = self._categorical.broadcast_to(batch_shape)
+        return new
+
+    def enumerate_support(self):
+        batch = self._batch_shape()
+        eye = npx.one_hot(np.arange(self.num_events, dtype='int32'),
+                          self.num_events)
+        return eye.reshape((self.num_events,) + (1,) * len(batch)
+                           + (self.num_events,)) * np.ones(
+            (self.num_events,) + batch + (self.num_events,))
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return self.prob * (1 - self.prob)
+
+    def entropy(self):
+        return self._categorical.entropy()

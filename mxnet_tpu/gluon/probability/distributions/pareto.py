@@ -1,0 +1,65 @@
+"""Pareto distribution (reference
+``python/mxnet/gluon/probability/distributions/pareto.py``)."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Positive, dependent_property, GreaterThanEq
+from .utils import as_array, sample_n_shape_converter
+
+__all__ = ['Pareto']
+
+
+class Pareto(Distribution):
+    has_grad = True
+    arg_constraints = {'alpha': Positive(), 'scale': Positive()}
+
+    def __init__(self, alpha, scale=1.0, F=None, validate_args=None):
+        self.alpha = as_array(alpha)
+        self.scale = as_array(scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    @dependent_property
+    def support(self):
+        return GreaterThanEq(self.scale)
+
+    def _batch_shape(self):
+        return (self.alpha + self.scale).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        return (np.log(self.alpha) + self.alpha * np.log(self.scale)
+                - (self.alpha + 1) * np.log(value))
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        u = np.random.uniform(0.0, 1.0, shape)
+        return self.scale * (1 - u) ** (-1 / self.alpha)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'alpha', 'scale')
+
+    def cdf(self, value):
+        return 1 - (self.scale / value) ** self.alpha
+
+    def icdf(self, value):
+        return self.scale * (1 - value) ** (-1 / self.alpha)
+
+    @property
+    def mean(self):
+        m = self.alpha * self.scale / (self.alpha - 1)
+        return np.where(self.alpha > 1, m,
+                        np.full(m.shape, float('inf')))
+
+    @property
+    def variance(self):
+        a = self.alpha
+        v = self.scale ** 2 * a / ((a - 1) ** 2 * (a - 2))
+        return np.where(a > 2, v, np.full(v.shape, float('inf')))
+
+    def entropy(self):
+        return np.log(self.scale / self.alpha) + 1 + 1 / self.alpha

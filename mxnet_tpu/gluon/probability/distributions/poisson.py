@@ -1,0 +1,53 @@
+"""Poisson distribution (reference
+``python/mxnet/gluon/probability/distributions/poisson.py``)."""
+
+from .... import numpy as np
+from .exp_family import ExponentialFamily
+from .constraint import Positive, NonNegativeInteger
+from .utils import as_array, sample_n_shape_converter, gammaln
+
+__all__ = ['Poisson']
+
+
+class Poisson(ExponentialFamily):
+    support = NonNegativeInteger()
+    arg_constraints = {'rate': Positive()}
+
+    def __init__(self, rate=1.0, F=None, validate_args=None):
+        self.rate = as_array(rate)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.rate.shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        return (value * np.log(self.rate) - self.rate
+                - gammaln(value + 1))
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        return np.random.poisson(self.rate, shape).astype('float32')
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'rate')
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    @property
+    def _natural_params(self):
+        return (np.log(self.rate),)
+
+    def _log_normalizer(self, x):
+        return np.exp(x)

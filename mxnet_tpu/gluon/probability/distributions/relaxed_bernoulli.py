@@ -1,0 +1,87 @@
+"""Relaxed Bernoulli / binary Concrete distribution (reference
+``python/mxnet/gluon/probability/distributions/relaxed_bernoulli.py`` —
+Maddison et al., "The Concrete Distribution")."""
+
+from .... import numpy as np
+from .... import numpy_extension as npx
+from .distribution import Distribution
+from .constraint import UnitInterval, Real, OpenInterval
+from .utils import (as_array, cached_property, prob2logit, logit2prob,
+                    sample_n_shape_converter)
+
+__all__ = ['RelaxedBernoulli']
+
+
+class RelaxedBernoulli(Distribution):
+    has_grad = True
+    support = OpenInterval(0, 1)
+    arg_constraints = {'prob': UnitInterval(), 'logit': Real()}
+
+    def __init__(self, T, prob=None, logit=None, F=None,
+                 validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                'Either `prob` or `logit` must be specified, but not both.')
+        self.T = as_array(T)
+        if prob is not None:
+            self.prob = as_array(prob)
+        else:
+            self.logit = as_array(logit)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, True)
+
+    def _batch_shape(self):
+        p = self.__dict__.get('prob')
+        return (p if p is not None else self.logit).shape
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        u = np.clip(np.random.uniform(0.0, 1.0, shape), 1e-7, 1 - 1e-7)
+        logistic = np.log(u) - np.log1p(-u)
+        return npx.sigmoid((self.logit + logistic) / self.T)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        if 'prob' in self.__dict__:
+            new.prob = np.broadcast_to(self.prob, batch_shape)
+            new.__dict__.pop('logit', None)
+        else:
+            new.logit = np.broadcast_to(self.logit, batch_shape)
+            new.__dict__.pop('prob', None)
+        return new
+
+    def log_prob(self, value):
+        """BinConcrete density: log λ + log α − (λ+1)(log y + log(1−y))
+        − 2 log(α y^{−λ} + (1−y)^{−λ})."""
+        if self._validate_args:
+            self._validate_samples(value)
+        lam, alpha_log = self.T, self.logit
+        ly = np.log(value)
+        l1y = np.log1p(-value)
+        # logsumexp of [alpha_log - lam*ly, -lam*l1y]
+        a = alpha_log - lam * ly
+        b = -lam * l1y
+        m = np.maximum(a, b)
+        lse = m + np.log(np.exp(a - m) + np.exp(b - m))
+        return (np.log(lam) + alpha_log - (lam + 1) * (ly + l1y)
+                - 2 * lse)
+
+    @property
+    def mean(self):
+        raise NotImplementedError  # no closed form
+
+    @property
+    def variance(self):
+        raise NotImplementedError

@@ -1,0 +1,92 @@
+"""Relaxed one-hot categorical / Concrete distribution (reference
+``python/mxnet/gluon/probability/distributions/relaxed_one_hot_categorical.py``
+— Gumbel-softmax reparameterization, Jang et al. / Maddison et al.)."""
+
+from .... import numpy as np
+from .... import numpy_extension as npx
+from .distribution import Distribution
+from .constraint import Simplex, Real
+from .utils import (as_array, cached_property, prob2logit, logit2prob,
+                    sample_n_shape_converter, gammaln, sum_right_most)
+
+__all__ = ['RelaxedOneHotCategorical']
+
+
+class RelaxedOneHotCategorical(Distribution):
+    has_grad = True
+    support = Simplex()
+    arg_constraints = {'prob': Simplex(), 'logit': Real()}
+
+    def __init__(self, T, num_events, prob=None, logit=None, F=None,
+                 validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                'Either `prob` or `logit` must be specified, but not both.')
+        self.T = as_array(T)
+        self.num_events = int(num_events)
+        if prob is not None:
+            self.prob = as_array(prob)
+        else:
+            self.logit = as_array(logit)
+        super().__init__(F=F, event_dim=1, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, False)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, False)
+
+    def _batch_shape(self):
+        p = self.__dict__.get('prob')
+        return (p if p is not None else self.logit).shape[:-1]
+
+    def sample(self, size=None):
+        full = (tuple(size) + (self.num_events,)) if size is not None \
+            else self.logit.shape
+        u = np.clip(np.random.uniform(0.0, 1.0, full), 1e-7, 1 - 1e-7)
+        gumbel = -np.log(-np.log(u))
+        return npx.softmax((self.logit + gumbel) / self.T, axis=-1)
+
+    def sample_n(self, size=None):
+        full = sample_n_shape_converter(size) + self._batch_shape()
+        return self.sample(full)
+
+    def broadcast_to(self, batch_shape):
+        import copy
+        new = copy.copy(self)
+        full = tuple(batch_shape) + (self.num_events,)
+        if 'prob' in self.__dict__:
+            new.prob = np.broadcast_to(self.prob, full)
+            new.__dict__.pop('logit', None)
+        else:
+            new.logit = np.broadcast_to(self.logit, full)
+            new.__dict__.pop('prob', None)
+        return new
+
+    def log_prob(self, value):
+        """Concrete density (Maddison et al., eq. 10):
+        log((K−1)!) + (K−1) log λ + Σ(log α_i − (λ+1) log y_i)
+        − K·logsumexp(log α − λ log y)."""
+        if self._validate_args:
+            self._validate_samples(value)
+        k = self.num_events
+        lam = self.T
+        logits = npx.log_softmax(self.logit, axis=-1)
+        ly = np.log(value)
+        score = logits - lam * ly
+        m = score.max(-1, keepdims=True)
+        lse = (m + np.log(np.exp(score - m).sum(-1, keepdims=True)))
+        lse = lse.squeeze(-1)
+        return (gammaln(np.array(float(k))) + (k - 1) * np.log(lam)
+                + sum_right_most(logits - (lam + 1) * ly, 1)
+                - k * lse)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError

@@ -1,0 +1,75 @@
+"""Student's t distribution (reference
+``python/mxnet/gluon/probability/distributions/studentT.py``)."""
+
+import math
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Real, Positive
+from .utils import (as_array, sample_n_shape_converter, gammaln, digamma,
+                    rgamma)
+
+__all__ = ['StudentT']
+
+
+class StudentT(Distribution):
+    has_grad = True
+    support = Real()
+    arg_constraints = {'df': Positive(), 'loc': Real(),
+                       'scale': Positive()}
+
+    def __init__(self, df, loc=0.0, scale=1.0, F=None, validate_args=None):
+        self.df = as_array(df)
+        self.loc = as_array(loc)
+        self.scale = as_array(scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return (self.df + self.loc + self.scale).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        nu = self.df
+        z = (value - self.loc) / self.scale
+        return (gammaln((nu + 1) / 2) - gammaln(nu / 2)
+                - 0.5 * np.log(nu * math.pi) - np.log(self.scale)
+                - (nu + 1) / 2 * np.log1p(z ** 2 / nu))
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        ones = np.ones(shape) if shape else np.array(1.0)
+        nu = np.broadcast_to(self.df * ones, shape)
+        eps = np.random.normal(0.0, 1.0, shape)
+        chi2 = rgamma(nu / 2, shape) * 2
+        return self.loc + self.scale * eps / np.sqrt(chi2 / nu)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'df', 'loc', 'scale')
+
+    @property
+    def mean(self):
+        m = self.loc * np.ones_like(self.df + self.scale)
+        return np.where(self.df > 1, m, np.full(m.shape, float('nan')))
+
+    @property
+    def variance(self):
+        nu = self.df
+        v = self.scale ** 2 * nu / (nu - 2)
+        inf = np.full(v.shape, float('inf'))
+        nan = np.full(v.shape, float('nan'))
+        return np.where(nu > 2, v, np.where(nu > 1, inf, nan))
+
+    def entropy(self):
+        # (nu+1)/2 (psi((nu+1)/2)-psi(nu/2)) + log(sqrt(nu) B(nu/2, 1/2))
+        nu = self.df
+        half = (nu + 1) / 2
+        lbeta = (gammaln(nu / 2) + 0.5 * math.log(math.pi)
+                 - gammaln(half))
+        return (half * (digamma(half) - digamma(nu / 2))
+                + 0.5 * np.log(nu) + lbeta
+                + np.log(self.scale) * np.ones_like(nu))

@@ -1,0 +1,76 @@
+"""TransformedDistribution (reference
+``python/mxnet/gluon/probability/distributions/transformed_distribution.py``
+— push a base distribution through a chain of invertible transforms;
+log_prob walks the chain backwards accumulating log-det-Jacobians)."""
+
+from .distribution import Distribution
+from ..transformation.transformation import Transformation
+from .utils import sum_right_most
+
+__all__ = ['TransformedDistribution']
+
+
+class TransformedDistribution(Distribution):
+
+    def __init__(self, base_dist, transforms, validate_args=None):
+        self._base_dist = base_dist
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self._transforms = list(transforms)
+        event_dim = max([base_dist.event_dim or 0] +
+                        [t.event_dim for t in self._transforms])
+        super().__init__(F=base_dist.F, event_dim=event_dim,
+                         validate_args=validate_args)
+
+    @property
+    def has_grad(self):
+        return self._base_dist.has_grad
+
+    def sample(self, size=None):
+        x = self._base_dist.sample(size)
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+    def sample_n(self, size=None):
+        x = self._base_dist.sample_n(size)
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+    def log_prob(self, value):
+        log_prob = 0.0
+        y = value
+        event_dim = self.event_dim
+        for t in reversed(self._transforms):
+            x = t.inv(y)
+            term = t.log_det_jacobian(x, y)
+            log_prob = log_prob - sum_right_most(
+                term, event_dim - t.event_dim)
+            y = x
+        base_dim = self._base_dist.event_dim or 0
+        log_prob = log_prob + sum_right_most(
+            self._base_dist.log_prob(y), event_dim - base_dim)
+        return log_prob
+
+    def cdf(self, value):
+        y = value
+        sign = 1
+        for t in reversed(self._transforms):
+            y = t.inv(y)
+            sign = sign * t.sign
+        base_cdf = self._base_dist.cdf(y)
+        if isinstance(sign, int) and sign == 1:
+            return base_cdf
+        return sign * (base_cdf - 0.5) + 0.5
+
+    def icdf(self, value):
+        sign = 1
+        for t in self._transforms:
+            sign = sign * t.sign
+        if not (isinstance(sign, int) and sign == 1):
+            value = sign * (value - 0.5) + 0.5
+        x = self._base_dist.icdf(value)
+        for t in self._transforms:
+            x = t(x)
+        return x

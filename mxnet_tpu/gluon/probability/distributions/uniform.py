@@ -1,0 +1,60 @@
+"""Uniform distribution (reference
+``python/mxnet/gluon/probability/distributions/uniform.py``)."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Real, dependent_property, Interval
+from .utils import as_array, sample_n_shape_converter
+
+__all__ = ['Uniform']
+
+
+class Uniform(Distribution):
+    has_grad = True
+    arg_constraints = {'low': Real(), 'high': Real()}
+
+    def __init__(self, low=0.0, high=1.0, F=None, validate_args=None):
+        self.low = as_array(low)
+        self.high = as_array(high)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    @dependent_property
+    def support(self):
+        return Interval(self.low, self.high)
+
+    def _batch_shape(self):
+        return (self.low + self.high).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        return -np.log(self.high - self.low) * np.ones_like(value)
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        u = np.random.uniform(0.0, 1.0, shape)
+        return self.low + (self.high - self.low) * u
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'low', 'high')
+
+    def cdf(self, value):
+        return np.clip((value - self.low) / (self.high - self.low), 0, 1)
+
+    def icdf(self, value):
+        return self.low + (self.high - self.low) * value
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+    def entropy(self):
+        return np.log(self.high - self.low)

@@ -1,0 +1,129 @@
+"""Shared helpers for the probability package.
+
+Reference surface: ``python/mxnet/gluon/probability/distributions/utils.py``
+(prob2logit/logit2prob/getF/sample_n_shape_converter/cached_property and the
+special-function aliases). TPU-native notes: there is one array namespace
+(``mx.np`` over jax), so ``getF`` is a compatibility no-op; special
+functions come from the op registry (XLA kernels); reparameterized gamma
+sampling is registered here as a *differentiable* stochastic op —
+``jax.random.gamma`` carries implicit-reparameterization gradients
+(Figurnov et al.), which the tape records like any other VJP. That single
+op gives pathwise gradients to Gamma/Beta/Dirichlet/Chi2/F/StudentT.
+"""
+
+import math
+
+from .... import numpy as np
+from .... import numpy_extension as npx
+from ....ops.registry import register, invoke, get_op
+from ....ndarray.ndarray import NDArray
+
+__all__ = ['getF', 'prob2logit', 'logit2prob', 'cached_property',
+           'constraint_check', 'sample_n_shape_converter', 'gammaln',
+           'digamma', 'erf', 'erfinv', 'as_array', 'sum_right_most',
+           'rgamma', 'EULER']
+
+EULER = 0.57721566490153286  # Euler–Mascheroni
+
+gammaln = np.gammaln
+digamma = np.digamma
+erf = np.erf
+erfinv = np.erfinv
+
+
+def getF(*params):
+    """Single-namespace build: always ``mx.np`` (kept for API parity with
+    the reference's ndarray/symbol mode switch)."""
+    return np
+
+
+def as_array(x, dtype='float32'):
+    if isinstance(x, NDArray):
+        return x
+    return np.array(x, dtype=dtype)
+
+
+def sum_right_most(value, ndim):
+    """Sum out the rightmost `ndim` dimensions (event reduction)."""
+    if ndim == 0:
+        return value
+    return value.reshape(value.shape[:-ndim] + (-1,)).sum(-1) \
+        if ndim > 1 else value.sum(-1)
+
+
+def prob2logit(prob, binary=True):
+    """Probabilities → logits; binary uses the sigmoid inverse, multiclass
+    the (normalized) log (reference utils.prob2logit)."""
+    prob = as_array(prob)
+    eps = 1e-7
+    prob = np.clip(prob, eps, 1.0 - eps)
+    if binary:
+        return np.log(prob) - np.log1p(-prob)
+    return np.log(prob)
+
+
+def logit2prob(logit, binary=True):
+    logit = as_array(logit)
+    if binary:
+        return npx.sigmoid(logit)
+    return npx.softmax(logit, axis=-1)
+
+
+class cached_property:
+    """Compute once per instance (reference utils.cached_property)."""
+
+    def __init__(self, func):
+        self._func = func
+        self.__doc__ = getattr(func, '__doc__', None)
+        self._name = func.__name__
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        val = self._func(obj)
+        obj.__dict__[self._name] = val
+        return val
+
+
+def constraint_check(condition, err_msg='constraint violated'):
+    """Eager-mode validation: raises when `condition` is concretely false;
+    a no-op under tracing (jit graphs cannot branch on data — the
+    reference's constraint_check op becomes a device-side nan instead).
+    Returns 1.0 so callers can multiply it in, like the reference op."""
+    if isinstance(condition, NDArray):
+        try:
+            ok = bool(condition.asnumpy().all())
+        except Exception:
+            return 1.0  # abstract under trace: skip host check
+        if not ok:
+            raise ValueError(err_msg)
+    elif not condition:
+        raise ValueError(err_msg)
+    return 1.0
+
+
+def sample_n_shape_converter(size):
+    """Normalize `sample_n` size to a tuple prefix."""
+    if size is None:
+        return ()
+    if isinstance(size, (int,)):
+        return (size,)
+    return tuple(size)
+
+
+@register('_prob_gamma_rsample', stochastic=True, differentiable=True,
+          namespaces=())
+def _prob_gamma_rsample(alpha, size=None, key=None):
+    """Reparameterized standard-gamma sample (scale folded in by the
+    caller so its gradient is pure NDArray math)."""
+    import jax
+    import jax.numpy as jnp
+    shape = tuple(size) if size is not None else jnp.shape(alpha)
+    return jax.random.gamma(key, alpha, shape, dtype=jnp.float32)
+
+
+def rgamma(alpha, size=None):
+    """Differentiable Gamma(alpha, 1) sample as an NDArray."""
+    alpha = as_array(alpha)
+    return invoke('_prob_gamma_rsample', (alpha,),
+                  {'size': tuple(size) if size is not None else None})

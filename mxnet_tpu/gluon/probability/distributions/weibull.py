@@ -1,0 +1,65 @@
+"""Weibull distribution (reference
+``python/mxnet/gluon/probability/distributions/weibull.py``)."""
+
+from .... import numpy as np
+from .distribution import Distribution
+from .constraint import Positive
+from .utils import as_array, sample_n_shape_converter, EULER
+
+__all__ = ['Weibull']
+
+
+class Weibull(Distribution):
+    has_grad = True
+    support = Positive()
+    arg_constraints = {'concentration': Positive(), 'scale': Positive()}
+
+    def __init__(self, concentration, scale=1.0, F=None,
+                 validate_args=None):
+        self.concentration = as_array(concentration)
+        self.scale = as_array(scale)
+        super().__init__(F=F, event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return (self.concentration + self.scale).shape
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        k, lam = self.concentration, self.scale
+        z = value / lam
+        return (np.log(k / lam) + (k - 1) * np.log(z) - z ** k)
+
+    def sample(self, size=None):
+        shape = size if size is not None else self._batch_shape()
+        u = np.random.uniform(0.0, 1.0, shape)
+        return self.scale * (-np.log1p(-u)) ** (1 / self.concentration)
+
+    def sample_n(self, size=None):
+        return self.sample(sample_n_shape_converter(size)
+                           + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return self._broadcast_args(batch_shape, 'concentration', 'scale')
+
+    def cdf(self, value):
+        return -np.expm1(-(value / self.scale) ** self.concentration)
+
+    def icdf(self, value):
+        return self.scale * (-np.log1p(-value)) ** (1 / self.concentration)
+
+    @property
+    def mean(self):
+        from .utils import gammaln
+        return self.scale * np.exp(gammaln(1 + 1 / self.concentration))
+
+    @property
+    def variance(self):
+        from .utils import gammaln
+        g1 = np.exp(gammaln(1 + 1 / self.concentration))
+        g2 = np.exp(gammaln(1 + 2 / self.concentration))
+        return self.scale ** 2 * (g2 - g1 ** 2)
+
+    def entropy(self):
+        k, lam = self.concentration, self.scale
+        return EULER * (1 - 1 / k) + np.log(lam / k) + 1

@@ -1,0 +1,5 @@
+"""Transformations (reference
+``python/mxnet/gluon/probability/transformation/__init__.py``)."""
+
+from .transformation import *
+from .domain_map import *

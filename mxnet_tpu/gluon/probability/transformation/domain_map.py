@@ -1,0 +1,103 @@
+"""Constraint → transformation registries (reference
+``python/mxnet/gluon/probability/transformation/domain_map.py`` —
+``biject_to``/``transform_to`` map a constraint object to a bijection
+from unconstrained reals onto that domain; used by variational
+inference to optimize constrained parameters freely)."""
+
+from .transformation import (ComposeTransform, ExpTransform,
+                             AffineTransform, SigmoidTransform,
+                             SoftmaxTransform, StickBreakingTransform,
+                             LowerCholeskyTransform)
+from ..distributions import constraint as C
+
+__all__ = ['biject_to', 'transform_to', 'domain_map']
+
+
+class domain_map:
+    """Decorator-based registry dispatching on constraint type."""
+
+    def __init__(self):
+        self._registry = {}
+
+    def register(self, constraint_type, factory=None):
+        if factory is None:
+            return lambda f: self.register(constraint_type, f)
+        self._registry[constraint_type] = factory
+        return factory
+
+    def __call__(self, cons):
+        for typ in type(cons).__mro__:
+            if typ in self._registry:
+                return self._registry[typ](cons)
+        raise NotImplementedError(
+            f'no transform registered for constraint {cons!r}')
+
+
+biject_to = domain_map()
+transform_to = domain_map()
+
+
+@biject_to.register(C.Positive)
+@transform_to.register(C.Positive)
+def _positive(cons):
+    return ExpTransform()
+
+
+@biject_to.register(C.NonNegative)
+@transform_to.register(C.NonNegative)
+def _nonnegative(cons):
+    return ExpTransform()
+
+
+@biject_to.register(C.GreaterThan)
+@transform_to.register(C.GreaterThan)
+@biject_to.register(C.GreaterThanEq)
+@transform_to.register(C.GreaterThanEq)
+def _greater_than(cons):
+    return ComposeTransform([ExpTransform(),
+                             AffineTransform(cons._low, 1.0)])
+
+
+@biject_to.register(C.LessThan)
+@transform_to.register(C.LessThan)
+@biject_to.register(C.LessThanEq)
+@transform_to.register(C.LessThanEq)
+def _less_than(cons):
+    return ComposeTransform([ExpTransform(),
+                             AffineTransform(cons._high, -1.0)])
+
+
+@biject_to.register(C.Interval)
+@transform_to.register(C.Interval)
+@biject_to.register(C.OpenInterval)
+@transform_to.register(C.OpenInterval)
+@biject_to.register(C.HalfOpenInterval)
+@transform_to.register(C.HalfOpenInterval)
+@biject_to.register(C.UnitInterval)
+@transform_to.register(C.UnitInterval)
+def _interval(cons):
+    low, high = cons._low, cons._high
+    return ComposeTransform([SigmoidTransform(),
+                             AffineTransform(low, high - low)])
+
+
+@biject_to.register(C.Real)
+@transform_to.register(C.Real)
+def _real(cons):
+    return AffineTransform(0.0, 1.0)
+
+
+@transform_to.register(C.Simplex)
+def _simplex(cons):
+    return SoftmaxTransform()
+
+
+@biject_to.register(C.Simplex)
+def _simplex_bijective(cons):
+    return StickBreakingTransform()
+
+
+@biject_to.register(C.LowerCholesky)
+@transform_to.register(C.LowerCholesky)
+def _lower_cholesky(cons):
+    return LowerCholeskyTransform()

@@ -1,0 +1,278 @@
+"""Invertible transformations with log-det-Jacobian tracking.
+
+Reference:
+``python/mxnet/gluon/probability/transformation/transformation.py``
+(Transformation/ComposeTransform/Exp/Affine/Power/Sigmoid/Softmax/Abs +
+TransformBlock). Each transform is pure NDArray math — differentiable
+through the tape and traceable under hybridize/jit.
+"""
+
+from .... import numpy as np
+from .... import numpy_extension as npx
+from ..distributions import constraint
+from ..distributions.utils import as_array, sum_right_most
+from ...block import HybridBlock
+
+__all__ = ['Transformation', 'TransformBlock', 'ComposeTransform',
+           'ExpTransform', 'AffineTransform', 'PowerTransform',
+           'SigmoidTransform', 'SoftmaxTransform', 'AbsTransform',
+           'StickBreakingTransform', 'LowerCholeskyTransform']
+
+
+class Transformation:
+    r"""y = T(x); carries T^{-1} and log|det dT/dx|."""
+
+    bijective = False
+    event_dim = 0
+
+    @property
+    def sign(self):
+        """Sign of the Jacobian determinant (monotone transforms)."""
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self._forward_compute(x)
+
+    def _forward_compute(self, x):
+        raise NotImplementedError
+
+    def _inverse_compute(self, y):
+        raise NotImplementedError
+
+    def log_det_jacobian(self, x, y):
+        raise NotImplementedError
+
+    @property
+    def inv(self):
+        return _InverseTransformation(self)
+
+
+class _InverseTransformation(Transformation):
+    """The inverse of a transformation (reference
+    _InverseTransformation)."""
+
+    def __init__(self, forward_transformation):
+        self._inst = forward_transformation
+
+    @property
+    def inv(self):
+        return self._inst
+
+    @property
+    def sign(self):
+        return self._inst.sign
+
+    @property
+    def event_dim(self):
+        return self._inst.event_dim
+
+    def __call__(self, x):
+        return self._inst._inverse_compute(x)
+
+    def log_det_jacobian(self, x, y):
+        return -self._inst.log_det_jacobian(y, x)
+
+
+class TransformBlock(Transformation, HybridBlock):
+    """A transformation that is also a gluon block — lets transforms own
+    Parameters (e.g. learned flows), reference TransformBlock."""
+
+    def __init__(self, **kwargs):
+        HybridBlock.__init__(self, **kwargs)
+
+
+class ComposeTransform(Transformation):
+    def __init__(self, parts):
+        self._parts = list(parts)
+
+    @property
+    def event_dim(self):
+        return max(p.event_dim for p in self._parts)
+
+    def _forward_compute(self, x):
+        for p in self._parts:
+            x = p(x)
+        return x
+
+    def _inverse_compute(self, y):
+        for p in reversed(self._parts):
+            y = p.inv(y)
+        return y
+
+    @property
+    def inv(self):
+        return ComposeTransform([p.inv for p in reversed(self._parts)])
+
+    def log_det_jacobian(self, x, y):
+        result = 0.0
+        event_dim = self.event_dim
+        xs = [x]
+        for p in self._parts[:-1]:
+            xs.append(p(xs[-1]))
+        xs.append(y)
+        for p, x0, y0 in zip(self._parts, xs[:-1], xs[1:]):
+            term = p.log_det_jacobian(x0, y0)
+            term = sum_right_most(term, event_dim - p.event_dim)
+            result = result + term
+        return result
+
+
+class ExpTransform(Transformation):
+    bijective = True
+    sign = 1
+
+    def _forward_compute(self, x):
+        return np.exp(x)
+
+    def _inverse_compute(self, y):
+        return np.log(y)
+
+    def log_det_jacobian(self, x, y):
+        return x
+
+
+class AffineTransform(Transformation):
+    """y = loc + scale * x."""
+
+    bijective = True
+
+    def __init__(self, loc, scale, event_dim=0):
+        self.loc = as_array(loc)
+        self.scale = as_array(scale)
+        self.event_dim = event_dim
+
+    @property
+    def sign(self):
+        return np.sign(self.scale)
+
+    def _forward_compute(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse_compute(self, y):
+        return (y - self.loc) / self.scale
+
+    def log_det_jacobian(self, x, y):
+        abs_log = np.log(np.abs(self.scale)) * np.ones_like(x)
+        return sum_right_most(abs_log, self.event_dim)
+
+
+class PowerTransform(Transformation):
+    """y = x ** exponent (on positives)."""
+
+    bijective = True
+    sign = 1
+
+    def __init__(self, exponent):
+        self.exponent = as_array(exponent)
+
+    def _forward_compute(self, x):
+        return x ** self.exponent
+
+    def _inverse_compute(self, y):
+        return y ** (1 / self.exponent)
+
+    def log_det_jacobian(self, x, y):
+        return np.log(np.abs(self.exponent * y / x))
+
+
+class SigmoidTransform(Transformation):
+    bijective = True
+    sign = 1
+
+    def _forward_compute(self, x):
+        return npx.sigmoid(x)
+
+    def _inverse_compute(self, y):
+        return np.log(y) - np.log1p(-y)
+
+    def log_det_jacobian(self, x, y):
+        return -npx.softplus(-x) - npx.softplus(x)
+
+
+class SoftmaxTransform(Transformation):
+    """y = softmax(x) — not bijective (projects to the simplex)."""
+
+    event_dim = 1
+
+    def _forward_compute(self, x):
+        return npx.softmax(x, axis=-1)
+
+    def _inverse_compute(self, y):
+        return np.log(y)
+
+
+class AbsTransform(Transformation):
+    def _forward_compute(self, x):
+        return np.abs(x)
+
+    def _inverse_compute(self, y):
+        return y
+
+
+class StickBreakingTransform(Transformation):
+    """Bijection R^{K-1} → interior of the K-simplex via stick-breaking
+    (the `biject_to(Simplex)` map): z_k = sigmoid(x_k − log(K−1−k)),
+    y_k = z_k ∏_{j<k}(1−z_j), y_K = remainder."""
+
+    bijective = True
+    event_dim = 1
+    sign = 1
+
+    @staticmethod
+    def _offset(k_minus_1):
+        return np.log(np.arange(float(k_minus_1), 0.0, -1.0))
+
+    def _forward_compute(self, x):
+        k1 = x.shape[-1]
+        z = npx.sigmoid(x - self._offset(k1))
+        # remainder after each stick break: r_k = prod_{j<k} (1-z_j)
+        log1mz = np.log1p(-z)
+        r = np.exp(np.cumsum(log1mz, axis=-1))
+        r_prev = np.concatenate(
+            [np.ones_like(r[..., :1]), r[..., :-1]], axis=-1)
+        head = z * r_prev
+        tail = r[..., -1:]
+        return np.concatenate([head, tail], axis=-1)
+
+    def _inverse_compute(self, y):
+        k1 = y.shape[-1] - 1
+        head = y[..., :-1]
+        csum = np.cumsum(head, axis=-1)
+        r_prev = 1 - np.concatenate(
+            [np.zeros_like(csum[..., :1]), csum[..., :-1]], axis=-1)
+        z = head / r_prev
+        return np.log(z) - np.log1p(-z) + self._offset(k1)
+
+    def log_det_jacobian(self, x, y):
+        # |det| = prod_k z_k (1-z_k) r_k with r_k = 1 - cumsum(y)_{k-1}
+        k1 = x.shape[-1]
+        u = x - self._offset(k1)
+        head = y[..., :-1]
+        csum = np.cumsum(head, axis=-1)
+        r_prev = 1 - np.concatenate(
+            [np.zeros_like(csum[..., :1]), csum[..., :-1]], axis=-1)
+        return (-npx.softplus(u) - npx.softplus(-u)
+                + np.log(r_prev)).sum(-1)
+
+
+class LowerCholeskyTransform(Transformation):
+    """Unconstrained square matrix → lower-triangular with positive
+    diagonal (the `biject_to(LowerCholesky)` map): keep the strict lower
+    triangle, exponentiate the diagonal."""
+
+    bijective = True
+    event_dim = 2
+    sign = 1
+
+    def _forward_compute(self, x):
+        diag = np.diagonal(x, axis1=-2, axis2=-1)
+        eye = np.eye(x.shape[-1])
+        return np.tril(x, -1) + np.exp(diag)[..., None] * eye
+
+    def _inverse_compute(self, y):
+        diag = np.diagonal(y, axis1=-2, axis2=-1)
+        eye = np.eye(y.shape[-1])
+        return np.tril(y, -1) + np.log(diag)[..., None] * eye
+
+    def log_det_jacobian(self, x, y):
+        return np.diagonal(x, axis1=-2, axis2=-1).sum(-1)
