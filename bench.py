@@ -1,29 +1,76 @@
-"""Headline benchmark. Default: ResNet-50 inference throughput (images/sec).
+"""Headline benchmark. Default: a SUITE — ResNet-50 *training* (the
+BASELINE.json north star) as the primary metric, with inference / BERT /
+kvstore captured in the same JSON line under "extras".
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "...", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "...", "vs_baseline": N,
+     "mfu": ..., "timing_spread": ..., "extras": {...}}
 
 Baseline anchors (BASELINE.md):
+  * ResNet-50 train batch 32: 49.48 img/s on K80 (reference
+    docs/.../faq/perf.md:230) — the only training number the reference
+    publishes.
   * ResNet-50 inference batch 32 on V100 — 1,076.81 img/s fp32 /
-    2,085.51 img/s fp16 (reference docs/.../faq/perf.md:194,208). We bench
-    bf16 (the TPU-native precision) against the reduced-precision number.
+    2,085.51 img/s fp16 (perf.md:194,208). We bench bf16 against the
+    reduced-precision number.
   * BERT-base: no number exists in the reference repo (GluonNLP was a
-    separate project — BASELINE.md last row). vs_baseline anchors to the
-    commonly cited V100 fp16 fine-tune throughput ≈100 samples/s @ seq 128.
+    separate project); vs_baseline anchors to the commonly cited V100
+    fp16 fine-tune throughput ~100 samples/s @ seq 128.
+
+Measurement honesty on the axon dev tunnel (see docs/benchmarking.md):
+  * identical (executable, inputs) executions are served from a content
+    cache -> every timed iteration uses value-distinct inputs;
+  * block_until_ready can return before device-only work runs -> every
+    timed region ends with a result-DEPENDENT host readback that forces
+    the whole chain;
+  * host contention silently swung round-1 numbers 4x -> the timed block
+    runs twice and the spread is reported + warned on.
 
 Run:
-  python bench.py                       # resnet50 inference, bf16, batch 32
-  python bench.py --model bert_base     # BERT-base train step, samples/sec
+  python bench.py                        # suite (train primary)
+  python bench.py --model resnet50_train # train only
+  python bench.py --model resnet50_v1    # inference only
+  python bench.py --model bert_base      # BERT-base train step
   python bench.py --dtype fp32 --batch 64 --cpu
 """
 
 import argparse
 import json
+import os
 import sys
 import time
 
 BASELINES = {'bf16': 2085.51, 'fp32': 1076.81}
-BERT_BASELINE = 100.0  # V100 fp16 fine-tune anchor; none in-repo
+TRAIN_BASELINE = 49.48     # K80 train img/s, perf.md:230
+BERT_BASELINE = 100.0      # V100 fp16 fine-tune anchor; none in-repo
+V5E_BF16_FLOPS = 394e12    # v5e peak bf16 TFLOP/s (MFU denominator)
+# ResNet-50 @224: ~4.09 GFLOPs forward per image (2*MACs convention);
+# training (fwd + bwd) ~= 3x forward
+RESNET50_FWD_FLOPS = 4.09e9
+
+
+def _warn_contention():
+    """Host load check: CPU-bound neighbors silently swung round-1
+    numbers 4x (VERDICT r1 weak #2)."""
+    try:
+        load = os.getloadavg()[0] / (os.cpu_count() or 1)
+    except OSError:
+        return None
+    if load > 0.5:
+        print(f'WARNING: host loadavg/ncpu = {load:.2f} — numbers may be '
+              f'contention-bound, rerun on an idle host', file=sys.stderr)
+    return round(load, 3)
+
+
+def _spread(times):
+    """Relative spread across timed reps; warns when unstable."""
+    s = (max(times) - min(times)) / min(times)
+    if s > 0.2:
+        print(f'WARNING: timing spread {s:.1%} across reps '
+              f'({[round(t, 3) for t in times]}s) — host contention or '
+              f'tunnel variance; treat the number as a lower bound',
+              file=sys.stderr)
+    return round(s, 3)
 
 
 def bench_resnet(args, mx):
@@ -33,18 +80,15 @@ def bench_resnet(args, mx):
     dtype = 'bfloat16' if args.dtype == 'bf16' else 'float32'
     print(f'context: {ctx}, dtype: {dtype}', file=sys.stderr)
 
-    net = getattr(vision, args.model)()
+    model = 'resnet50_v1' if args.model in ('suite', 'resnet50_train') \
+        else args.model
+    net = getattr(vision, model)()   # any model_zoo.vision name
     net.initialize(ctx=ctx)
     net(mx.np.ones((1, 3, 224, 224), ctx=ctx))  # materialize params
     if dtype != 'float32':
         net.cast(dtype)
     net.hybridize(static_alloc=True)
 
-    # every timed iteration gets value-distinct input: the dev tunnel
-    # content-caches (executable, input-values) pairs, so feeding the
-    # same batch every step measures the cache, not the chip. The
-    # per-iteration perturbation is one fused scalar op — noise next to
-    # the conv stack.
     # eps must exceed the bf16 ulp at 1.0 (2^-7): smaller steps quantize
     # away and consecutive iterations degenerate to identical values
     x = mx.np.ones((args.batch, 3, 224, 224), dtype=dtype, ctx=ctx)
@@ -53,25 +97,191 @@ def bench_resnet(args, mx):
     def batch(i):
         return x + eps * float(i + 1)
 
-    for i in range(args.warmup):
-        y = net(batch(i))
-    y.wait_to_read()
+    # primary: K forwards fused into one device program (lax.scan over
+    # pure_function) — chip throughput with the tunnel's per-call RPC
+    # amortized away; the carry chains iterations so nothing caches
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
 
+    pure, in_raws, params, aux = net.pure_function(x, train=False)
+    key = jax.random.PRNGKey(0)
+    deps = jnp.asarray(2.0 ** -6, in_raws[0].dtype)
+
+    def fwd(acc, i):
+        xi = in_raws[0] * (1.0 + deps * i.astype(in_raws[0].dtype)) \
+            + acc.astype(in_raws[0].dtype) * jnp.asarray(
+                1e-12, in_raws[0].dtype)
+        outs, _ = pure(jax.random.fold_in(key, i), (xi,), params, aux)
+        return outs[0][0, 0].astype(jnp.float32), outs[0][0, 0]
+
+    K = args.iters
+    run_dev = jax.jit(lambda a0: lax.scan(fwd, a0, jnp.arange(K)))
+    acc, _ = run_dev(jnp.float32(0.0))
+    float(acc)
+    times = []
+    for rep in range(2):
+        acc, _ = run_dev(acc)           # evolved seed: cache-proof
+        float(acc)                      # dependent readback
+        t0 = time.perf_counter()
+        acc, _ = run_dev(acc + rep + 1)
+        float(acc)
+        times.append(time.perf_counter() - t0)
+
+    ips = args.batch * K / min(times)
+
+    # secondary: per-call dispatch loop (what a user's Python loop sees
+    # through the tunnel; converges with the primary on attached TPUs)
+    def run(base, n):
+        outs = []
+        for i in range(n):
+            outs.append(net(batch(base + i)))
+        acc = outs[0][0, 0]
+        for o in outs[1:]:
+            acc = acc + o[0, 0]
+        return float(acc.asnumpy()), outs
+
+    run(0, max(args.warmup, 1))
     t0 = time.perf_counter()
-    outs = []
-    for i in range(args.iters):
-        outs.append(net(batch(args.warmup + i)))
-    for o in outs:
-        o.wait_to_read()
-    dt = time.perf_counter() - t0
+    run(args.warmup + 1, args.iters)
+    dispatch_ips = args.batch * args.iters / (time.perf_counter() - t0)
 
-    ips = args.batch * args.iters / dt
-    baseline = BASELINES[args.dtype]
-    return {
-        'metric': f'resnet50_inference_{args.dtype}_batch{args.batch}',
+    res = {
+        'metric': f'{model}_inference_{args.dtype}_batch{args.batch}',
         'value': round(ips, 2),
         'unit': 'img/s',
-        'vs_baseline': round(ips / baseline, 3),
+        'timing_spread': _spread(times),
+        'dispatch_img_s': round(dispatch_ips, 2),
+    }
+    if model == 'resnet50_v1':
+        # baseline + FLOP model are resnet50-specific
+        res['vs_baseline'] = round(ips / BASELINES[args.dtype], 3)
+        res['mfu'] = round(ips * RESNET50_FWD_FLOPS / V5E_BF16_FLOPS, 3)
+    return res
+
+
+def bench_resnet_train(args, mx):
+    """ResNet-50 training (fwd+bwd+SGD-momentum), img/s + MFU vs the
+    v5e roofline. Reference anchor: perf.md:230 (49.48 img/s on K80).
+
+    Primary number: K train steps fused into ONE device program
+    (``HybridBlock.pure_function`` + ``lax.scan`` — the TPU-idiomatic
+    training loop; params/momentum/BatchNorm stats ride the scan carry).
+    This is the only measurement that reflects chip throughput through
+    the axon tunnel, whose per-call RPC (~5-20 ms) otherwise swamps any
+    per-step timing. The imperative Trainer path (NDArrayIter feeding,
+    per-step dispatch) is reported as ``imperative_img_s`` for the same
+    workload — on directly-attached TPUs the two converge.
+    """
+    import numpy as onp
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mxnet_tpu import autograd, gluon, io as mxio
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    ctx = mx.current_context()
+    dtype = 'bfloat16' if args.dtype == 'bf16' else 'float32'
+    B = args.batch
+    print(f'context: {ctx}, dtype: {dtype} (train)', file=sys.stderr)
+
+    net = vision.resnet50_v1()
+    net.initialize(ctx=ctx)
+    net(mx.np.ones((1, 3, 224, 224), ctx=ctx))
+    if dtype != 'float32':
+        net.cast(dtype)
+    net.hybridize(static_alloc=True)
+
+    x0 = mx.np.ones((B, 3, 224, 224), dtype=dtype, ctx=ctx)
+    pure, in_raws, params, aux = net.pure_function(x0, train=True)
+    labels = jnp.arange(B, dtype=jnp.int32) % 1000
+    base_key = jax.random.PRNGKey(0)
+    lr, momentum = 0.05, 0.9
+    mom0 = jax.tree.map(lambda w: jnp.zeros_like(w, jnp.float32), params)
+    eps = jnp.asarray(2.0 ** -6, in_raws[0].dtype)  # > bf16 ulp at 1.0
+
+    def step(carry, i):
+        ps, mom, aux_s = carry
+        x = in_raws[0] * (1.0 + eps * i.astype(in_raws[0].dtype))
+
+        def loss_of(ps_):
+            outs, new_aux = pure(jax.random.fold_in(base_key, i),
+                                 (x,), ps_, aux_s)
+            logits = outs[0].astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits)
+            return -logp[jnp.arange(B), labels].mean(), new_aux
+
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(ps)
+        new_mom = jax.tree.map(
+            lambda m, g: momentum * m - lr * g.astype(jnp.float32),
+            mom, grads)
+        new_ps = jax.tree.map(lambda w, m: (w + m).astype(w.dtype),
+                              ps, new_mom)
+        return (new_ps, new_mom, tuple(new_aux)), loss
+
+    K = args.iters
+    run = jax.jit(lambda c: lax.scan(step, c, jnp.arange(K)))
+    carry = (params, mom0, aux)
+    carry, losses = run(carry)
+    assert float(losses[-1]) == float(losses[-1]), 'loss is NaN'
+    times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        carry, losses = run(carry)          # evolved carry: cache-proof
+        float(losses[-1])                   # dependent readback
+        times.append(time.perf_counter() - t0)
+
+    ips = B * K / min(times)
+    mfu = ips * 3 * RESNET50_FWD_FLOPS / V5E_BF16_FLOPS
+    print(f'train throughput {ips:.1f} img/s (device loop), '
+          f'MFU {mfu:.1%} of v5e {V5E_BF16_FLOPS / 1e12:.0f} TFLOP/s',
+          file=sys.stderr)
+
+    # imperative Trainer path on the same workload, fed by NDArrayIter
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': lr, 'momentum': momentum})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = onp.random.default_rng(0)
+    images = rng.standard_normal((B * 2, 3, 224, 224),
+                                 dtype=onp.float32) * 0.1
+    lab = rng.integers(0, 1000, B * 2).astype(onp.float32)
+    epsnd = mx.np.full((1,), 2.0 ** -6, dtype=dtype, ctx=ctx)
+
+    def imperative(n, base):
+        it = mxio.NDArrayIter(images, lab, batch_size=B, shuffle=False)
+        got = 0
+        loss = None
+        while got < n:
+            try:
+                b = next(it)
+            except StopIteration:
+                it.reset()
+                continue
+            with autograd.record():
+                out = net(b.data[0].astype(dtype)
+                          + epsnd * float(base + got)).astype('float32')
+                loss = loss_fn(out, b.label[0]).mean()
+            loss.backward()
+            trainer.step(B)
+            got += 1
+        return float(loss.asnumpy())  # param chain serializes; forces all
+
+    imp_iters = max(min(args.iters // 2, 10), 3)
+    imperative(2, 0)
+    t0 = time.perf_counter()
+    imperative(imp_iters, 100)
+    imp_ips = B * imp_iters / (time.perf_counter() - t0)
+
+    return {
+        'metric': f'resnet50_train_{args.dtype}_batch{B}',
+        'value': round(ips, 2),
+        'unit': 'img/s',
+        'vs_baseline': round(ips / TRAIN_BASELINE, 3),
+        'mfu': round(mfu, 3),
+        'timing_spread': _spread(times),
+        'imperative_img_s': round(imp_ips, 2),
     }
 
 
@@ -115,21 +325,24 @@ def bench_bert(args, mx):
 
     for _ in range(args.warmup):
         loss = step()
-    loss.wait_to_read()
+    float(loss.asnumpy())
 
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        loss = step()
-    loss.wait_to_read()
-    dt = time.perf_counter() - t0
+    times = []
+    for rep in range(2):
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            loss = step()
+        float(loss.asnumpy())  # parameter chain serializes; forces all
+        times.append(time.perf_counter() - t0)
 
-    sps = args.batch * args.iters / dt
+    sps = args.batch * args.iters / min(times)
     return {
         'metric': f'bert_base_train_{args.dtype}_seq{seq_len}'
                   f'_batch{args.batch}',
         'value': round(sps, 2),
         'unit': 'samples/s',
         'vs_baseline': round(sps / BERT_BASELINE, 3),
+        'timing_spread': _spread(times),
     }
 
 
@@ -161,7 +374,7 @@ def bench_llama_decode(args, mx):
     prompt2 = mx.np.array(rng.integers(1, 32000, (1, 32)).astype('float32'))
     t0 = time.perf_counter()
     out = net.generate(prompt2, max_new_tokens=n_new)
-    out.wait_to_read()
+    float(out.asnumpy()[0, -1])  # dependent readback
     dt = time.perf_counter() - t0
     tps = n_new / dt
     return {
@@ -179,17 +392,19 @@ def bench_kvstore(args):
     the closest published transport ceiling)."""
     import io
     import json as _json
-    import os
-    import sys as _sys
     from contextlib import redirect_stdout
 
-    _sys.path.insert(0, os.path.join(os.path.dirname(
+    sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), 'tools', 'bandwidth'))
     import measure
 
     buf = io.StringIO()
     with redirect_stdout(buf):
-        measure.main(['--network', 'resnet50_v1',
+        # device-only: the on-device reduce loop — roofline-relative
+        # bandwidth; the per-key dispatch modes measure mostly tunnel
+        # RPC (see tools/bandwidth/measure.py --help)
+        measure.main(['--network', 'uniform', '--size-mb', '200',
+                      '--replicas', '4', '--device-only',
                       '--num-batches', str(args.iters),
                       '--warmup', str(args.warmup)])
     res = _json.loads(buf.getvalue().strip().splitlines()[-1])
@@ -231,8 +446,10 @@ def bench_yolo(args, mx):
     for i in range(args.iters):
         # offset past every warmup index so no timed input repeats one
         results.append(net(batch_i(args.warmup + 1 + i)))
-    for r in results:
-        r[1].wait_to_read()
+    acc = results[0][1][0, 0]
+    for r in results[1:]:
+        acc = acc + r[1][0, 0]
+    float(acc.asnumpy())            # dependent readback forces all
     dt = time.perf_counter() - t0
     ips = batch * args.iters / dt
     return {
@@ -243,9 +460,35 @@ def bench_yolo(args, mx):
     }
 
 
+def bench_suite(args, mx):
+    """Default: ResNet-50 TRAIN as the primary metric (BASELINE.json
+    north star) + inference / BERT / kvstore in "extras" — one driver-
+    visible artifact carrying the full picture."""
+    import copy
+    result = bench_resnet_train(args, mx)
+    extras = {}
+
+    def sub(name, fn, **over):
+        a = copy.copy(args)
+        for k, v in over.items():
+            setattr(a, k, v)
+        try:
+            r = fn(a, mx) if fn is not bench_kvstore else fn(a)
+            extras[r['metric']] = {k: r[k] for k in
+                                   ('value', 'unit', 'vs_baseline')}
+        except Exception as e:  # a broken extra must not kill the bench
+            print(f'extra bench {name} failed: {e!r}', file=sys.stderr)
+
+    sub('resnet_infer', bench_resnet, model='resnet50_v1')
+    sub('bert', bench_bert, iters=max(args.iters // 5, 5))
+    sub('kvstore', bench_kvstore, iters=10)
+    result['extras'] = extras
+    return result
+
+
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument('--model', default='resnet50_v1')
+    parser.add_argument('--model', default='suite')
     parser.add_argument('--batch', type=int, default=32)
     parser.add_argument('--seq-len', type=int, default=128)
     parser.add_argument('--dtype', default='bf16', choices=['bf16', 'fp32'])
@@ -260,7 +503,12 @@ def main():
 
     import mxnet_tpu as mx
 
-    if args.model in ('bert_base', 'bert', 'bert_12_768_12'):
+    load = _warn_contention()
+    if args.model == 'suite':
+        result = bench_suite(args, mx)
+    elif args.model == 'resnet50_train':
+        result = bench_resnet_train(args, mx)
+    elif args.model in ('bert_base', 'bert', 'bert_12_768_12'):
         result = bench_bert(args, mx)
     elif args.model == 'kvstore':
         result = bench_kvstore(args)
@@ -270,6 +518,8 @@ def main():
         result = bench_yolo(args, mx)
     else:
         result = bench_resnet(args, mx)
+    if load is not None:
+        result['host_load'] = load
     print(json.dumps(result))
 
 

@@ -305,6 +305,20 @@ class _CachedGraph:
     def _build(self, shapes_key, train_mode, n_in, treedef):
         import jax
 
+        pure_fn = self._make_pure(shapes_key, train_mode, treedef)
+        jit_kwargs = {}
+        if self.static_alloc:
+            # donate input buffers (≙ static_alloc persistent buffers)
+            jit_kwargs['donate_argnums'] = ()
+        if self.remat:
+            # recompute activations in backward instead of storing them
+            # (reference backward mirroring, MXNET_BACKWARD_DO_MIRROR)
+            pure_fn = jax.checkpoint(pure_fn)
+        return jax.jit(pure_fn, **jit_kwargs)
+
+    def _make_pure(self, shapes_key, train_mode, treedef):
+        import jax
+
         main, aux = self._params()
 
         def pure_fn(rng_key, in_raws, main_raws, aux_raws):
@@ -341,15 +355,7 @@ class _CachedGraph:
                 _rng.pop_trace_provider()
                 st.aux_writes = prev_aux
 
-        jit_kwargs = {}
-        if self.static_alloc:
-            # donate input buffers (≙ static_alloc persistent buffers)
-            jit_kwargs['donate_argnums'] = ()
-        if self.remat:
-            # recompute activations in backward instead of storing them
-            # (reference backward mirroring, MXNET_BACKWARD_DO_MIRROR)
-            pure_fn = jax.checkpoint(pure_fn)
-        return jax.jit(pure_fn, **jit_kwargs)
+        return pure_fn
 
     def __call__(self, args):
         import jax
@@ -457,6 +463,35 @@ class HybridBlock(Block):
         the whole graph; this hybridizes + warms the cache."""
         self.hybridize(True)
         return self(x, *args)
+
+    def pure_function(self, *args, train=False):
+        """Export this block's forward as a pure jax function — the
+        TPU-idiomatic escape hatch for building fully-fused training
+        programs (lax.scan over steps, pjit over meshes) where the
+        per-step Python dispatch of the imperative path would dominate.
+
+        Returns ``(fn, in_raws, main_raws, aux_raws)`` with
+        ``fn(rng_key, in_raws, main_raws, aux_raws) ->
+        (out_raws_tuple, new_aux_raws_tuple)`` pure and traceable.
+        ``main_raws`` are the trainable parameters (grad_req != 'null'),
+        ``aux_raws`` the rest (e.g. BatchNorm running stats — returned
+        updated when ``train=True``). No reference analog: CachedOp has
+        no user-facing pure form; this is new TPU-first surface."""
+        import jax
+        if not isinstance(self._cached_graph, _CachedGraph):
+            self.hybridize(True)
+        graph = self._cached_graph
+        if not self._first_forward_done:
+            self(*args)  # materialize deferred params
+        leaves, treedef = jax.tree.flatten(
+            args, is_leaf=lambda x: isinstance(x, NDArray))
+        in_raws = tuple(x._data if isinstance(x, NDArray)
+                        else array(x)._data for x in leaves)
+        main, aux = graph._params()
+        fn = graph._make_pure(None, train, treedef)
+        main_raws = tuple(p.data()._data for p in main)
+        aux_raws = tuple(p.data()._data for p in aux)
+        return fn, in_raws, main_raws, aux_raws
 
     def infer_shape(self, *args):
         """Reference block.py:1278 — resolve deferred parameter shapes from

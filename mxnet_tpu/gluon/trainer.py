@@ -308,6 +308,11 @@ class Trainer:
                                 else e for e in tmpl)
                         nw, ns = opt.step(w, g, st, lrs_[j], wds_[j],
                                           ts_[j])
+                        # keep the stored weight dtype stable across
+                        # steps (bf16-cast nets: math promotes to f32,
+                        # the parameter itself must stay bf16)
+                        if nw.dtype != w.dtype:
+                            nw = nw.astype(w.dtype)
                         new_ws.append(nw)
                         if ns is None:
                             new_ss.append([])

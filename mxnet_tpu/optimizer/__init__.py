@@ -149,6 +149,11 @@ class Optimizer:
         t = self._index_update_count[index]
         new_w, new_state = self.step(weight._data, grad._data, state, lr, wd,
                                      t)
+        # update math may promote (e.g. f32 lr x bf16 weight); the stored
+        # weight keeps its dtype (reference kernels write in-place in the
+        # weight's dtype — a bf16-cast net must stay bf16 across steps)
+        if new_w.dtype != weight._data.dtype:
+            new_w = new_w.astype(weight._data.dtype)
         weight._rebind(new_w)
         self._write_state(state, new_state)
 

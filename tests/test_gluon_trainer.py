@@ -110,3 +110,33 @@ def test_trainer_allreduce_and_update_split():
     loss.backward()
     trainer.allreduce_grads()
     trainer.update(2)
+
+
+def test_bf16_cast_net_keeps_dtype_across_steps():
+    """A bf16-cast net must still be bf16 after trainer.step — round-2
+    regression: momentum math promoted weights to f32 after step 1,
+    breaking the cached graph's dtype signature."""
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    net(mx.np.ones((1, 3)))
+    net.cast('bfloat16')
+    trainer = mx.gluon.Trainer(net.collect_params(), 'sgd',
+                               {'learning_rate': 0.1, 'momentum': 0.9})
+    x = mx.np.ones((2, 3), dtype='bfloat16')
+    from mxnet_tpu import autograd
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(x).astype('float32') ** 2).sum()
+        loss.backward()
+        trainer.step(2)
+    assert str(net.weight.data().dtype) == 'bfloat16'
+    # per-param (non-fused) path too
+    net2 = mx.gluon.nn.Dense(4, in_units=3)
+    net2.initialize()
+    net2(mx.np.ones((1, 3)))
+    net2.cast('bfloat16')
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    state = opt.create_state(0, net2.weight.data())
+    g = mx.np.ones(net2.weight.shape, dtype='bfloat16')
+    opt.update(0, net2.weight.data(), g, state)
+    assert str(net2.weight.data().dtype) == 'bfloat16'
