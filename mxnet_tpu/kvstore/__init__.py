@@ -14,6 +14,7 @@ code run unchanged.
 from .base import KVStoreBase
 from .kvstore import KVStore, KVStoreLocal
 from .tpu import KVStoreTPUSync, Horovod, BytePS
+from .dist_async import KVStoreDistAsync
 
 
 def create(name='local'):

@@ -35,7 +35,6 @@ class KVStoreBase:
             'nccl': 'device',
             'dist': 'dist_tpu_sync',
             'dist_sync': 'dist_tpu_sync',
-            'dist_async': 'dist_tpu_sync',
             'dist_sync_device': 'dist_tpu_sync',
             'dist_device_sync': 'dist_tpu_sync',
         }

@@ -214,6 +214,15 @@ class KVStoreLocal(KVStoreBase):
         from .gradient_compression import GradientCompression
         gc = GradientCompression()
         gc.set_params(compression_params)
+        if gc.active and type(self) in (KVStoreLocal, KVStoreDevice):
+            # the reference raises for kvstore types without compression
+            # support (kvstore.cc); we accept for API parity but make
+            # the no-op visible
+            import warnings
+            warnings.warn(
+                f'gradient compression is a no-op on the {self.NAME!r} '
+                'kvstore: it applies only on the distributed hop '
+                '(dist_tpu_sync)', UserWarning, stacklevel=2)
         self._gc = gc
 
     @property

@@ -330,8 +330,12 @@ class KVStoreTPUSync(KVStoreLocal):
 
 @register
 class Horovod(KVStoreTPUSync):
-    """Horovod-compatible plugin surface (reference
-    python/mxnet/kvstore/horovod.py:25) backed by the same XLA allreduce."""
+    """COMPAT ALIAS, not a Horovod binding: scripts written against the
+    reference's Horovod plugin surface (python/mxnet/kvstore/horovod.py:25
+    — broadcast/pushpull/local_rank) run unchanged, backed by the same
+    allreduce topology Horovod would execute, but over XLA collectives.
+    No hvd transport exists in this zero-egress image; a real binding
+    would register here via KVStoreBase.register."""
 
     NAME = 'horovod'
 
@@ -342,6 +346,7 @@ class Horovod(KVStoreTPUSync):
 
 @register
 class BytePS(KVStoreTPUSync):
-    """BytePS plugin surface (reference python/mxnet/kvstore/byteps.py:45)."""
+    """COMPAT ALIAS for the BytePS plugin surface (reference
+    python/mxnet/kvstore/byteps.py:45) — see Horovod note above."""
 
     NAME = 'byteps'

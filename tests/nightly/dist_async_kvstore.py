@@ -1,0 +1,73 @@
+"""dist_async worker script (reference
+``tests/nightly/dist_async_kvstore.py`` — launched by
+``tools/launch.py -n 2 --launcher local``).
+
+Asserts the async contract: per-push immediate server-side updates (no
+worker merge barrier), server-side optimizer via ``set_optimizer``, and
+eventual consistency after an explicit barrier.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import _cpu_guard  # noqa: E402
+_cpu_guard.force_cpu()
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import kvstore  # noqa: E402
+
+
+def main():
+    kv = kvstore.create('dist_async')
+    rank, size = kv.rank, kv.num_workers
+    assert kv.type == 'dist_async'
+    assert size == int(os.environ.get('MX_NPROC', '1'))
+
+    # --- init + barrier: rank 0's value is authoritative
+    kv.init('w', mx.np.zeros((4,)))
+    kv.barrier()
+
+    # --- per-push immediate accumulation: after each rank pushes once
+    # and all ranks rendezvous, the store holds the FULL sum — proving
+    # every push applied on arrival without waiting for a merge quorum
+    kv.push('w', mx.np.ones((4,)) * (rank + 1))
+    kv.barrier()
+    got = kv.pull('w').asnumpy()
+    want = sum(r + 1.0 for r in range(size))
+    onp.testing.assert_allclose(got, onp.full((4,), want), rtol=1e-6)
+
+    # --- asynchronous pushpull: the pulled value must contain AT LEAST
+    # this worker's own push (it may or may not include concurrent
+    # peers' — the staleness contract)
+    kv.barrier()
+    out = mx.np.zeros((4,))
+    kv.pushpull('w', mx.np.ones((4,)), out=out)
+    assert (out.asnumpy() >= want + 1.0 - 1e-5).all()
+    kv.barrier()
+    final = kv.pull('w').asnumpy()
+    onp.testing.assert_allclose(final, onp.full((4,), want + size),
+                                rtol=1e-6)
+
+    # --- server-side optimizer: updates applied per push, immediately
+    kv2 = kvstore.create('dist_async')
+    if rank == 0:
+        kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv2.barrier()
+    kv2.init('x', mx.np.ones((3,)) * 10.0)
+    kv2.barrier()
+    kv2.push('x', mx.np.ones((3,)))          # w <- w - 0.5*1, per push
+    kv2.barrier()
+    got = kv2.pull('x').asnumpy()
+    onp.testing.assert_allclose(got, onp.full((3,), 10.0 - 0.5 * size),
+                                rtol=1e-6)
+
+    print(f'worker {rank}/{size}: all dist_async assertions passed',
+          flush=True)
+
+
+if __name__ == '__main__':
+    main()

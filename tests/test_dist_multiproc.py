@@ -55,3 +55,22 @@ def test_two_process_dist_training_convergence():
     assert res.returncode == 0, out[-4000:]
     for r in range(2):
         assert f'worker {r}/2: dist training converged' in out
+
+
+@pytest.mark.timeout(240)
+def test_two_process_dist_async_kvstore():
+    """dist_async: per-push immediate server updates, no worker merge
+    barrier (reference kvstore_dist_server.h:325-349 async branch;
+    tests/nightly/dist_async_kvstore.py analog)."""
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'tools', 'launch.py'),
+         '-n', '2', '--launcher', 'local', '--port', '49913',
+         sys.executable,
+         os.path.join(ROOT, 'tests', 'nightly', 'dist_async_kvstore.py')],
+        capture_output=True, text=True, timeout=220, env=env, cwd=ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    for r in range(2):
+        assert f'worker {r}/2: all dist_async assertions passed' in out
