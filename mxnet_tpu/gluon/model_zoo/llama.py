@@ -287,7 +287,14 @@ class LlamaForCausalLM(HybridBlock):
             else jnp.asarray(token_ids)
         toks = toks.astype(jnp.int32)
         B, S = toks.shape
-        L = max_length or min(self.cfg.max_length, S + max_new_tokens)
+        # default cache length is sized from the power-of-two-rounded
+        # decode budget (not the tight S + max_new_tokens), so
+        # varying-length generate() calls land on a handful of compiled
+        # (cache-shape, scan-length) programs instead of one per n
+        n_pow2 = 1
+        while n_pow2 < max(max_new_tokens - 1, 1):
+            n_pow2 *= 2
+        L = max_length or min(self.cfg.max_length, S + n_pow2 + 1)
         assert S + max_new_tokens <= L, 'max_length too small'
 
         params = self.collect_params()
@@ -327,10 +334,7 @@ class LlamaForCausalLM(HybridBlock):
         # generate() calls hit a handful of compiled programs instead of
         # one per distinct n.
         n_rest = max_new_tokens - 1
-        n_pad = 1
-        while n_pad < n_rest:
-            n_pad *= 2
-        n_pad = min(n_pad, L - S - 1)
+        n_pad = min(n_pow2, L - S - 1)
         psig = (B, S, L, float(temperature))
         dsig = psig + (n_pad,)
         steps = getattr(self, '_gen_steps', None)

@@ -50,16 +50,21 @@ class RPN(HybridBlock):
 class FasterRCNN(HybridBlock):
     """Two-stage detector: RPN proposals → ROIAlign → 2-FC head.
 
-    Inference returns ``(ids, scores, boxes)`` of fixed shape
-    (B, post_nms * classes kept via per-class NMS topk). Training mode
-    (autograd recording) returns the raw stage outputs for the loss:
+    Inference returns ``(ids, scores, boxes)`` with a fixed candidate
+    axis of ``min(post_nms * classes, pre_nms)`` entries: the raw
+    per-class candidates are first cut to the ``pre_nms`` best by score
+    (one top-k, keeps the quadratic NMS IoU matrix HBM-sized) before
+    per-class NMS keeps ``nms_topk`` each. Training mode (autograd
+    recording) returns the raw stage outputs for the loss:
     ``(rpn_cls_raw, rpn_reg, cls_scores, bbox_deltas, rois)``.
     """
 
     def __init__(self, classes=20, rpn_channels=512, post_nms=128,
                  scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
-                 nms_thresh=0.5, nms_topk=100, roi_size=7, **kwargs):
+                 nms_thresh=0.5, nms_topk=100, roi_size=7, pre_nms=400,
+                 **kwargs):
         super().__init__(**kwargs)
+        self._pre_nms = pre_nms
         self._classes = classes
         self._post_nms = post_nms
         self._scales = scales
@@ -134,7 +139,8 @@ class FasterRCNN(HybridBlock):
                    [mnp.expand_dims(cls_ids, -1),
                     mnp.expand_dims(probs, -1), boxes], axis=-1)
         dets = dets.reshape(B, R * C, 6)
-        return nms_detection_output(dets, self._nms_thresh, self._nms_topk)
+        return nms_detection_output(dets, self._nms_thresh, self._nms_topk,
+                                    pre_nms=self._pre_nms)
 
 
 def faster_rcnn_resnet50_v1(classes=20, **kwargs):

@@ -25,3 +25,4 @@ from . import contrib       # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import fft_ops       # noqa: F401
 from . import quantization_ops  # noqa: F401
+from . import legacy_ops    # noqa: F401

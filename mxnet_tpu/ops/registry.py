@@ -167,7 +167,19 @@ def invoke(op_name, args, kwargs):
     for i, a in enumerate(args):
         if isinstance(a, NDArray):
             if i in op.static_argnums:
-                consts[i] = a._data   # bake concrete; no grad, no tracing
+                # bake concrete; no grad, no tracing. Under abstract
+                # tracing the value is a tracer — baking it would leak
+                # it into a "constant"; raise DynamicShapeError so
+                # _CachedGraph falls back to eager (today only
+                # boolean_mask hits this, which also sets
+                # dynamic_shape=True; this assert makes the invariant
+                # explicit rather than incidental)
+                import jax.core as _jc
+                if not _jc.is_concrete(a._data):
+                    raise DynamicShapeError(
+                        f'op {op.name!r}: static NDArray argument '
+                        f'{i} must be concrete, got a traced value')
+                consts[i] = a._data
             else:
                 arr_slots.append((i, None))
                 arrays.append(a)

@@ -1,0 +1,317 @@
+"""Functional tests for the round-2 op-ledger additions.
+
+Reference behaviors: optimizer_op.cc (ftml/mp/multi/preloaded families),
+contrib/{quadratic,gradient_multiplier,stes,bounding_box,index_array,
+hawkes_ll}.cc, tensor/amp_cast.cc, image/image_random.cc,
+roi_pooling.cc, deformable_convolution.cc.
+"""
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ops.registry import invoke
+
+
+def _inv(name, *args, **kw):
+    return invoke(name, args, kw)
+
+
+def test_legacy_broadcast_and_elemwise_names():
+    a = mx.np.array([[1.0, 2.0]])
+    b = mx.np.array([[3.0], [4.0]])
+    out = mx.nd.broadcast_add(a, b)
+    onp.testing.assert_allclose(out.asnumpy(), [[4, 5], [5, 6]])
+    out = mx.nd.broadcast_maximum(a, b)
+    onp.testing.assert_allclose(out.asnumpy(), [[3, 3], [4, 4]])
+    out = mx.nd.elemwise_mul(mx.np.array([2.0]), mx.np.array([3.0]))
+    onp.testing.assert_allclose(out.asnumpy(), [6.0])
+    out = mx.nd.broadcast_lesser(a, b)
+    onp.testing.assert_allclose(out.asnumpy(), [[1, 1], [1, 1]])
+
+
+def test_slice_and_broadcast_axis():
+    x = mx.np.arange(24).reshape(2, 3, 4)
+    out = _inv('slice', x, begin=(0, 1), end=(2, 3))
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy()[0:2, 1:3])
+    y = mx.np.ones((1, 3, 1))
+    out = _inv('broadcast_axis', y, axis=(0, 2), size=(2, 5))
+    assert out.shape == (2, 3, 5)
+
+
+def test_softsign_and_square_sum():
+    x = mx.np.array([-2.0, 0.0, 3.0])
+    onp.testing.assert_allclose(_inv('softsign', x).asnumpy(),
+                                [-2 / 3, 0, 0.75])
+    onp.testing.assert_allclose(
+        _inv('square_sum', x).asnumpy(), 13.0)
+
+
+def test_amp_cast_multicast():
+    x = mx.np.ones((2,), dtype='float32')
+    y = mx.np.ones((2,), dtype='bfloat16')
+    out = _inv('amp_cast', x, dtype='bfloat16')
+    assert str(out.dtype) == 'bfloat16'
+    a, b = _inv('amp_multicast', x, y)
+    assert str(a.dtype) == str(b.dtype) == 'float32'
+    a, b = _inv('amp_multicast', x, y, cast_narrow=True)
+    assert str(a.dtype) == str(b.dtype) == 'bfloat16'
+
+
+def test_quadratic_and_stes_grads():
+    x = mx.np.array([1.0, -2.0])
+    out = _inv('quadratic', x, a=1.0, b=2.0, c=3.0)
+    onp.testing.assert_allclose(out.asnumpy(), [6.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = _inv('round_ste', x * 1.7)
+        loss = y.sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [1.7, 1.7])  # STE
+    x2 = mx.np.array([0.5])
+    x2.attach_grad()
+    with autograd.record():
+        loss = _inv('gradient_multiplier', x2, scalar=-3.0).sum()
+    loss.backward()
+    onp.testing.assert_allclose(x2.grad.asnumpy(), [-3.0])
+
+
+def test_div_sqrt_dim_index_array_edge_id():
+    x = mx.np.ones((2, 16))
+    onp.testing.assert_allclose(_inv('div_sqrt_dim', x).asnumpy(),
+                                onp.full((2, 16), 0.25))
+    idx = _inv('index_array', mx.np.zeros((2, 3)))
+    assert idx.shape == (2, 3, 2)
+    onp.testing.assert_allclose(idx.asnumpy()[1, 2], [1, 2])
+    adj = mx.np.array([[0.0, 5.0], [7.0, 0.0]])
+    out = _inv('edge_id', adj, mx.np.array([0, 1]), mx.np.array([1, 0]))
+    onp.testing.assert_allclose(out.asnumpy(), [5.0, 7.0])
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = mx.np.array([[[0.0, 0.0, 2.0, 2.0],
+                            [1.0, 1.0, 3.0, 4.0]]])
+    refs = mx.np.array([[[0.5, 0.5, 2.5, 2.5],
+                         [1.0, 1.0, 3.0, 4.0]]])
+    samples = mx.np.array([[1.0, 1.0]])
+    matches = mx.np.array([[0, 1]])
+    t, mask = _inv('box_encode', samples, matches, anchors, refs)
+    assert t.shape == (1, 2, 4) and mask.asnumpy().min() == 1.0
+    dec = _inv('box_decode', t, anchors)
+    onp.testing.assert_allclose(dec.asnumpy(), refs.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_roi_pooling():
+    data = mx.np.arange(32).reshape(1, 2, 4, 4)
+    rois = mx.np.array([[0.0, 0.0, 0.0, 3.0, 3.0]])
+    out = _inv('roi_pooling', data, rois, pooled_size=(2, 2),
+               spatial_scale=1.0)
+    assert out.shape == (1, 2, 2, 2)
+    onp.testing.assert_allclose(out.asnumpy()[0, 0],
+                                [[5, 7], [13, 15]])
+
+
+def test_ftml_and_mp_updates():
+    w = mx.np.ones((3,))
+    g = mx.np.ones((3,)) * 0.1
+    d = mx.np.zeros((3,))
+    v = mx.np.zeros((3,))
+    z = mx.np.zeros((3,))
+    nw, nd_, nv, nz = _inv('ftml_update', w, g, d, v, z, lr=0.1, t=1)
+    assert onp.isfinite(nw.asnumpy()).all()
+    # mp sgd: bf16 weight, fp32 master
+    wb = mx.np.ones((3,), dtype='bfloat16')
+    w32 = mx.np.ones((3,))
+    mom = mx.np.zeros((3,))
+    out = _inv('mp_nag_mom_update', wb, g, mom, w32, lr=0.1,
+               momentum=0.9)
+    assert str(out[0].dtype) == 'bfloat16'
+    onp.testing.assert_allclose(out[2].asnumpy(),
+                                1 - 0.1 * (0.1 + 0.9 * 0.1), rtol=1e-5)
+
+
+def test_multi_and_preloaded_sgd():
+    ws = [mx.np.ones((2,)) * (i + 1) for i in range(3)]
+    gs = [mx.np.ones((2,)) * 0.5 for _ in range(3)]
+    lrs = mx.np.array([0.1, 0.2, 0.3])
+    wds = mx.np.zeros((3,))
+    flat = []
+    for w, g in zip(ws, gs):
+        flat += [w, g]
+    outs = _inv('preloaded_multi_sgd_update', *(flat + [lrs, wds]),
+                num_weights=3)
+    for i, o in enumerate(outs):
+        onp.testing.assert_allclose(
+            o.asnumpy(), (i + 1) - [0.1, 0.2, 0.3][i] * 0.5, rtol=1e-6)
+    # mp variant with momentum
+    flat = []
+    for i in range(2):
+        flat += [mx.np.ones((2,), dtype='bfloat16'),
+                 mx.np.ones((2,)) * 0.5, mx.np.zeros((2,)),
+                 mx.np.ones((2,))]
+    outs = _inv('preloaded_multi_mp_sgd_mom_update',
+                *(flat + [mx.np.array([0.1, 0.1]), mx.np.zeros((2,))]),
+                momentum=0.9, num_weights=2)
+    assert len(outs) == 6
+    onp.testing.assert_allclose(outs[2].asnumpy(), 0.95, rtol=1e-5)
+
+
+def test_multi_lamb_lans_adamw():
+    arrays = []
+    for i in range(2):
+        arrays += [mx.np.ones((4,)), mx.np.ones((4,)) * 0.01,
+                   mx.np.zeros((4,)), mx.np.zeros((4,))]
+    outs = _inv('multi_lamb_update', *arrays,
+                learning_rates=[0.01, 0.01], wds=[0.0, 0.0],
+                step_count=[1, 1], num_tensors=2)
+    assert len(outs) == 6
+    assert (outs[0].asnumpy() < 1.0).all()
+    outs = _inv('multi_lans_update', *arrays,
+                learning_rates=[0.01, 0.01], wds=[0.0, 0.0],
+                step_count=[1, 1], num_tensors=2)
+    assert onp.isfinite(outs[0].asnumpy()).all()
+    outs = _inv('multi_adamw_update', *arrays,
+                learning_rates=[0.01, 0.01], wds=[0.01, 0.01],
+                etas=[1.0, 1.0], num_tensors=2)
+    assert (outs[0].asnumpy() < 1.0).all()
+
+
+def test_multi_all_finite_and_lars():
+    good = [mx.np.ones((3,)), mx.np.ones((2,))]
+    bad = [mx.np.ones((3,)), mx.np.array([1.0, float('inf')])]
+    assert _inv('multi_all_finite', *good).asnumpy()[0] == 1.0
+    assert _inv('multi_all_finite', *bad).asnumpy()[0] == 0.0
+    lrs = _inv('multi_lars', mx.np.array([0.1, 0.1]),
+               mx.np.array([4.0, 1.0]), mx.np.array([1.0, 1.0]),
+               mx.np.array([0.0, 0.0]), eta=0.01)
+    onp.testing.assert_allclose(lrs.asnumpy(),
+                                [0.1 * 0.01 * 2 / 1, 0.1 * 0.01],
+                                rtol=1e-4)
+
+
+def test_sparse_adagrad_update():
+    w = mx.np.ones((4,))
+    g = mx.np.ones((4,)) * 2.0
+    h = mx.np.zeros((4,))
+    nw, nh = _inv('sparse_adagrad_update', w, g, h, lr=0.1)
+    onp.testing.assert_allclose(nh.asnumpy(), 4.0)
+    onp.testing.assert_allclose(nw.asnumpy(), 1 - 0.1 * 2 / 2.0,
+                                rtol=1e-4)
+
+
+def test_image_ops():
+    img = mx.np.array(onp.arange(48).reshape(4, 4, 3).astype('f'))
+    t = _inv('image_to_tensor', img)
+    assert t.shape == (3, 4, 4)
+    assert abs(float(t.asnumpy().max()) - 47 / 255) < 1e-6
+    n = _inv('image_normalize', t, mean=(0.5, 0.5, 0.5),
+             std=(0.5, 0.5, 0.5))
+    assert n.shape == (3, 4, 4)
+    c = _inv('image_crop', img, 1, 1, 2, 2)
+    assert c.shape == (2, 2, 3)
+    onp.testing.assert_allclose(c.asnumpy()[0, 0], img.asnumpy()[1, 1])
+    rc = _inv('image_random_crop', img, size=(2, 2))
+    assert rc.shape == (2, 2, 3)
+    rrc = _inv('image_random_resized_crop', img, size=(3, 3))
+    assert rrc.shape == (3, 3, 3)
+
+
+def test_extract_make_trian_roundtrip():
+    A = mx.np.array(onp.arange(9).reshape(3, 3).astype('f'))
+    v = _inv('extracttrian', A)
+    assert v.shape == (6,)
+    B = _inv('maketrian', v)
+    onp.testing.assert_allclose(B.asnumpy(), onp.tril(A.asnumpy()))
+
+
+def test_generalized_negative_binomial_sample():
+    mx.random.seed(0)
+    s = _inv('sample_generalized_negative_binomial',
+             mx.np.array([5.0]), mx.np.array([0.1]), shape=(2000,))
+    m = float(s.asnumpy().mean())
+    assert abs(m - 5.0) < 0.5
+
+
+def test_hawkesll_finite_and_state():
+    mu = mx.np.ones((2, 3)) * 0.5
+    alpha = mx.np.array([0.2, 0.2, 0.2])
+    beta = mx.np.array([1.0, 1.0, 1.0])
+    state = mx.np.zeros((2, 3))
+    lags = mx.np.array(onp.full((2, 5), 0.3, 'f'))
+    marks = mx.np.array(onp.random.RandomState(0).randint(0, 3, (2, 5)))
+    vl = mx.np.array([5.0, 3.0])
+    mt = mx.np.array([2.0, 2.0])
+    ll, new_state = _inv('hawkesll', mu, alpha, beta, state, lags,
+                         marks, vl, mt)
+    assert ll.shape == (2,) and onp.isfinite(ll.asnumpy()).all()
+    assert (new_state.asnumpy() >= 0).all()
+    # more events in the window -> different LL
+    assert ll.asnumpy()[0] != ll.asnumpy()[1]
+
+
+def test_deformable_convolution_matches_plain_conv_at_zero_offset():
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.randn(1, 2, 5, 5).astype('f'))
+    w = mx.np.array(rng.randn(3, 2, 3, 3).astype('f'))
+    off = mx.np.zeros((1, 18, 3, 3))
+    out = _inv('deformable_convolution', x, off, w, kernel=(3, 3),
+               num_filter=3, no_bias=True)
+    ref = _inv('convolution', x, w, kernel=(3, 3), num_filter=3,
+               no_bias=True)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_identity_attach_kl_sparse_reg():
+    x = mx.np.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = _inv('identity_attach_kl_sparse_reg', x,
+                 sparseness_target=0.2, penalty=0.01)
+        loss = y.sum()
+    loss.backward()
+    onp.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    assert onp.isfinite(x.grad.asnumpy()).all()
+
+
+def test_calibrate_entropy_runs():
+    import numpy as np
+    hist, edges = np.histogram(np.random.RandomState(0).randn(10000),
+                               bins=256)
+    thr, div = _inv('calibrate_entropy', mx.np.array(hist.astype('f')),
+                    mx.np.array(edges.astype('f')),
+                    num_quantized_bins=255)
+    assert 0 < float(thr.asnumpy()) < 5
+
+
+def test_multi_all_finite_init_output_false():
+    """init_output=False ANDs into the previous flag (last array)."""
+    prev_ok = mx.np.ones((1,))
+    prev_bad = mx.np.zeros((1,))
+    a = mx.np.ones((3,))
+    assert _inv('multi_all_finite', a, prev_ok,
+                init_output=False).asnumpy()[0] == 1.0
+    assert _inv('multi_all_finite', a, prev_bad,
+                init_output=False).asnumpy()[0] == 0.0
+
+
+def test_hawkesll_padding_is_noop():
+    """Entries past valid_length must not decay the state (round-2
+    review regression)."""
+    mu = mx.np.ones((1, 2)) * 0.5
+    alpha = mx.np.array([0.3, 0.3])
+    beta = mx.np.array([1.0, 1.0])
+    state = mx.np.zeros((1, 2))
+    marks = mx.np.array([[0, 1, 0, 1]])
+    vl = mx.np.array([2.0])
+    mt = mx.np.array([1.5])
+    lags_zero_pad = mx.np.array([[0.5, 0.5, 0.0, 0.0]])
+    lags_junk_pad = mx.np.array([[0.5, 0.5, 9.9, 9.9]])
+    ll0, st0 = _inv('hawkesll', mu, alpha, beta, state, lags_zero_pad,
+                    marks, vl, mt)
+    ll1, st1 = _inv('hawkesll', mu, alpha, beta, state, lags_junk_pad,
+                    marks, vl, mt)
+    onp.testing.assert_allclose(ll0.asnumpy(), ll1.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(st0.asnumpy(), st1.asnumpy(), rtol=1e-6)

@@ -1,0 +1,54 @@
+"""Operator-parity ledger test (VERDICT r1 item 5).
+
+Every ``NNVM_REGISTER_OP`` name extracted from the reference
+(``fixtures/reference_nnvm_ops.txt``, 806 unique names from the 584+
+registration sites incl. .cu re-registrations) must be implemented or
+carry an explicit design-mapping in ``mxnet_tpu/ops/ledger.py``. Zero
+silent gaps.
+"""
+
+import os
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import ledger, registry
+
+FIXTURE = os.path.join(os.path.dirname(__file__), 'fixtures',
+                       'reference_nnvm_ops.txt')
+
+
+def _frontends():
+    return [mx.np, mx.npx, mx.nd, mx.np.random, mx.np.linalg,
+            mx.npx.random if hasattr(mx.npx, 'random') else mx.np.random]
+
+
+def test_every_reference_op_accounted():
+    names = [l.strip() for l in open(FIXTURE) if l.strip()]
+    assert len(names) > 780  # fixture sanity
+    regs = set(registry.list_ops())
+    fes = _frontends()
+    missing = []
+    stats = {'implemented': 0, 'design-mapped': 0}
+    for n in names:
+        status, _ = ledger.account(n, regs, fes)
+        if status == 'MISSING':
+            missing.append(n)
+        else:
+            stats[status] += 1
+    assert not missing, (
+        f'{len(missing)} reference ops unaccounted '
+        f'(implement or add to ops/ledger.py with a reason): {missing}')
+    # the ledger must stay mostly real implementations, not mappings
+    assert stats['implemented'] > 400, stats
+
+
+def test_ledger_aliases_resolve():
+    """Every implemented-alias target actually exists."""
+    regs = set(registry.list_ops())
+    fes = _frontends()
+    dead = []
+    for src, dst in ledger.ALIASES.items():
+        if dst.startswith('__'):
+            continue  # python protocol (getitem/setitem) — always present
+        if dst not in regs and not any(hasattr(ns, dst) for ns in fes):
+            dead.append((src, dst))
+    assert not dead, f'alias targets missing: {dead}'
