@@ -2,3 +2,6 @@
 
 from . import estimator
 from . import cnn
+from . import rnn
+from . import nn
+from . import data

@@ -1,0 +1,6 @@
+"""Contrib datasets/samplers (reference
+python/mxnet/gluon/contrib/data/__init__.py)."""
+
+from .sampler import IntervalSampler
+from . import text
+from . import vision

@@ -1,0 +1,79 @@
+"""Contrib text datasets (reference
+``python/mxnet/gluon/contrib/data/text.py`` — WikiText language-model
+datasets).
+
+Zero-egress build: datasets load from local files only (pass ``root``
+pointing at pre-downloaded ``wiki.{train,validation,test}.tokens``);
+the reference's download path raises a clear error here instead of
+fetching. Tokenization/vocabulary come from ``contrib.text``.
+"""
+
+import os
+
+import numpy as onp
+
+from ...data.dataset import SimpleDataset
+from ....contrib import text as _text
+
+__all__ = ['WikiText2', 'WikiText103']
+
+
+class _LanguageModelDataset(SimpleDataset):
+    """Token-id sequence dataset cut into `seq_len` windows (reference
+    _LanguageModelDataset + _WikiText behavior)."""
+
+    def __init__(self, root, segment, seq_len, namespace, vocab=None):
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = seq_len
+        self._namespace = namespace
+        path = self._find_file()
+        tokens = self._tokenize(path)
+        if vocab is None:
+            counter = _text.utils.count_tokens_from_str(' '.join(tokens))
+            vocab = _text.vocab.Vocabulary(counter, most_freq_count=None,
+                                           min_freq=1)
+        # shared across segments: pass the train split's vocabulary when
+        # building validation/test so token ids line up (reference
+        # _LanguageModelDataset vocab parameter)
+        self.vocabulary = vocab
+        ids = onp.asarray(self.vocabulary.to_indices(tokens),
+                          dtype=onp.int32)
+        n = (len(ids) - 1) // seq_len
+        data = ids[:n * seq_len].reshape(n, seq_len)
+        target = ids[1:n * seq_len + 1].reshape(n, seq_len)
+        super().__init__(list(zip(data, target)))
+
+    def _find_file(self):
+        for name in (f'wiki.{self._segment}.tokens',
+                     f'{self._segment}.txt'):
+            p = os.path.join(self._root, name)
+            if os.path.exists(p):
+                return p
+        raise FileNotFoundError(
+            f'{self._namespace}: no local data under {self._root!r} '
+            f'(zero-egress build — place wiki.{self._segment}.tokens '
+            'there; the reference would download it)')
+
+    @staticmethod
+    def _tokenize(path):
+        with open(path, encoding='utf-8') as f:
+            return f.read().replace('\n', ' <eos> ').split()
+
+
+class WikiText2(_LanguageModelDataset):
+    """WikiText-2 (reference contrib/data/text.py:WikiText2)."""
+
+    def __init__(self, root='~/.mxnet/datasets/wikitext-2',
+                 segment='train', seq_len=35, vocab=None):
+        super().__init__(root, segment, seq_len, 'wikitext-2',
+                         vocab=vocab)
+
+
+class WikiText103(_LanguageModelDataset):
+    """WikiText-103 (reference contrib/data/text.py:WikiText103)."""
+
+    def __init__(self, root='~/.mxnet/datasets/wikitext-103',
+                 segment='train', seq_len=35, vocab=None):
+        super().__init__(root, segment, seq_len, 'wikitext-103',
+                         vocab=vocab)

@@ -1,0 +1,4 @@
+"""Contrib vision data (reference
+python/mxnet/gluon/contrib/data/vision/__init__.py)."""
+
+from . import transforms

@@ -1,0 +1,4 @@
+"""Contrib vision transforms (reference
+python/mxnet/gluon/contrib/data/vision/transforms/__init__.py)."""
+
+from . import bbox

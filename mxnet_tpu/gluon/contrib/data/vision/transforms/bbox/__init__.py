@@ -1,0 +1,5 @@
+"""Image+bbox joint transforms (reference
+python/mxnet/gluon/contrib/data/vision/transforms/bbox/__init__.py)."""
+
+from .bbox import *
+from . import utils

@@ -1,0 +1,128 @@
+"""Joint image+bbox transform blocks (reference
+``python/mxnet/gluon/contrib/data/vision/transforms/bbox/bbox.py``).
+
+Each block takes ``(image HWC, bbox (N, 4+))`` and returns the
+transformed pair — the detection-pipeline counterpart of the plain
+vision transforms. Image math runs through ``mx.np``; box math is the
+host-side utils module (tiny arrays, pipeline stage).
+"""
+
+import random as _random
+
+import numpy as onp
+
+from mxnet_tpu.ndarray.ndarray import NDArray, array
+from mxnet_tpu.gluon.block import Block
+
+from . import utils
+
+__all__ = ['ImageBboxRandomFlipLeftRight', 'ImageBboxCrop',
+           'ImageBboxRandomCropWithConstraints', 'ImageBboxRandomExpand',
+           'ImageBboxResize']
+
+
+def _hw(img):
+    return img.shape[0], img.shape[1]
+
+
+class ImageBboxRandomFlipLeftRight(Block):
+    """Flip image+boxes horizontally with probability p (reference
+    ImageBboxRandomFlipLeftRight)."""
+
+    def __init__(self, p=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+
+    def forward(self, img, bbox):
+        if _random.random() < self.p:
+            img = img[:, ::-1, :]
+            h, w = _hw(img)
+            bbox = array(utils.bbox_flip(
+                bbox.asnumpy() if isinstance(bbox, NDArray) else bbox,
+                (w, h), flip_x=True))
+        return img, bbox
+
+
+class ImageBboxCrop(Block):
+    """Fixed crop (x, y, w, h) of image+boxes (reference ImageBboxCrop)."""
+
+    def __init__(self, crop, allow_outside_center=False, **kwargs):
+        super().__init__(**kwargs)
+        self._crop = crop
+        self._allow = allow_outside_center
+
+    def forward(self, img, bbox):
+        x, y, w, h = self._crop
+        img = img[y:y + h, x:x + w, :]
+        raw = bbox.asnumpy() if isinstance(bbox, NDArray) else bbox
+        return img, array(utils.bbox_crop(raw, (x, y, w, h),
+                                          self._allow))
+
+
+class ImageBboxRandomCropWithConstraints(Block):
+    """SSD-style constrained random crop (reference
+    ImageBboxRandomCropWithConstraints)."""
+
+    def __init__(self, min_scale=0.3, max_scale=1.0, max_aspect_ratio=2,
+                 constraints=None, max_trial=50, **kwargs):
+        super().__init__(**kwargs)
+        self._kw = dict(min_scale=min_scale, max_scale=max_scale,
+                        max_aspect_ratio=max_aspect_ratio,
+                        constraints=constraints, max_trial=max_trial)
+
+    def forward(self, img, bbox):
+        h, w = _hw(img)
+        raw = bbox.asnumpy() if isinstance(bbox, NDArray) else bbox
+        new_bbox, crop = utils.bbox_random_crop_with_constraints(
+            raw, (w, h), **self._kw)
+        x, y, cw, ch = crop
+        return img[y:y + ch, x:x + cw, :], array(new_bbox)
+
+
+class ImageBboxRandomExpand(Block):
+    """Place the image on a larger mean-filled canvas, shifting boxes
+    (reference ImageBboxRandomExpand — the SSD zoom-out augment)."""
+
+    def __init__(self, max_ratio=4, fill=0, keep_ratio=True, **kwargs):
+        super().__init__(**kwargs)
+        self._max_ratio = max_ratio
+        self._fill = fill
+        self._keep = keep_ratio
+
+    def forward(self, img, bbox):
+        if self._max_ratio <= 1:
+            return img, bbox
+        h, w = _hw(img)
+        ratio_x = _random.uniform(1, self._max_ratio)
+        ratio_y = ratio_x if self._keep else _random.uniform(
+            1, self._max_ratio)
+        oh, ow = int(h * ratio_y), int(w * ratio_x)
+        off_y = _random.randint(0, oh - h)
+        off_x = _random.randint(0, ow - w)
+        raw_img = img.asnumpy() if isinstance(img, NDArray) else \
+            onp.asarray(img)
+        canvas = onp.full((oh, ow, raw_img.shape[-1]), self._fill,
+                          raw_img.dtype)
+        canvas[off_y:off_y + h, off_x:off_x + w, :] = raw_img
+        raw = bbox.asnumpy() if isinstance(bbox, NDArray) else bbox
+        return array(canvas), array(utils.bbox_translate(
+            raw, x_offset=off_x, y_offset=off_y))
+
+
+class ImageBboxResize(Block):
+    """Resize image+boxes to (width, height) (reference
+    ImageBboxResize)."""
+
+    def __init__(self, width, height, interpolation=1, **kwargs):
+        super().__init__(**kwargs)
+        self._size = (width, height)
+        self._interp = interpolation
+
+    def forward(self, img, bbox):
+        h, w = _hw(img)
+        from mxnet_tpu.image import imresize
+        img = imresize(img if isinstance(img, NDArray) else array(img),
+                       self._size[0], self._size[1],
+                       interp=self._interp)
+        raw = bbox.asnumpy() if isinstance(bbox, NDArray) else bbox
+        return img, array(utils.bbox_resize(raw, (w, h), self._size))

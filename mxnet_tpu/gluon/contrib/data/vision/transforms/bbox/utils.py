@@ -1,0 +1,167 @@
+"""Bounding-box transform utilities (reference
+``python/mxnet/gluon/contrib/data/vision/transforms/bbox/utils.py``).
+
+Host-side numpy math: these run in the data pipeline before batches
+reach the device (boxes are tiny; shipping them through XLA per sample
+would cost more in dispatch than compute). Boxes are ``(N, 4+)`` arrays
+in corner ``xmin, ymin, xmax, ymax`` layout unless stated otherwise.
+"""
+
+import random as _random
+
+import numpy as np
+
+__all__ = ['bbox_crop', 'bbox_flip', 'bbox_resize', 'bbox_translate',
+           'bbox_iou', 'bbox_xywh_to_xyxy', 'bbox_xyxy_to_xywh',
+           'bbox_clip_xyxy', 'bbox_random_crop_with_constraints']
+
+
+def _check(bbox):
+    bbox = np.asarray(bbox, np.float32)
+    if bbox.ndim != 2 or bbox.shape[1] < 4:
+        raise ValueError(f'bbox must be (N, 4+), got {bbox.shape}')
+    return bbox
+
+
+def bbox_crop(bbox, crop_box=None, allow_outside_center=True):
+    """Crop boxes to a window, dropping the ones that vanish
+    (reference utils.bbox_crop)."""
+    bbox = _check(bbox).copy()
+    if crop_box is None:
+        return bbox
+    if sum(c is None for c in crop_box) == 4:
+        return bbox
+    l, t, w, h = crop_box
+    left = l or 0
+    top = t or 0
+    right = left + (w or np.inf)
+    bottom = top + (h or np.inf)
+    window = np.array([left, top, right, bottom], np.float32)
+    if allow_outside_center:
+        mask = np.ones(bbox.shape[0], dtype=bool)
+    else:
+        centers = (bbox[:, :2] + bbox[:, 2:4]) / 2
+        mask = np.logical_and(window[:2] <= centers,
+                              centers < window[2:]).all(axis=1)
+    bbox[:, :2] = np.maximum(bbox[:, :2], window[:2])
+    bbox[:, 2:4] = np.minimum(bbox[:, 2:4], window[2:])
+    bbox[:, :2] -= window[:2]
+    bbox[:, 2:4] -= window[:2]
+    mask = np.logical_and(mask, (bbox[:, :2] < bbox[:, 2:4]).all(axis=1))
+    return bbox[mask]
+
+
+def bbox_flip(bbox, size, flip_x=False, flip_y=False):
+    """Mirror boxes inside a (width, height) canvas (reference
+    utils.bbox_flip)."""
+    bbox = _check(bbox).copy()
+    width, height = size
+    if flip_x:
+        xmax = width - bbox[:, 0]
+        xmin = width - bbox[:, 2]
+        bbox[:, 0], bbox[:, 2] = xmin, xmax
+    if flip_y:
+        ymax = height - bbox[:, 1]
+        ymin = height - bbox[:, 3]
+        bbox[:, 1], bbox[:, 3] = ymin, ymax
+    return bbox
+
+
+def bbox_resize(bbox, in_size, out_size):
+    """Rescale boxes from in_size (w, h) to out_size (reference
+    utils.bbox_resize)."""
+    bbox = _check(bbox).copy()
+    sx = out_size[0] / in_size[0]
+    sy = out_size[1] / in_size[1]
+    bbox[:, [0, 2]] *= sx
+    bbox[:, [1, 3]] *= sy
+    return bbox
+
+
+def bbox_translate(bbox, x_offset=0, y_offset=0):
+    bbox = _check(bbox).copy()
+    bbox[:, [0, 2]] += float(x_offset)
+    bbox[:, [1, 3]] += float(y_offset)
+    return bbox
+
+
+def bbox_iou(bbox_a, bbox_b, offset=0):
+    """Pairwise IoU matrix (N, M) (reference utils.bbox_iou)."""
+    a = np.asarray(bbox_a, np.float32)
+    b = np.asarray(bbox_b, np.float32)
+    tl = np.maximum(a[:, None, :2], b[None, :, :2])
+    br = np.minimum(a[:, None, 2:4], b[None, :, 2:4])
+    inter = np.prod(np.maximum(br - tl + offset, 0), axis=2)
+    area_a = np.prod(a[:, 2:4] - a[:, :2] + offset, axis=1)
+    area_b = np.prod(b[:, 2:4] - b[:, :2] + offset, axis=1)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / np.maximum(union, 1e-12)
+
+
+def bbox_xywh_to_xyxy(xywh):
+    x = np.asarray(xywh, np.float32)
+    out = x.copy()
+    out[..., 2] = x[..., 0] + np.maximum(0, x[..., 2] - 1)
+    out[..., 3] = x[..., 1] + np.maximum(0, x[..., 3] - 1)
+    return out
+
+
+def bbox_xyxy_to_xywh(xyxy):
+    x = np.asarray(xyxy, np.float32)
+    out = x.copy()
+    out[..., 2] = x[..., 2] - x[..., 0] + 1
+    out[..., 3] = x[..., 3] - x[..., 1] + 1
+    return out
+
+
+def bbox_clip_xyxy(xyxy, width, height):
+    x = np.asarray(xyxy, np.float32).copy()
+    x[..., 0] = np.clip(x[..., 0], 0, width - 1)
+    x[..., 1] = np.clip(x[..., 1], 0, height - 1)
+    x[..., 2] = np.clip(x[..., 2], 0, width - 1)
+    x[..., 3] = np.clip(x[..., 3], 0, height - 1)
+    return x
+
+
+def bbox_random_crop_with_constraints(bbox, size, min_scale=0.3,
+                                      max_scale=1, max_aspect_ratio=2,
+                                      constraints=None, max_trial=50):
+    """SSD-style constrained random crop (reference
+    utils.bbox_random_crop_with_constraints): sample candidate windows
+    until one satisfies a minimum-IoU constraint with some box."""
+    if constraints is None:
+        constraints = ((0.1, None), (0.3, None), (0.5, None),
+                       (0.7, None), (0.9, None), (None, 1))
+    w, h = size
+    bbox = _check(bbox)
+    candidates = [(0, 0, w, h)]
+    for min_iou, max_iou in constraints:
+        min_iou = -np.inf if min_iou is None else min_iou
+        max_iou = np.inf if max_iou is None else max_iou
+        for _ in range(max_trial):
+            scale = _random.uniform(min_scale, max_scale)
+            aspect = _random.uniform(
+                max(1 / max_aspect_ratio, scale * scale),
+                min(max_aspect_ratio, 1 / (scale * scale)))
+            crop_h = int(h * scale / np.sqrt(aspect))
+            crop_w = int(w * scale * np.sqrt(aspect))
+            if crop_w > w or crop_h > h:
+                continue
+            crop_t = _random.randrange(h - crop_h + 1)
+            crop_l = _random.randrange(w - crop_w + 1)
+            crop_bb = np.array([[crop_l, crop_t, crop_l + crop_w,
+                                 crop_t + crop_h]], np.float32)
+            if len(bbox) == 0:
+                return bbox, (crop_l, crop_t, crop_w, crop_h)
+            iou = bbox_iou(bbox, crop_bb)
+            if min_iou <= iou.min() and iou.max() <= max_iou:
+                candidates.append((crop_l, crop_t, crop_w, crop_h))
+                break
+    # pick a candidate that keeps at least one box
+    while candidates:
+        crop = candidates.pop(int(_random.random()
+                                  * len(candidates)))
+        new_bbox = bbox_crop(bbox, crop, allow_outside_center=False)
+        if len(new_bbox):
+            return new_bbox, crop
+    return bbox, (0, 0, w, h)

@@ -13,9 +13,12 @@ class Estimator:
     """Reference estimator.py:Estimator."""
 
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
-                 trainer=None, context=None, devices=None):
+                 trainer=None, context=None, devices=None,
+                 batch_processor=None):
+        from .batch_processor import BatchProcessor
         self.net = net
         self.loss = loss
+        self.batch_processor = batch_processor or BatchProcessor()
         tm = train_metrics or [Accuracy()]
         if not isinstance(tm, list):
             tm = [tm]
@@ -37,9 +40,9 @@ class Estimator:
         for metric in self.val_metrics:
             metric.reset()
         for batch in val_data or []:
-            data, label = batch[0], batch[1]
-            pred = self.net(data)
-            loss = self.loss(pred, label)
+            data, label, pred, loss = \
+                self.batch_processor.evaluate_batch(self, batch,
+                                                    batch_axis)
             for metric in self.val_metrics:
                 if isinstance(metric, LossMetric):
                     metric.update(0, loss)
@@ -48,7 +51,6 @@ class Estimator:
 
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None, batch_axis=0):
-        from .... import autograd
         from ...trainer import Trainer
 
         self.max_epoch = epochs or 1
@@ -69,13 +71,11 @@ class Estimator:
             for h in epoch_begin:
                 h.epoch_begin(self)
             for batch in train_data:
-                data, label = batch[0], batch[1]
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
+                data, label, pred, loss = \
+                    self.batch_processor.fit_batch(self, batch,
+                                                   batch_axis)
                 self.trainer.step(data.shape[batch_axis])
                 for h in batch_end:
                     h.batch_end(self, batch=batch, pred=pred, label=label,

@@ -1,0 +1,5 @@
+"""Contrib layers (reference
+``python/mxnet/gluon/contrib/nn/__init__.py``)."""
+
+from .basic_layers import *
+from ...nn import SyncBatchNorm  # reference keeps it here; main nn owns it

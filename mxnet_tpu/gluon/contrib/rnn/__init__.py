@@ -1,0 +1,5 @@
+"""Contrib RNN cells (reference
+``python/mxnet/gluon/contrib/rnn/__init__.py``)."""
+
+from .conv_rnn_cell import *
+from .rnn_cell import *

@@ -1,0 +1,198 @@
+"""Convolutional recurrent cells (reference
+``python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py`` —
+Conv{RNN,LSTM,GRU}Cell for 1D/2D/3D inputs).
+
+TPU design: each timestep is two convolutions (input→hidden,
+hidden→hidden) + gate math; under ``unroll`` the whole sequence becomes
+one traced graph, so XLA batches the convs onto the MXU and fuses the
+gate elementwise ops — no per-step dispatch.
+"""
+
+from ...rnn.rnn_cell import RecurrentCell, _op
+
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    """Shared conv machinery. `input_shape` is (C, spatial...) without
+    the batch axis; `dims` = number of spatial dims."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                 i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, dims,
+                 conv_layout, activation, **kwargs):
+        super().__init__(**kwargs)
+        default_layout = 'NC' + 'DHW'[3 - dims:]
+        if conv_layout != default_layout:
+            raise ValueError(
+                f'only {default_layout!r} conv_layout is supported '
+                f'(channels-first is the TPU-native layout; got '
+                f'{conv_layout!r})')
+        self._input_shape = tuple(input_shape)
+        self._hidden_channels = hidden_channels
+        self._dims = dims
+        self._activation = activation
+        self._i2h_kernel = _tuple(i2h_kernel, dims)
+        self._h2h_kernel = _tuple(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise ValueError(
+                    f'h2h_kernel must be odd to keep spatial dims, got '
+                    f'{self._h2h_kernel}')
+        self._i2h_pad = _tuple(i2h_pad, dims)
+        self._i2h_dilate = _tuple(i2h_dilate, dims)
+        self._h2h_dilate = _tuple(h2h_dilate, dims)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+
+        from ...parameter import Parameter
+        ng = self._num_gates
+        in_c = self._input_shape[0]
+        self.i2h_weight = Parameter(
+            'i2h_weight',
+            shape=(ng * hidden_channels, in_c) + self._i2h_kernel,
+            init=i2h_weight_initializer)
+        self.h2h_weight = Parameter(
+            'h2h_weight',
+            shape=(ng * hidden_channels, hidden_channels)
+            + self._h2h_kernel,
+            init=h2h_weight_initializer)
+        self.i2h_bias = Parameter('i2h_bias',
+                                  shape=(ng * hidden_channels,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter('h2h_bias',
+                                  shape=(ng * hidden_channels,),
+                                  init=h2h_bias_initializer)
+
+    @property
+    def _num_gates(self):
+        raise NotImplementedError
+
+    def _state_shape(self):
+        # i2h output spatial dims define the state spatial dims
+        spatial = []
+        for i, s in enumerate(self._input_shape[1:]):
+            k, p, d = (self._i2h_kernel[i], self._i2h_pad[i],
+                       self._i2h_dilate[i])
+            spatial.append((s + 2 * p - d * (k - 1) - 1) + 1)
+        return (self._hidden_channels,) + tuple(spatial)
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size,) + self._state_shape()
+        return [{'shape': shape} for _ in range(self._num_states)]
+
+    @property
+    def _num_states(self):
+        return 1
+
+    def _convs(self, inputs, state):
+        ng = self._num_gates
+        i2h = _op('convolution', inputs, self.i2h_weight.data(),
+                  self.i2h_bias.data(), kernel=self._i2h_kernel,
+                  pad=self._i2h_pad, dilate=self._i2h_dilate,
+                  num_filter=ng * self._hidden_channels)
+        h2h = _op('convolution', state, self.h2h_weight.data(),
+                  self.h2h_bias.data(), kernel=self._h2h_kernel,
+                  pad=self._h2h_pad, dilate=self._h2h_dilate,
+                  num_filter=ng * self._hidden_channels)
+        return i2h, h2h
+
+    def _act(self, x):
+        return _op('activation', x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _num_gates = 1
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        out = self._act(i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _num_gates = 4
+    _num_states = 2
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        gates = i2h + h2h
+        c = self._hidden_channels
+        sl = [slice(None)] * gates.ndim
+        def g(j):
+            sl[1] = slice(j * c, (j + 1) * c)
+            return gates[tuple(sl)]
+        i = _op('sigmoid', g(0))
+        f = _op('sigmoid', g(1))
+        gg = self._act(g(2))
+        o = _op('sigmoid', g(3))
+        next_c = f * states[1] + i * gg
+        out = o * self._act(next_c)
+        return out, [out, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _num_gates = 3
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        c = self._hidden_channels
+        sl = [slice(None)] * i2h.ndim
+        def g(x, j):
+            sl[1] = slice(j * c, (j + 1) * c)
+            return x[tuple(sl)]
+        r = _op('sigmoid', g(i2h, 0) + g(h2h, 0))
+        z = _op('sigmoid', g(i2h, 1) + g(h2h, 1))
+        n = self._act(g(i2h, 2) + r * g(h2h, 2))
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+def _make(base, dims, name, doc):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     i2h_weight_initializer=None,
+                     h2h_weight_initializer=None,
+                     i2h_bias_initializer='zeros',
+                     h2h_bias_initializer='zeros',
+                     conv_layout='NC' + 'DHW'[3 - dims:],
+                     activation='tanh', **kwargs):
+            super().__init__(
+                input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                i2h_pad, i2h_dilate, h2h_dilate, i2h_weight_initializer,
+                h2h_weight_initializer, i2h_bias_initializer,
+                h2h_bias_initializer, dims, conv_layout, activation,
+                **kwargs)
+
+    Cell.__name__ = Cell.__qualname__ = name
+    Cell.__doc__ = doc
+    return Cell
+
+
+_REF = ('reference python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py')
+Conv1DRNNCell = _make(_ConvRNNCell, 1, 'Conv1DRNNCell',
+                      f'1D convolutional RNN cell ({_REF}).')
+Conv2DRNNCell = _make(_ConvRNNCell, 2, 'Conv2DRNNCell',
+                      f'2D convolutional RNN cell ({_REF}).')
+Conv3DRNNCell = _make(_ConvRNNCell, 3, 'Conv3DRNNCell',
+                      f'3D convolutional RNN cell ({_REF}).')
+Conv1DLSTMCell = _make(_ConvLSTMCell, 1, 'Conv1DLSTMCell',
+                       f'1D ConvLSTM cell (Shi et al.; {_REF}).')
+Conv2DLSTMCell = _make(_ConvLSTMCell, 2, 'Conv2DLSTMCell',
+                       f'2D ConvLSTM cell (Shi et al.; {_REF}).')
+Conv3DLSTMCell = _make(_ConvLSTMCell, 3, 'Conv3DLSTMCell',
+                       f'3D ConvLSTM cell (Shi et al.; {_REF}).')
+Conv1DGRUCell = _make(_ConvGRUCell, 1, 'Conv1DGRUCell',
+                      f'1D convolutional GRU cell ({_REF}).')
+Conv2DGRUCell = _make(_ConvGRUCell, 2, 'Conv2DGRUCell',
+                      f'2D convolutional GRU cell ({_REF}).')
+Conv3DGRUCell = _make(_ConvGRUCell, 3, 'Conv3DGRUCell',
+                      f'3D convolutional GRU cell ({_REF}).')
+
+__all__ = ['Conv1DRNNCell', 'Conv2DRNNCell', 'Conv3DRNNCell',
+           'Conv1DLSTMCell', 'Conv2DLSTMCell', 'Conv3DLSTMCell',
+           'Conv1DGRUCell', 'Conv2DGRUCell', 'Conv3DGRUCell']

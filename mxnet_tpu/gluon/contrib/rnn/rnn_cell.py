@@ -1,0 +1,129 @@
+"""Contrib recurrent cells (reference
+``python/mxnet/gluon/contrib/rnn/rnn_cell.py`` — VariationalDropoutCell
+and LSTMPCell)."""
+
+from ...parameter import Parameter
+from ...rnn.rnn_cell import ModifierCell, RecurrentCell, _op
+from .... import _tape
+
+__all__ = ['VariationalDropoutCell', 'LSTMPCell']
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (locked) dropout (Gal & Ghahramani): ONE Bernoulli
+    mask per sequence, reused at every timestep for inputs/states/
+    outputs (reference contrib/rnn/rnn_cell.py:VariationalDropoutCell).
+    Masks regenerate on ``reset()``."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, cached, p, like):
+        if p == 0.0 or not _tape.is_training():
+            return cached, None
+        if cached is None or cached.shape != like.shape:
+            keep = _op('random_bernoulli', prob=1 - p, size=like.shape)
+            cached = keep / (1 - p)
+        return cached, cached
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None, valid_length=None):
+        """Fresh masks per sequence: the reference's unroll resets
+        before stepping, so each minibatch gets its own locked mask."""
+        self.reset()
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs,
+                              valid_length=valid_length)
+
+    def forward(self, inputs, states):
+        self._input_mask, m = self._mask(self._input_mask,
+                                         self.drop_inputs, inputs)
+        if m is not None:
+            inputs = inputs * m
+        if self.drop_states and states:
+            self._state_mask, m = self._mask(self._state_mask,
+                                             self.drop_states, states[0])
+            if m is not None:
+                states = [states[0] * m] + list(states[1:])
+        out, next_states = self.base_cell(inputs, states)
+        self._output_mask, m = self._mask(self._output_mask,
+                                          self.drop_outputs, out)
+        if m is not None:
+            out = out * m
+        return out, next_states
+
+    def __repr__(self):
+        return (f'VariationalDropoutCell(p_out={self.drop_outputs}, '
+                f'p_state={self.drop_states})')
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projected hidden state (Sak et al. 2014; reference
+    contrib/rnn/rnn_cell.py:LSTMPCell): the recurrent/output state is
+    ``r = h2r(o * tanh(c))`` of size ``projection_size`` — smaller
+    recurrent matmuls for large hidden sizes, a shape the MXU likes.
+
+    States: [r (B, projection_size), c (B, hidden_size)].
+    """
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self.i2h_weight = Parameter('i2h_weight',
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter(
+            'h2h_weight', shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer)
+        self.h2r_weight = Parameter(
+            'h2r_weight', shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer)
+        self.i2h_bias = Parameter('i2h_bias', shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter('h2h_bias', shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (batch_size, self._projection_size)},
+                {'shape': (batch_size, self._hidden_size)}]
+
+    def _infer(self, x):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+            self.i2h_weight._finish_deferred_init()
+
+    def forward(self, inputs, states):
+        self._infer(inputs)
+        h = self._hidden_size
+        gates = _op('fully_connected', inputs, self.i2h_weight.data(),
+                    self.i2h_bias.data(), num_hidden=4 * h) + \
+            _op('fully_connected', states[0], self.h2h_weight.data(),
+                self.h2h_bias.data(), num_hidden=4 * h)
+        i = _op('sigmoid', gates[:, :h])
+        f = _op('sigmoid', gates[:, h:2 * h])
+        g = _op('tanh', gates[:, 2 * h:3 * h])
+        o = _op('sigmoid', gates[:, 3 * h:])
+        c = f * states[1] + i * g
+        hidden = o * _op('tanh', c)
+        r = _op('fully_connected', hidden, self.h2r_weight.data(), None,
+                num_hidden=self._projection_size, no_bias=True)
+        return r, [r, c]

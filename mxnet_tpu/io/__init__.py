@@ -316,6 +316,43 @@ def CSVIter(data_csv, data_shape, label_csv=None, label_shape=(1,),
     return NDArrayIter(data, label, batch_size=batch_size, **kwargs)
 
 
+def LibSVMIter(data_libsvm, data_shape, label_libsvm=None,
+               label_shape=(1,), batch_size=1, **kwargs):
+    """Reference src/io/iter_libsvm.cc — parse libsvm ``label idx:val``
+    lines into dense batches (the TPU form: CSR text is a host format;
+    on-device the batch is a dense matrix, with RowSparse available via
+    ndarray.sparse for the embedding path)."""
+    def parse(path, width):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append([float(v) for v in parts[0].split(',')])
+                row = _np.zeros(width, _np.float32)
+                for tok in parts[1:]:
+                    idx, val = tok.split(':')
+                    row[int(idx)] = float(val)
+                rows.append(row)
+        return _np.stack(rows), _np.asarray(labels, _np.float32)
+
+    width = int(_np.prod(data_shape))
+    data, inline_labels = parse(data_libsvm, width)
+    data = data.reshape((-1,) + tuple(data_shape))
+    if label_libsvm is not None:
+        # separate label file: plain values per line (reference
+        # iter_libsvm.cc label_libsvm layout), no idx:val tokens
+        with open(label_libsvm) as f:
+            label = _np.asarray(
+                [[float(v) for v in line.replace(',', ' ').split()]
+                 for line in f if line.strip()], _np.float32)
+        label = label.reshape((-1,) + tuple(label_shape))
+    else:
+        label = inline_labels.reshape((-1,) + tuple(label_shape))
+    return NDArrayIter(data, label, batch_size=batch_size, **kwargs)
+
+
 def MNISTIter(image, label, batch_size=1, shuffle=True, flat=False,
               silent=False, seed=0, **kwargs):
     """Reference src/io/iter_mnist.cc — reads idx-format MNIST files."""
