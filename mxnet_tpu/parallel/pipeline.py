@@ -5,14 +5,36 @@ async engine gives only *implicit* cross-device pipelining). TPU-native
 design: every pipeline stage runs the SAME program (SPMD), stage weights
 are stacked along a leading axis sharded over mesh axis 'pp', and
 activations flow stage-to-stage with ``lax.ppermute`` (neighbor ICI hop).
-The fill/drain schedule is a ``lax.scan`` over ``n_micro + n_stages - 1``
-ticks, so the whole pipeline is ONE XLA program — no host round-trips
-between microbatches, and reverse-mode AD through the scan + ppermute gives
-the backward pipeline for free.
+The fill/drain schedule is a ``lax.scan`` over the tick axis, so the whole
+pipeline is ONE XLA program — no host round-trips between microbatches,
+and reverse-mode AD through the scan + ppermute gives the backward
+pipeline for free.
+
+Memory layout (round 2 — the round-1 kernel replicated the full
+microbatch feed and output buffer to every stage):
+
+* the feed is SHARDED over 'pp': stage k owns microbatches
+  ``{t : t % S == k}`` (interleaved), so each stage stores
+  ``n_micro / S`` microbatches. A one-microbatch *carrier* register
+  circulates toward stage 0 (one ppermute hop per tick), refreshed from
+  the local shard every S ticks — microbatch t arrives at stage 0
+  exactly at tick t.
+* outputs are likewise sharded: the last stage injects each retired
+  output into a carrier circulating the other way; the owning stage
+  grabs it into its local ``n_micro / S`` slot.
+
+Per-stage activation memory is therefore O(n_micro/S + 3) microbatches
+instead of O(2·n_micro). Every stage executes ``stage_fn`` on every
+tick including fill/drain — inherent to single-program SPMD pipelining
+(the bubble arithmetic is wasted, not scheduled around), which is the
+standard TPU trade against multi-program 1F1B; the honest
+wasted-compute fraction (ticks − n_micro) / ticks is reported by
+:func:`pipeline_stats` alongside the classic GPipe figure.
 
 Constraints (standard for collective pipelining): every stage maps
 activations of one fixed shape/dtype to the same shape/dtype (true for
-transformer blocks), and the number of microbatches is static.
+transformer blocks), the number of microbatches is static and divisible
+by the stage count.
 """
 
 import functools
@@ -23,52 +45,110 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-def _shift_right(x, axis_name, axis_size):
-    """Send this device's value to the next pipeline stage (ring hop)."""
-    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+def _shift(x, axis_name, axis_size, toward_zero):
+    """One ring hop. toward_zero: stage k's value -> stage k-1 (feed
+    circulation); else k -> k+1 (output circulation)."""
+    if toward_zero:
+        perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
+    else:
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     return lax.ppermute(x, axis_name, perm)
 
 
-def pipeline_kernel(stage_fn, params, xs, axis_name, axis_size):
+def pipeline_stats(n_micro, n_stages):
+    """Schedule characteristics: actual wasted-compute fraction of THIS
+    kernel (every stage runs stage_fn every tick; ticks include the
+    output-circulation drain), the classic GPipe figure for comparison,
+    and per-stage buffer sizes (in microbatches)."""
+    ticks = max(n_micro, n_micro + 2 * n_stages - 3)
+    return {
+        'ticks': ticks,
+        # useful stage executions: n_micro per stage
+        'bubble_fraction': (ticks - n_micro) / ticks,
+        'gpipe_bubble_fraction':
+            (n_stages - 1) / (n_micro + n_stages - 1),
+        'feed_microbatches_per_stage': n_micro // n_stages,
+        'out_microbatches_per_stage': n_micro // n_stages,
+        'carrier_microbatches': 3,   # feed carrier, act buf, out carrier
+    }
+
+
+def pipeline_kernel(stage_fn, params, xs_local, axis_name, axis_size,
+                    n_micro):
     """Per-device GPipe schedule body — call inside shard_map.
 
-    ``params``: this stage's weights (leading stage axis already sliced
-    away by the shard_map in_spec, i.e. leaves have a leading dim of 1
-    which is squeezed here).
-    ``xs``: (n_micro, mb, ...) microbatched inputs, identical on every
-    stage (replicated in_spec).
-    Returns (n_micro, mb, ...) stage-``axis_size - 1`` outputs, replicated
-    to every device via a masked psum so the loss can be computed SPMD.
+    ``params``: this stage's weights (leading stage axis sliced away by
+    the in_spec; the size-1 dim is squeezed here).
+    ``xs_local``: (n_micro / S, mb, ...) — this stage's interleaved feed
+    shard (local slot q holds microbatch q·S + stage_idx).
+    Returns this stage's (n_micro / S, mb, ...) interleaved output shard
+    (local slot q holds the output of microbatch q·S + stage_idx).
     """
     params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
     idx = lax.axis_index(axis_name)
-    n_micro = xs.shape[0]
-    last = axis_size - 1
+    S = axis_size
+    n_loc = xs_local.shape[0]
+    last = S - 1
+    # output w is produced by the last stage at tick w + S - 1 and takes
+    # (owner + 1) mod S forward hops to reach its owner (w % S); the
+    # latest grab is owner S-2 at tick n_micro + 2S - 4
+    ticks = max(n_micro, n_micro + 2 * S - 3)
 
     def tick(carry, t):
-        buf, outs = carry
-        # stage 0 pulls microbatch t from the feed; later stages consume
-        # the activation ppermuted from their predecessor.
-        feed = lax.dynamic_index_in_dim(
-            xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
-        x_in = jnp.where(idx == 0, feed, buf)
+        feed_c, buf, out_c, outs = carry
+        q, r = jnp.divmod(t, S)
+        # refresh the feed carrier from the local shard every S ticks
+        local = lax.dynamic_index_in_dim(
+            xs_local, jnp.clip(q, 0, n_loc - 1), 0, keepdims=False)
+        feed_c = jnp.where(r == 0, local, feed_c)
+        # stage 0 consumes the carrier; others consume their neighbor's
+        # activation from the previous tick
+        x_in = jnp.where(idx == 0, feed_c, buf)
         y = stage_fn(params, x_in)
-        # the last stage retires microbatch t - (n_stages - 1) at tick t.
-        w = t - last
-        wc = jnp.clip(w, 0, n_micro - 1)
-        cur = lax.dynamic_index_in_dim(outs, wc, 0, keepdims=False)
+        # last stage retires microbatch w = t - (S - 1): inject into the
+        # output carrier
+        w_prod = t - last
+        out_c = jnp.where(idx == last, y, out_c)
+        # a carrier arriving at stage idx at tick t holds the output of
+        # microbatch w_arr = t - (S - 1) - ((idx + 1) % S); grab it if
+        # this stage owns it (w_arr % S == idx)
+        w_arr = t - last - (idx + 1) % S
+        grab = (w_arr >= 0) & (w_arr < n_micro) & (w_arr % S == idx)
+        slot = jnp.clip(w_arr // S, 0, n_loc - 1)
+        cur = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
+        # the value to store: for the last stage its own fresh y when it
+        # is also the owner ((idx+1)%S==0 -> zero hops), else the
+        # circulated carrier
+        val = jnp.where((idx == last) & (w_arr == w_prod), y, out_c)
         outs = lax.dynamic_update_index_in_dim(
-            outs, jnp.where(w >= 0, y, cur), wc, 0)
-        buf = _shift_right(y, axis_name, axis_size)
-        return (buf, outs), None
+            outs, jnp.where(grab, val, cur), slot, 0)
+        # circulate both carriers
+        feed_c = _shift(feed_c, axis_name, S, toward_zero=True)
+        out_c = _shift(out_c, axis_name, S, toward_zero=False)
+        buf = _shift(y, axis_name, S, toward_zero=False)
+        return (feed_c, buf, out_c, outs), None
 
-    buf0 = jnp.zeros(xs.shape[1:], xs.dtype)
-    outs0 = jnp.zeros(xs.shape, xs.dtype)
-    (_, outs), _ = lax.scan(tick, (buf0, outs0),
-                            jnp.arange(n_micro + last))
-    # only the last stage holds real outputs; replicate across 'pp'.
-    outs = jnp.where(idx == last, outs, jnp.zeros_like(outs))
-    return lax.psum(outs, axis_name)
+    z = jnp.zeros(xs_local.shape[1:], xs_local.dtype)
+    outs0 = jnp.zeros_like(xs_local)
+    (_, _, _, outs), _ = lax.scan(
+        tick, (z, z, z, outs0), jnp.arange(ticks))
+    return outs
+
+
+def _interleave(xs, n_stages):
+    """Reorder (n_micro, ...) so contiguous per-stage blocks hold the
+    interleaved ownership {t : t % S == k}."""
+    n_micro = xs.shape[0]
+    return jnp.swapaxes(
+        xs.reshape((n_micro // n_stages, n_stages) + xs.shape[1:]),
+        0, 1).reshape(xs.shape)
+
+
+def _deinterleave(ys, n_stages):
+    n_micro = ys.shape[0]
+    return jnp.swapaxes(
+        ys.reshape((n_stages, n_micro // n_stages) + ys.shape[1:]),
+        0, 1).reshape(ys.shape)
 
 
 def pipeline_apply(stage_fn, stage_params, xs, mesh, axis_name='pp'):
@@ -77,23 +157,33 @@ def pipeline_apply(stage_fn, stage_params, xs, mesh, axis_name='pp'):
     ``stage_fn(params, x) -> y`` — one stage, shape-preserving.
     ``stage_params`` — pytree whose leaves have leading dim ``n_stages``
     (stage i's weights), placed/sharded over mesh axis ``axis_name``.
-    ``xs`` — (n_micro, microbatch, ...) inputs, replicated.
+    ``xs`` — (n_micro, microbatch, ...) inputs; sharded over ``pp``
+    inside (each stage stores n_micro/S microbatches — round-1
+    replicated the full feed everywhere).
 
-    Returns (n_micro, microbatch, ...) outputs, replicated over ``pp``.
-    Differentiable: ``jax.grad`` through this builds the 1F1B-equivalent
-    backward sweep from the scan transpose.
+    Returns (n_micro, microbatch, ...) outputs (pp-sharded global
+    array; downstream SPMD consumers use it directly).
+    Differentiable: ``jax.grad`` through this builds the backward sweep
+    from the scan transpose.
     """
     from .mesh import _shard_map
 
     axis_size = mesh.shape[axis_name]
+    n_micro = xs.shape[0]
+    if n_micro % axis_size:
+        raise ValueError(
+            f'n_micro ({n_micro}) must be divisible by the stage count '
+            f'({axis_size})')
     pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
     fn = _shard_map()(
         functools.partial(pipeline_kernel, stage_fn,
-                          axis_name=axis_name, axis_size=axis_size),
+                          axis_name=axis_name, axis_size=axis_size,
+                          n_micro=n_micro),
         mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P())
-    return fn(stage_params, xs)
+        in_specs=(pspec, P(axis_name)),
+        out_specs=P(axis_name))
+    ys = fn(stage_params, _interleave(xs, axis_size))
+    return _deinterleave(ys, axis_size)
 
 
 def stack_stage_params(param_list):

@@ -117,7 +117,7 @@ def test_pipeline_matches_sequential():
     from mxnet_tpu.parallel.pipeline import (pipeline_apply,
                                              stack_stage_params)
     np.random.seed(1)
-    n_stages, n_micro, mb, D = 4, 6, 3, 8
+    n_stages, n_micro, mb, D = 4, 8, 3, 8
     mesh = parallel.make_mesh(pp=n_stages)
 
     def stage_fn(p, x):
@@ -319,3 +319,41 @@ def test_ring_attention_flash_path_differentiable():
     for gf, gx in zip(g_flash, g_xla):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
                                    rtol=5e-5, atol=5e-5)
+
+
+def test_pipeline_stats_and_divisibility():
+    from mxnet_tpu.parallel.pipeline import pipeline_apply, pipeline_stats
+    s = pipeline_stats(8, 4)
+    assert s['ticks'] == 13
+    assert abs(s['bubble_fraction'] - 5 / 13) < 1e-9
+    assert abs(s['gpipe_bubble_fraction'] - 3 / 11) < 1e-9
+    assert s['feed_microbatches_per_stage'] == 2
+    assert pipeline_stats(4, 1)['ticks'] == 4  # S=1 degenerate
+    mesh = parallel.make_mesh(pp=2)
+    with pytest.raises(ValueError):
+        pipeline_apply(lambda p, x: x, {'w': jnp.zeros((2, 1))},
+                       jnp.zeros((3, 2, 4)), mesh)
+
+
+def test_pipeline_feed_is_sharded():
+    """The compiled pipeline must NOT replicate the full feed to every
+    stage: per-device feed bytes = n_micro/S microbatches (round-1
+    replicated all of them)."""
+    from mxnet_tpu.parallel.pipeline import (pipeline_apply,
+                                             stack_stage_params)
+    n_stages, n_micro, mb, D = 4, 8, 2, 8
+    mesh = parallel.make_mesh(pp=n_stages)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p['w'])
+
+    params = stack_stage_params(
+        [{'w': jnp.eye(D)} for _ in range(n_stages)])
+    xs = jnp.zeros((n_micro, mb, D))
+
+    def run(p, x):
+        return pipeline_apply(stage_fn, p, x, mesh)
+
+    txt = jax.jit(run).lower(params, xs).compile().as_text()
+    # the shard_map body must receive a (n_micro/S, mb, D) feed operand
+    assert f'f32[{n_micro // n_stages},{mb},{D}]' in txt
