@@ -73,6 +73,52 @@ class AGInfo:
         self.grad_req = grad_req
 
 
+class RowSparseCot:
+    """Row-sparse cotangent: the backward of a sparse-grad embedding
+    lookup carries (values, row indices) instead of scattering into a
+    dense table-shaped array (reference: Embedding's FGradient emits a
+    row_sparse grad, src/operator/tensor/indexing_op.cc). Indices may
+    repeat (one entry per token occurrence); the consumer merges.
+    """
+
+    __slots__ = ('values', 'indices', 'shape')
+
+    def __init__(self, values, indices, shape):
+        self.values = values        # (nnz,) + shape[1:]
+        self.indices = indices      # (nnz,) int32
+        self.shape = shape          # full dense shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def astype(self, dtype):
+        if dtype == self.values.dtype:
+            return self
+        return RowSparseCot(self.values.astype(dtype), self.indices,
+                            self.shape)
+
+    def dense(self):
+        z = jnp.zeros(self.shape, self.values.dtype)
+        return z.at[self.indices].add(self.values)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseCot):
+            return RowSparseCot(
+                jnp.concatenate([self.values, other.values]),
+                jnp.concatenate([self.indices, other.indices]),
+                self.shape)
+        if other is None:
+            return self
+        return self.dense() + other     # mixed with a dense cotangent
+
+    def __radd__(self, other):
+        if other is None or (isinstance(other, (int, float))
+                             and other == 0):
+            return self
+        return other + self.dense()
+
+
 class TapeNode:
     """One recorded op: pure fn, captured input values, parent links."""
 
@@ -236,8 +282,18 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
                 raise ValueError('grad() variables must be marked '
                                  '(attach_grad/mark_variables)')
             got = var_grads.get(id(info))
-            out.append(NDArray(got[1]) if got is not None
-                       else NDArray(jnp.zeros(v.shape, v._data.dtype)))
+            if got is None:
+                out.append(NDArray(jnp.zeros(v.shape, v._data.dtype)))
+            elif isinstance(got[1], RowSparseCot):
+                from .ndarray import sparse as _sp
+                rsp = _sp.RowSparseNDArray(
+                    NDArray(got[1].values),
+                    NDArray(got[1].indices.astype(jnp.int64)),
+                    got[1].shape)
+                rsp._may_have_duplicates = True
+                out.append(rsp)
+            else:
+                out.append(NDArray(got[1]))
         return out
 
     # write into variable grad buffers honoring grad_req
@@ -246,6 +302,26 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
             continue
         if cot.dtype == jax.dtypes.float0:
             continue      # integer-dtype variable: no gradient (float0)
+        if isinstance(cot, RowSparseCot):
+            if info.grad_req == 'add':
+                # accumulation mode may mix sparse and dense
+                # contributions across backward() calls — densify so
+                # neither is lost (the no-densify fast path is the
+                # default grad_req='write')
+                cot = cot.dense()
+            else:
+                # keep the gradient row-sparse end-to-end: the dense
+                # buffer is never materialized; Parameter.grad()/
+                # list_grad surface the attached RowSparseNDArray
+                # (10M-row embeddings never touch O(table) grad memory)
+                from .ndarray import sparse as _sp
+                rsp = _sp.RowSparseNDArray(
+                    NDArray(cot.values.astype(info.grad._data.dtype)),
+                    NDArray(cot.indices.astype(jnp.int64)), cot.shape)
+                rsp._may_have_duplicates = True
+                info.grad._rsp = rsp
+                continue
+        info.grad._rsp = None
         if info.grad_req == 'add':
             info.grad._data = info.grad._data + cot.astype(info.grad._data.dtype)
         else:  # 'write'
@@ -345,6 +421,9 @@ def _backward_recorded(heads, head_infos, head_grads, variables,
     for info, cot_nd in var_grads.values():
         if info.grad is None or info.grad_req == 'null':
             continue
+        # recorded (create_graph) backward is dense-only: drop any
+        # surfaced row-sparse grad so it cannot shadow this write
+        info.grad._rsp = None
         if info.grad_req == 'add':
             info.grad._data = info.grad._data + cot_nd._data.astype(
                 info.grad._data.dtype)

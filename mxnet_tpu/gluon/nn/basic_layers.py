@@ -337,8 +337,47 @@ class Embedding(HybridBlock):
             grad_stype='row_sparse' if sparse_grad else 'default')
 
     def forward(self, x):
-        return _op('embedding', x, self.weight.data(),
+        from ... import _tape
+        w = self.weight.data()
+        if (self.weight._grad_stype == 'row_sparse'
+                and _tape.is_recording() and _tape._needs_grad([w])):
+            return _sparse_grad_embedding(x, w, self._output_dim)
+        return _op('embedding', x, w,
                    input_dim=self._input_dim, output_dim=self._output_dim)
+
+
+def _sparse_grad_embedding(x, w, output_dim):
+    """Embedding lookup whose recorded backward emits a ROW-SPARSE
+    cotangent — (per-token values, token ids) — instead of scattering
+    into a dense table-shaped array (reference indexing_op.cc Embedding
+    FGradient with sparse_grad: grad stype row_sparse). The dense-grad
+    path is jax.vjp like every op; this path hand-writes the tape node
+    because jax cotangents cannot carry sparsity."""
+    import jax.numpy as jnp
+    from ... import _tape
+    from ...ndarray.ndarray import NDArray
+
+    ids = x._data.astype(jnp.int32)
+    out_raw = jnp.take(w._data, ids, axis=0)
+    out = NDArray(out_raw)
+    flat_ids = ids.reshape(-1)
+
+    def fn(ids_raw, w_raw):     # dense replay (retain_graph fallback)
+        return jnp.take(w_raw, ids_raw.astype(jnp.int32), axis=0)
+
+    def vjp(cot):
+        vals = cot.reshape(flat_ids.shape[0], -1)
+        return (None,      # integer ids: no gradient
+                _tape.RowSparseCot(vals, flat_ids, w.shape))
+
+    import jax
+    node = _tape.TapeNode(
+        fn, [ids, w._data],
+        [getattr(x, '_ag', None), getattr(w, '_ag', None)],
+        1, 'embedding_sparse_grad', vjp_fn=vjp,
+        out_avals=[jax.typeof(out_raw)], multi=False)
+    out._ag = _tape.AGInfo(node=node, index=0)
+    return out
 
 
 class Flatten(HybridBlock):

@@ -179,6 +179,14 @@ class Parameter:
         self._check_initialized()
         return list(self._data.values())
 
+    @staticmethod
+    def _surface_grad(g):
+        """Row-sparse grads ride on the buffer as ``_rsp`` (written by
+        the tape's sparse-embedding backward) — surface them so the
+        dense table-shaped buffer is never materialized."""
+        rsp = getattr(g, '_rsp', None)
+        return rsp if rsp is not None else g
+
     def grad(self, ctx=None):
         """Reference parameter.py:604."""
         self._check_initialized()
@@ -187,14 +195,14 @@ class Parameter:
                 f'Cannot get gradient array for Parameter {self.name} '
                 'because grad_req="null"')
         if ctx is None:
-            return next(iter(self._grad.values()))
-        return self._grad[ctx]
+            return self._surface_grad(next(iter(self._grad.values())))
+        return self._surface_grad(self._grad[ctx])
 
     def list_grad(self):
         self._check_initialized()
         if self._grad is None:
             return []
-        return list(self._grad.values())
+        return [self._surface_grad(g) for g in self._grad.values()]
 
     def list_ctx(self):
         if self._data is None and self._deferred_init is not None:
@@ -224,6 +232,7 @@ class Parameter:
         import jax.numpy as jnp
         for g in self._grad.values():
             g._rebind(jnp.zeros_like(g._data))
+            g._rsp = None   # clear any surfaced row-sparse gradient
 
     def reset_ctx(self, ctx):
         if isinstance(ctx, Context):

@@ -179,10 +179,28 @@ class Trainer:
             return
         entries = []
         for i, param in enumerate(self._params):
-            if param.grad_req != 'null':
-                grads = param.list_grad()
-                if grads:
-                    entries.append((i, param, grads))
+            if param.grad_req == 'null':
+                continue
+            if param._grad_stype == 'row_sparse':
+                # keep row-sparse grads out of the dense allreduce: the
+                # kvstore merge would densify the O(table) gradient —
+                # exactly what the sparse path exists to avoid. The
+                # local lazy update handles them (reference: sparse
+                # params take the push/row_sparse_pull route).
+                if getattr(self._kvstore, 'num_workers', 1) > 1 and \
+                        not getattr(self, '_warned_sparse_dist', False):
+                    import warnings
+                    warnings.warn(
+                        'row_sparse gradients are applied rank-locally '
+                        'under a distributed kvstore (no sparse '
+                        'allreduce); replicate embeddings or use '
+                        'dist_async for server-side sparse updates.',
+                        UserWarning)
+                    self._warned_sparse_dist = True
+                continue
+            grads = param.list_grad()
+            if grads:
+                entries.append((i, param, grads))
         if not entries:
             return
         if hasattr(self._kvstore, 'fused_pushpull'):

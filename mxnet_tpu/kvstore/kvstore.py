@@ -188,11 +188,19 @@ class KVStoreLocal(KVStoreBase):
         if out is not None:
             outs = out if isinstance(out, (list, tuple)) else [out]
             for o in outs:
-                o._rebind(o._data.at[rows].set(gathered))
+                if isinstance(o, _sp.RowSparseNDArray):
+                    # actual row slices — never densify the pull
+                    o.data = NDArray(gathered)
+                    o.indices = NDArray(rows.astype(jnp.int64))
+                    o._invalidate()
+                else:
+                    o._rebind(o._data.at[rows].set(gathered))
             return out
-        res = jnp.zeros_like(value._data).at[rows].set(gathered)
-        from ..ndarray.ndarray import NDArray
-        return NDArray(res)
+        # no out given: return the row slices themselves (O(nnz), not
+        # O(table) — a 10M-row embedding pull must not densify)
+        return _sp.RowSparseNDArray(NDArray(gathered),
+                                    NDArray(rows.astype(jnp.int64)),
+                                    value.shape)
 
     # ------------------------------------------------------ optimizer hooks
     def set_updater(self, updater):

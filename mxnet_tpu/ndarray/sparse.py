@@ -96,6 +96,11 @@ class RowSparseNDArray(BaseSparseNDArray):
     kRowSparseStorage, ndarray.h:63). ``indices``: sorted int64 row ids,
     ``data``: (len(indices),) + shape[1:] values."""
 
+    #: set on gradient-born instances: one entry per token occurrence,
+    #: indices may repeat (the tape's RowSparseCot form); consumers
+    #: merge with scatter-add / unique
+    _may_have_duplicates = False
+
     def __init__(self, data, indices, shape, ctx=None):
         data = data if isinstance(data, NDArray) else array(data)
         indices = indices if isinstance(indices, NDArray) else array(
@@ -111,6 +116,8 @@ class RowSparseNDArray(BaseSparseNDArray):
     def _to_dense_raw(self):
         dense = jnp.zeros(self._shape, dtype=self._dtype)
         idx = self.indices._data.astype(jnp.int32)
+        if self._may_have_duplicates:
+            return dense.at[idx].add(self.data._data)
         return dense.at[idx].set(self.data._data)
 
     def copy(self):

@@ -167,30 +167,52 @@ class Optimizer:
         wd = self._get_wd(index)
         t = self._index_update_count[index]
         rows = grad.indices._data.astype(jnp.int32)
+        vals = grad.data._data
+        if getattr(grad, '_may_have_duplicates', False):
+            # gradient-born row_sparse: one entry per token occurrence.
+            # Merge to unique rows with static shapes: jnp.unique with a
+            # fixed size pads, padded slots are routed OUT OF BOUNDS so
+            # their scatter writes drop (XLA scatter OOB semantics) —
+            # no dynamic shapes, no densify.
+            n = rows.shape[0]
+            uniq, inv = jnp.unique(rows, return_inverse=True, size=n,
+                                   fill_value=-1)
+            vals = jnp.zeros((n,) + vals.shape[1:],
+                             vals.dtype).at[inv.reshape(-1)].add(vals)
+            valid = uniq >= 0
+            rows = jnp.where(valid, uniq,
+                             weight.shape[0]).astype(jnp.int32)
 
         def take(s):
             if isinstance(s, NDArray):
-                return NDArray(s._data[rows], ctx=s._ctx)
+                return NDArray(s._data[jnp.clip(rows, 0,
+                                                s.shape[0] - 1)],
+                               ctx=s._ctx)
             if isinstance(s, (list, tuple)):
                 return type(s)(take(x) for x in s)
             return s
 
         w_raw = weight._data
-        new_w_rows, new_srows = self.step(w_raw[rows], grad.data._data,
-                                          take(state), lr, wd, t)
-        weight._rebind(w_raw.at[rows].set(new_w_rows))
+        w_rows = w_raw[jnp.clip(rows, 0, w_raw.shape[0] - 1)]
+        new_w_rows, new_srows = self.step(w_rows, vals, take(state), lr,
+                                          wd, t)
+        # OOB rows (padding) are dropped by the scatter
+        weight._rebind(w_raw.at[rows].set(
+            new_w_rows, mode='drop', unique_indices=False))
         self._write_state_rows(state, new_srows, rows)
 
     def _write_state_rows(self, state, new_state, rows):
+        # mode='drop': out-of-bounds rows are dedup padding (see
+        # _update_one_lazy) and must not write anywhere
         if state is None:
             return
         if isinstance(state, NDArray):
             n = new_state[0] if isinstance(new_state, tuple) else new_state
-            state._rebind(state._data.at[rows].set(n))
+            state._rebind(state._data.at[rows].set(n, mode='drop'))
         elif isinstance(state, (list, tuple)):
             for s, n in zip(state, new_state):
                 if isinstance(s, NDArray):
-                    s._rebind(s._data.at[rows].set(n))
+                    s._rebind(s._data.at[rows].set(n, mode='drop'))
 
     def _write_state(self, state, new_state):
         if state is None:

