@@ -441,6 +441,12 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
             elif scalar_ok and isinstance(spec, (int, float, _np.generic)) \
                     and not isinstance(spec, bool):
                 ins.append(b.const('scalar', _np.asarray(spec, _np.float32)))
+        # keyword-passed arrays (e.g. multi_head_attention(mask=m)) are
+        # recorded as {'__arr__': i} specs in node.kwargs — append them
+        # after the positional operands so converters see every input
+        for spec in (node.kwargs or {}).values():
+            if isinstance(spec, dict) and '__arr__' in spec:
+                ins.append(in_name(node.inputs[spec['__arr__']]))
         for i in range(node.n_out):
             out_names[(node.uid, i)] = (
                 f'{node.name}_out{i}' if node.n_out > 1 else node.name)
@@ -486,14 +492,24 @@ def _split(b, node, ins, outs):
         sections = node.args_spec[1]
     axis = int(kw.get('axis', 0))
     in_shape = b.shape_of(node.inputs[0])
-    if not isinstance(sections, int):
-        raise NotImplementedError('split with explicit indices unsupported '
-                                  'in ONNX export (equal sections only)')
     if in_shape is None:
         raise NotImplementedError(
             'split export needs input_shapes= for the size computation')
-    size = in_shape[axis] // sections
-    sp = b.const('split', _np.full(sections, size, _np.int64))
+    if isinstance(sections, int):
+        size = in_shape[axis] // sections
+        sizes = _np.full(sections, size, _np.int64)
+    else:
+        # explicit indices: ONNX Split sizes are consecutive diffs with
+        # the axis length closing the last chunk. Indices resolve like
+        # numpy slicing boundaries: negatives count from the end, and
+        # everything clamps into [0, dim] (out-of-range -> empty chunk).
+        dim = int(in_shape[axis])
+        idx = [min(max(int(i) + dim if int(i) < 0 else int(i), 0), dim)
+               for i in sections]
+        bounds = [0] + idx + [dim]
+        sizes = _np.asarray([max(b2 - b1, 0) for b1, b2 in
+                             zip(bounds[:-1], bounds[1:])], _np.int64)
+    sp = b.const('split', sizes)
     b.add('Split', [ins[0], sp], list(outs), axis=axis)
 
 
@@ -514,7 +530,7 @@ def _getitem(b, node, ins, out):
         fill = (slice(None),) * (len(in_shape) - n_given)
         i = key.index(Ellipsis)
         key = key[:i] + fill + key[i + 1:]
-    starts, ends, axes, squeeze_axes = [], [], [], []
+    starts, ends, axes, steps, squeeze_axes = [], [], [], [], []
     for ax, k in enumerate(key):
         dim = in_shape[ax]
         if isinstance(k, int):
@@ -522,19 +538,29 @@ def _getitem(b, node, ins, out):
             starts.append(s)
             ends.append(s + 1)
             axes.append(ax)
+            steps.append(1)
             squeeze_axes.append(ax)
         elif isinstance(k, slice):
-            if k.step not in (None, 1):
-                raise NotImplementedError('strided getitem unsupported in '
-                                          'ONNX export')
-            s = 0 if k.start is None else (k.start if k.start >= 0
-                                           else k.start + dim)
-            e = dim if k.stop is None else (k.stop if k.stop >= 0
-                                            else k.stop + dim)
-            if (s, e) != (0, dim):
+            st = 1 if k.step is None else int(k.step)
+            if st == 0:
+                raise ValueError('slice step cannot be zero')
+            if st > 0:
+                s = 0 if k.start is None else (k.start if k.start >= 0
+                                               else k.start + dim)
+                e = dim if k.stop is None else (k.stop if k.stop >= 0
+                                                else k.stop + dim)
+            else:
+                # negative stride: ONNX Slice walks backwards; INT64_MIN
+                # -ish sentinel (-dim-1 clamps to 'before element 0')
+                s = dim - 1 if k.start is None else (
+                    k.start if k.start >= 0 else k.start + dim)
+                e = -dim - 1 if k.stop is None else (
+                    k.stop if k.stop >= 0 else k.stop + dim)
+            if (st, s, e) != (1, 0, dim):
                 starts.append(s)
                 ends.append(e)
                 axes.append(ax)
+                steps.append(st)
         else:
             raise NotImplementedError(
                 f'getitem key element {k!r} unsupported in ONNX export')
@@ -543,7 +569,8 @@ def _getitem(b, node, ins, out):
         cur = b.add('Slice', [
             cur, b.const('starts', _np.asarray(starts, _np.int64)),
             b.const('ends', _np.asarray(ends, _np.int64)),
-            b.const('axes', _np.asarray(axes, _np.int64))],
+            b.const('axes', _np.asarray(axes, _np.int64)),
+            b.const('steps', _np.asarray(steps, _np.int64))],
             [b.uname('sliced') if squeeze_axes else out])
     if squeeze_axes:
         b.add('Squeeze', [cur, b.const(
@@ -558,11 +585,12 @@ def _mha(b, node, ins, out):
     static shapes from the pre-pass (mask-free case, as traced by BERT
     with no valid_length)."""
     kw = node.kwargs
-    if len(ins) > 3 or kw.get('mask') is not None or kw.get('causal') or \
-            kw.get('dropout_p', 0.0) > 0.0:
+    if kw.get('dropout_p', 0.0) and kw['dropout_p'] > 0.0:
+        # this op applies dropout on every replay (no eval switch), so
+        # an export without it would diverge from sym.eval
         raise NotImplementedError(
-            'multi_head_attention export supports the unmasked, '
-            'non-causal, no-dropout q/k/v form')
+            'multi_head_attention export requires dropout_p=0 '
+            '(trace the model in inference configuration)')
     heads = kw.get('num_heads')
     if heads is None and len(node.args_spec) > 3:
         heads = node.args_spec[3]
@@ -589,6 +617,21 @@ def _mha(b, node, ins, out):
     scores = b.add('MatMul', [qh, kt], [b.uname('scores')])
     scaled = b.add('Mul', [scores, b.const(
         'scale', _np.float32(hd ** -0.5))], [b.uname('scaled')])
+    # additive masks before the softmax: causal (static lower-triangular
+    # constant, bottom-right aligned like the op) and/or an explicit
+    # boolean mask input (4th operand) lowered via Where
+    if kw.get('causal'):
+        tri = _np.tril(_np.ones((Sq, Sk), _np.float32), k=Sk - Sq)
+        add = _np.where(tri > 0, _np.float32(0), _np.float32(-1e9))
+        scaled = b.add('Add', [scaled, b.const(
+            'causal_mask', add.reshape(1, 1, Sq, Sk))],
+            [b.uname('causal_masked')])
+    if len(ins) > 3:
+        mb = b.add('Cast', [ins[3]], [b.uname('mask_b')], to=9)  # BOOL
+        add = b.add('Where', [
+            mb, b.const('mzero', _np.float32(0.0)),
+            b.const('mneg', _np.float32(-1e9))], [b.uname('mask_add')])
+        scaled = b.add('Add', [scaled, add], [b.uname('masked')])
     probs = b.add('Softmax', [scaled], [b.uname('probs')], axis=-1)
     ctxv = b.add('MatMul', [probs, vh], [b.uname('ctx')])
     back = b.add('Transpose', [ctxv], [b.uname('back')], perm=[0, 2, 1, 3])

@@ -171,26 +171,48 @@ def _import_node(imp, node):
         axis = at.get('axis', 0)
         if sizes and len(set(sizes)) == 1:
             return _invoke('split', [S(0), len(sizes)], dict(axis=axis))
-        raise NotImplementedError('non-equal Split import unsupported')
+        if sizes:
+            # unequal chunks -> split at the cumulative boundaries
+            bounds = []
+            acc = 0
+            for s in sizes[:-1]:
+                acc += int(s)
+                bounds.append(acc)
+            return _invoke('split', [S(0), tuple(bounds)],
+                           dict(axis=axis))
+        raise NotImplementedError('Split without sizes unsupported')
     if op == 'Slice':
         starts = [int(v) for v in imp.const(ins[1])]
         ends = [int(v) for v in imp.const(ins[2])]
         axes = ([int(v) for v in imp.const(ins[3])] if len(ins) > 3
                 else list(range(len(starts))))
-        if len(ins) > 4 and ins[4]:
-            steps = [int(v) for v in imp.const(ins[4])]
-            if any(s != 1 for s in steps):
-                raise NotImplementedError(
-                    f'Slice with steps {steps} unsupported (stride-1 only)')
-        out_s = S(0)
-        for s, e, ax in zip(starts, ends, axes):
-            out_s = _invoke('slice_axis', [out_s, ax, s,
-                                           None if e >= 2 ** 31 else e], {})
-        return out_s
+        steps = ([int(v) for v in imp.const(ins[4])]
+                 if len(ins) > 4 and ins[4] else [1] * len(starts))
+        if all(st == 1 for st in steps):
+            out_s = S(0)
+            for s, e, ax in zip(starts, ends, axes):
+                out_s = _invoke('slice_axis', [out_s, ax, s,
+                                               None if e >= 2 ** 31
+                                               else e], {})
+            return out_s
+        # strided form -> legacy `slice` op with explicit axes
+        # (negative axes allowed per ONNX spec; INT_MIN/MAX
+        # sentinels = open bounds)
+        begin = tuple(s if abs(s) < 2 ** 31 else None for s in starts)
+        end = tuple(e if abs(e) < 2 ** 31 else None for e in ends)
+        return _invoke('slice', [S(0)],
+                       dict(begin=begin, end=end, step=tuple(steps),
+                            axes=tuple(axes)))
     if op == 'Gather':
-        if at.get('axis', 0) != 0:
-            raise NotImplementedError('Gather only on axis 0')
-        return _invoke('embedding', [S(1), S(0)], {})
+        axis = at.get('axis', 0)
+        if axis == 0:
+            return _invoke('embedding', [S(1), S(0)], {})
+        # mode='wrap': ONNX Gather permits negative (from-the-back)
+        # indices; 'clip' would silently map -1 to 0
+        return _invoke('take', [S(0), S(1)], dict(axis=axis,
+                                                  mode='wrap'))
+    if op == 'Where':
+        return _invoke('where', [S(0), S(1), S(2)], {})
     if op == 'Cast':
         return _invoke('cast', [S(0)],
                        dict(dtype=_NP_DTYPE[at['to']]))

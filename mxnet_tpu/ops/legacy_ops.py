@@ -54,10 +54,18 @@ def softsign(data):
 
 
 @register('slice')
-def slice_legacy(data, begin, end, step=None):
+def slice_legacy(data, begin, end, step=None, axes=None):
     """Classic slice op (reference src/operator/tensor/matrix_op.cc
-    `slice` — begin/end/step tuples with None wildcards)."""
+    `slice` — begin/end/step tuples with None wildcards). With ``axes``
+    the triplets apply to the named axes (negative axes allowed) —
+    the ONNX Slice import form."""
     nd = data.ndim
+    if axes is not None:
+        idx = [slice(None)] * nd
+        step = step if step is not None else (None,) * len(axes)
+        for ax, b, e, s in zip(axes, begin, end, step):
+            idx[ax] = slice(b, e, s)
+        return data[tuple(idx)]
     begin = tuple(begin) + (None,) * (nd - len(begin))
     end = tuple(end) + (None,) * (nd - len(end))
     step = tuple(step) + (None,) * (nd - len(step)) if step else \

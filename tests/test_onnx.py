@@ -169,3 +169,140 @@ def test_bert_encoder_onnx_roundtrip(tmp_path):
                         rtol=1e-4, atol=1e-4)
     assert_almost_equal(got[1].asnumpy(), pooled.asnumpy(),
                         rtol=1e-4, atol=1e-4)
+
+
+def test_bert_base_dims_onnx_logit_parity(tmp_path):
+    """BERT-base architecture (12 layers, 768 units, 12 heads, 3072
+    hidden) export -> import -> logit parity (VERDICT r1 item 9; vocab
+    kept small so the artifact stays CI-sized — the graph structure is
+    the full base config)."""
+    from mxnet_tpu.gluon.model_zoo import bert
+    net = bert.get_bert_model(num_layers=12, vocab_size=2000, units=768,
+                              hidden_size=3072, num_heads=12,
+                              dropout=0.0, use_decoder=False,
+                              use_classifier=False)
+    net.initialize()
+    toks = mx.np.array(np.random.randint(1, 2000, (2, 16)).astype('f'))
+    segs = mx.np.zeros((2, 16))
+    seq, pooled = net(toks, segs)
+
+    sym = net._trace_symbol(toks, segs)
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = str(tmp_path / 'bert_base.onnx')
+    mx.contrib.onnx.export_model(sym, params,
+                                 input_shapes=[(2, 16), (2, 16)],
+                                 onnx_file_path=path)
+    sym2, arg_params, _ = mx.contrib.onnx.import_model(path)
+    bindings = dict(arg_params)
+    names = [n for n in sym2.list_arguments() if n not in arg_params]
+    got = sym2.eval(**bindings, **dict(zip(sorted(names), [toks, segs])))
+    assert_almost_equal(got[0].asnumpy(), seq.asnumpy(),
+                        rtol=1e-3, atol=1e-4)
+    assert_almost_equal(got[1].asnumpy(), pooled.asnumpy(),
+                        rtol=1e-3, atol=1e-4)
+
+
+def test_causal_attention_onnx_roundtrip(tmp_path):
+    """Decoder-style causal attention exports (additive triangular mask
+    before the softmax) and round-trips."""
+    from mxnet_tpu import gluon
+
+    class CausalSelfAtt(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.qkv = gluon.nn.Dense(3 * 32, in_units=32, flatten=False)
+
+        def forward(self, x):
+            q, k, v = mx.np.split(self.qkv(x), 3, axis=-1)
+            return mx.npx.multi_head_attention(q, k, v, num_heads=4,
+                                               causal=True)
+
+    net = CausalSelfAtt()
+    net.initialize()
+    x = mx.np.array(np.random.randn(2, 6, 32).astype('f'))
+    want = net(x)
+    sym = net._trace_symbol(x)
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = str(tmp_path / 'causal.onnx')
+    mx.contrib.onnx.export_model(sym, params, input_shapes=[(2, 6, 32)],
+                                 onnx_file_path=path)
+    sym2, arg_params, _ = mx.contrib.onnx.import_model(path)
+    names = [n for n in sym2.list_arguments() if n not in arg_params]
+    got = sym2.eval(**dict(arg_params), **{names[0]: x})
+    got = got[0] if isinstance(got, (list, tuple)) else got
+    assert_almost_equal(got.asnumpy(), want.asnumpy(), rtol=1e-4,
+                        atol=1e-5)
+    # causality check on the imported graph: future tokens don't matter
+    x2 = mx.np.array(np.concatenate(
+        [x.asnumpy()[:, :3], np.random.randn(2, 3, 32).astype('f')], 1))
+    got2 = sym2.eval(**dict(arg_params), **{names[0]: x2})
+    got2 = got2[0] if isinstance(got2, (list, tuple)) else got2
+    assert_almost_equal(got2.asnumpy()[:, :3], want.asnumpy()[:, :3],
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_strided_slice_and_unequal_split_roundtrip(tmp_path):
+    from mxnet_tpu import gluon
+
+    class Net(gluon.HybridBlock):
+        def forward(self, x):
+            a = x[:, ::2]                      # strided
+            b_ = x[:, ::-1]                    # negative stride
+            c, d = mx.np.split(x, [3], axis=1)  # unequal split (3, 5)
+            red = lambda t: t.sum(-1).sum(-1, keepdims=True)
+            return red(a) + red(b_) * 0.5 + red(c) + red(d)
+
+    net = Net()
+    net.initialize()
+    x = mx.np.array(np.random.randn(2, 8, 4).astype('f'))
+    want = net(x)
+    sym = net._trace_symbol(x)
+    path = str(tmp_path / 'strided.onnx')
+    mx.contrib.onnx.export_model(sym, {}, input_shapes=[(2, 8, 4)],
+                                 onnx_file_path=path)
+    sym2, arg_params, _ = mx.contrib.onnx.import_model(path)
+    names = [n for n in sym2.list_arguments() if n not in arg_params]
+    got = sym2.eval(**dict(arg_params), **{names[0]: x})
+    got = got[0] if isinstance(got, (list, tuple)) else got
+    assert_almost_equal(got.asnumpy(), want.asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_masked_attention_kwarg_roundtrip(tmp_path):
+    """A keyword-passed boolean mask must reach the exported graph
+    (round-2 review regression: it was silently dropped)."""
+    from mxnet_tpu import gluon
+
+    class MaskedAtt(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.qkv = gluon.nn.Dense(3 * 16, in_units=16, flatten=False)
+
+        def forward(self, x, mask):
+            q, k, v = mx.np.split(self.qkv(x), 3, axis=-1)
+            return mx.npx.multi_head_attention(q, k, v, num_heads=2,
+                                               mask=mask)
+
+    net = MaskedAtt()
+    net.initialize()
+    x = mx.np.array(np.random.randn(1, 4, 16).astype('f'))
+    m = mx.np.array(np.tril(np.ones((1, 1, 4, 4))).astype(bool))
+    want = net(x, m)
+    sym = net._trace_symbol(x, m)
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = str(tmp_path / 'masked.onnx')
+    mx.contrib.onnx.export_model(sym, params,
+                                 input_shapes=[(1, 4, 16), (1, 1, 4, 4)],
+                                 input_types=['float32', 'bool'],
+                                 onnx_file_path=path)
+    sym2, arg_params, _ = mx.contrib.onnx.import_model(path)
+    names = sorted(n for n in sym2.list_arguments() if n not in arg_params)
+    got = sym2.eval(**dict(arg_params), **dict(zip(names, [x, m])))
+    got = got[0] if isinstance(got, (list, tuple)) else got
+    assert_almost_equal(got.asnumpy(), want.asnumpy(), rtol=1e-4,
+                        atol=1e-5)
+    # the mask must actually matter in the imported graph
+    m2 = mx.np.array(np.ones((1, 1, 4, 4)).astype(bool))
+    got2 = sym2.eval(**dict(arg_params), **dict(zip(names, [x, m2])))
+    got2 = got2[0] if isinstance(got2, (list, tuple)) else got2
+    assert np.abs(got2.asnumpy() - want.asnumpy()).max() > 1e-4
