@@ -121,7 +121,13 @@ def unscale(trainer):
 
 def convert_hybrid_block(block, target_dtype='bfloat16', **kwargs):
     """Reference amp.convert_hybrid_block: cast a model's compute to
-    bf16/fp16 (the ReducePrecision pass analog). Casts parameters; the
-    jit'd forward then computes in that dtype."""
+    bf16/fp16. Casts parameters; the jit'd forward then computes in that
+    dtype. For the op-list-driven graph rewrite on a traced symbol (the
+    ReducePrecision pass proper), use :func:`convert_symbol` /
+    :func:`convert_model`."""
     block.cast(target_dtype)
     return block
+
+
+from . import lists                              # noqa: E402,F401
+from .pass_ import convert_symbol, convert_model  # noqa: E402,F401

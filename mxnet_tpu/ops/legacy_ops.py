@@ -115,7 +115,11 @@ def sparse_retain(data, indices):
 @register('amp_cast')
 def amp_cast(data, dtype='float32'):
     """AMP-inserted cast (reference tensor/amp_cast.cc) — identity in
-    value, dtype change only; the AMP graph pass inserts these."""
+    value, dtype change only; the AMP graph pass inserts these. Only
+    floating inputs are touched (integer ids / boolean masks pass
+    through, matching the reference's float-only AMPCast)."""
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        return data
     return data.astype(dtype)
 
 

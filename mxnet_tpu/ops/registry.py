@@ -22,6 +22,7 @@ import jax
 
 from .. import _deferred_compute as _dc
 from .. import _rng, _tape
+from .. import profiler as _prof
 
 _OPS = {}
 
@@ -125,10 +126,25 @@ def apply_op(op, arrays, fn, n_out=None, name=None, _from_invoke=False):
     raws = [a._data for a in arrays]
     recording = _tape.is_recording() and _tape._needs_grad(arrays)
     vjp_fn = None
+    profiling = _prof._is_profiling_ops()
+    if profiling:
+        import time as _time
+        _t0 = _time.perf_counter()
     if recording and op.differentiable and _tape.is_training():
         outs, vjp_fn = jax.vjp(fn, *raws)
     else:
         outs = fn(*raws)
+    if profiling:
+        # per-op latency needs completion, not dispatch: sync each op
+        # (the reference's NaiveEngine-profiling trade, SURVEY §5)
+        try:
+            jax.block_until_ready(outs)
+        except Exception:
+            pass
+        _nb = sum(int(getattr(o, 'nbytes', 0)) for o in
+                  (outs if isinstance(outs, (tuple, list)) else [outs]))
+        _prof.record_op(name or op.name,
+                        _time.perf_counter() - _t0, _nb)
     multi = isinstance(outs, (tuple, list))
     out_list = list(outs) if multi else [outs]
 

@@ -13,16 +13,28 @@ import time
 import jax
 
 _config = {'profile_all': False, 'filename': '/tmp/mxnet_tpu_profile',
-           'running': False}
+           'running': False, 'ops': False, 'memory': False}
 _records = []
+_op_stats = {}      # name -> [count, total_s, min_s, max_s, out_bytes]
+_mem_stats = {'peak_live_bytes': 0}
 
 
 def set_config(profile_all=False, profile_symbolic=True,
                profile_imperative=True, profile_memory=True, profile_api=True,
                filename='/tmp/mxnet_tpu_profile', aggregate_stats=False,
                **kwargs):
-    """Reference profiler.py set_config → MXSetProcessProfilerConfig."""
-    _config.update(profile_all=profile_all, filename=filename)
+    """Reference profiler.py set_config → MXSetProcessProfilerConfig.
+
+    ``profile_imperative``/``profile_all`` arm per-op aggregate stats:
+    every imperative dispatch is timed to completion (a sync per op —
+    the reference recommends NaiveEngine for accurate per-op numbers,
+    and this is the same trade) and tallied into the ``dumps()`` table.
+    ``profile_memory`` additionally tracks live device bytes per op
+    (≙ storage_profiler.h).
+    """
+    _config.update(profile_all=profile_all, filename=filename,
+                   ops=bool(profile_all or profile_imperative),
+                   memory=bool(profile_memory))
 
 
 def set_state(state='stop', profile_process='worker'):
@@ -56,18 +68,98 @@ def dump(finished=True, profile_process='worker'):
     stop()
 
 
+def _is_profiling_ops():
+    return _config['running'] and _config['ops']
+
+
+import threading as _threading
+
+_stats_lock = _threading.Lock()
+
+
+def record_op(name, dt, out_bytes):
+    """Called by the dispatch layer (ops/registry.py) when op profiling
+    is armed — the aggregate_stats.cc tally. Locked: DataLoader worker
+    threads dispatch ops concurrently."""
+    with _stats_lock:
+        s = _op_stats.get(name)
+        if s is None:
+            _op_stats[name] = [1, dt, dt, dt, out_bytes]
+        else:
+            s[0] += 1
+            s[1] += dt
+            s[2] = min(s[2], dt)
+            s[3] = max(s[3], dt)
+            s[4] += out_bytes
+    if _config['memory']:
+        # O(1) allocator peak where the backend exposes it (TPU does);
+        # a per-op live_arrays() walk would be O(live buffers) per call
+        try:
+            stats = jax.devices()[0].memory_stats()
+            peak = int((stats or {}).get('peak_bytes_in_use', 0))
+            if peak > _mem_stats['peak_live_bytes']:
+                _mem_stats['peak_live_bytes'] = peak
+        except Exception:
+            pass
+
+
 def dumps(reset=False):
-    """Aggregate table of scoped timings recorded via profiler.scope/Marker."""
-    lines = ['Profile Statistics:', f'{"Name":<40}{"Count":>8}{"Total(ms)":>12}']
+    """Aggregate statistics table (reference ``mx.profiler.dumps()`` over
+    ``src/profiler/aggregate_stats.cc``): per-op count / total / avg /
+    min / max latency + output bytes, then scoped host timings, then the
+    memory summary."""
+    lines = ['Profile Statistics:']
+    if _op_stats:
+        lines.append('Operator summary (imperative dispatch, synced '
+                     'per call):')
+        lines.append(f'{"Name":<32}{"Count":>8}{"Total(ms)":>12}'
+                     f'{"Avg(ms)":>10}{"Min(ms)":>10}{"Max(ms)":>10}'
+                     f'{"Out(MB)":>10}')
+        for name, (c, t, lo, hi, nb) in sorted(
+                _op_stats.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f'{name:<32}{c:>8}{t * 1e3:>12.3f}'
+                         f'{t / c * 1e3:>10.3f}{lo * 1e3:>10.3f}'
+                         f'{hi * 1e3:>10.3f}{nb / 1e6:>10.2f}')
     agg = {}
     for name, dt in _records:
         c, t = agg.get(name, (0, 0.0))
         agg[name] = (c + 1, t + dt)
-    for name, (c, t) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
-        lines.append(f'{name:<40}{c:>8}{t * 1e3:>12.3f}')
+    if agg:
+        lines.append('Scoped host timings:')
+        lines.append(f'{"Name":<40}{"Count":>8}{"Total(ms)":>12}')
+        for name, (c, t) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f'{name:<40}{c:>8}{t * 1e3:>12.3f}')
+    if _config['memory'] and _mem_stats['peak_live_bytes']:
+        lines.append(f'Peak live device memory: '
+                     f'{_mem_stats["peak_live_bytes"] / 1e6:.2f} MB')
     if reset:
         _records.clear()
+        _op_stats.clear()
+        _mem_stats['peak_live_bytes'] = 0
     return '\n'.join(lines)
+
+
+def memory_summary(device=None):
+    """Device memory snapshot (reference storage_profiler.h GPU memory
+    profiler): allocator stats where the backend exposes them, plus the
+    live-buffer aggregate."""
+    dev = device or jax.devices()[0]
+    out = {'device': str(dev)}
+    try:
+        stats = dev.memory_stats()
+        if stats:
+            out.update({k: int(v) for k, v in stats.items()
+                        if isinstance(v, (int, float))})
+    except Exception:
+        pass
+    try:
+        live = [a for a in jax.live_arrays()]
+        out['live_buffers'] = len(live)
+        out['live_bytes'] = sum(int(a.nbytes) for a in live)
+    except Exception:
+        pass
+    out['peak_live_bytes'] = _mem_stats['peak_live_bytes']
+    return out
 
 
 @contextlib.contextmanager

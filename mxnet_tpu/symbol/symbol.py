@@ -348,7 +348,46 @@ class Symbol:
 
     # ---------------------------------------------------------- serialization
     def tojson(self, remove_amp_cast=True):
-        nodes = self._topo()
+        sym = self._strip_amp_cast() if remove_amp_cast else self
+        nodes = sym._topo()
+        return sym._tojson_nodes(nodes)
+
+    def _strip_amp_cast(self):
+        """Drop amp_cast nodes (reference remove_amp_cast semantics:
+        the saved JSON is the clean fp32 graph; the AMP rewrite is a
+        runtime optimization, not part of the model definition)."""
+        if not any(n.op in ('amp_cast', 'amp_multicast')
+                   for n in self._topo()):
+            return self
+
+        def resolve(entry):
+            node, idx = entry
+            while node.op in ('amp_cast', 'amp_multicast'):
+                node, idx = node.inputs[idx if node.op == 'amp_multicast'
+                                        else 0]
+            return (node, idx)
+
+        clones = {}
+        for node in self._topo():
+            if node.op in ('null', 'amp_cast', 'amp_multicast'):
+                clones[id(node)] = node
+                continue
+            new_inputs = []
+            for e in node.inputs:
+                n2, i2 = resolve(e)
+                n2 = clones.get(id(n2), n2)
+                new_inputs.append((n2, i2))
+            new = _SymNode(node.op, node.name, node.args_spec,
+                           dict(node.kwargs), new_inputs,
+                           dict(node.attrs))
+            new.n_out = node.n_out
+            clones[id(node)] = new
+        out = Symbol([(clones.get(id(n), n), i)
+                      for n, i in map(resolve, self._outputs)])
+        out._aux = dict(self._aux)
+        return out
+
+    def _tojson_nodes(self, nodes):
         opaque = [n.attrs['__opaque_name__'] for n in nodes
                   if n.op == '_opaque']
         if opaque:
