@@ -371,7 +371,11 @@ class _CachedGraph:
             args, is_leaf=lambda x: isinstance(x, NDArray))
         in_nds = [x if isinstance(x, NDArray) else array(x) for x in leaves]
         main, aux = self._params()
-        train_mode = _tape.is_training() if _tape.is_recording() else False
+        # the train flag alone decides the traced branch/behavior
+        # (dropout, BN stats, detector training heads): record() turns
+        # it on by default, autograd.train_mode() turns it on without
+        # recording — eager and hybridized must agree in every scope
+        train_mode = _tape.is_training()
         # treedef is part of the key: same leaf shapes under different arg
         # nesting (or train/eval forwards with different output structures)
         # must not share a compiled entry or its output pytree

@@ -13,3 +13,4 @@ from .llama import (LlamaConfig, LlamaForCausalLM, llama_tiny, llama2_7b,
 from .yolo import Darknet53, YOLOv3, darknet53, yolo3_darknet53
 from .transformer import TransformerMT, transformer_base_mt
 from .rcnn import FasterRCNN, faster_rcnn_resnet50_v1
+from .ssd import SSD, ssd_300_resnet18_v1

@@ -126,7 +126,10 @@ class FasterRCNN(HybridBlock):
         cls_scores = self.cls_pred(h)             # (B*R, C+1)
         deltas = self.box_pred(h)                 # (B*R, 4C)
 
-        if _tape.is_recording():
+        # is_training (not is_recording): inside a hybridized trace the
+        # recorder is off but the train flag carries through, so the
+        # training branch compiles correctly under hybridize too
+        if _tape.is_training():
             return rpn_raw, rpn_reg, cls_scores, deltas, rois
 
         probs = npx.softmax(cls_scores, axis=-1)[:, 1:]   # drop background

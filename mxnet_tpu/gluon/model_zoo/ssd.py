@@ -1,0 +1,153 @@
+"""SSD single-shot detector.
+
+Reference assets: the SSD multibox op family
+(``src/operator/contrib/multibox_prior.cc`` / ``multibox_target.cc`` /
+``multibox_detection.cc``) + the SSD example
+(``example/ssd`` in the reference era; GluonCV ``ssd_300_*`` models).
+TPU design: every stage — backbone, multi-scale heads, anchor
+generation (constant-folded), box decode and per-class NMS — is one
+static-shape compiled graph; training mode returns raw predictions +
+anchors for ``multibox_target``.
+"""
+
+import numpy as _np
+
+from ... import _tape
+from ... import np as mnp
+from .. import nn
+from ..block import HybridBlock
+from .yolo import _op
+
+
+def _conv_block(channels, kernel, stride=1, pad=0):
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels, kernel, strides=stride, padding=pad,
+                      use_bias=False),
+            nn.BatchNorm(), nn.Activation('relu'))
+    return blk
+
+
+class _SSDFeatures(HybridBlock):
+    """Truncated backbone + stride-2 extra blocks → multi-scale maps.
+
+    Uses the resnet18 feature trunk (stages to stride 16 and 32) and
+    ``num_extra`` additional downsampling blocks — the role of the
+    reference's VGG-atrous + extra layers."""
+
+    def __init__(self, num_extra=2, **kwargs):
+        super().__init__(**kwargs)
+        from .vision import resnet18_v1
+        base = resnet18_v1()
+        feats = list(base.features._children.values())
+        # stages: conv..stage3 (stride 16) | stage4 (stride 32)
+        self.stage1 = nn.HybridSequential()
+        for layer in feats[:7]:
+            self.stage1.add(layer)
+        self.stage2 = nn.HybridSequential()
+        self.stage2.add(feats[7])
+        self.extras = nn.HybridSequential()
+        for _ in range(num_extra):
+            blk = nn.HybridSequential()
+            blk.add(_conv_block(256, 1),
+                    _conv_block(512, 3, stride=2, pad=1))
+            self.extras.add(blk)
+
+    def forward(self, x):
+        outs = []
+        x = self.stage1(x)
+        outs.append(x)                    # stride 16
+        x = self.stage2(x)
+        outs.append(x)                    # stride 32
+        for blk in self._children['extras']._children.values():
+            x = blk(x)
+            outs.append(x)                # stride 64, 128, ...
+        return outs
+
+
+class SSD(HybridBlock):
+    """Single-shot detector over multi-scale feature maps.
+
+    ``forward(x)``:
+      * training (autograd recording): ``(cls_preds (N, A, C+1),
+        loc_preds (N, A*4), anchors (1, A, 4))`` — feed to
+        ``mx.npx.multibox_target`` for loss targets;
+      * inference: ``(ids, scores, boxes)`` via ``multibox_detection``
+        (+ per-class NMS), all inside the compiled graph. Anchors are
+        in [0, 1] normalized corners (reference convention).
+    """
+
+    def __init__(self, classes=20, sizes=None, ratios=None, num_extra=2,
+                 nms_thresh=0.45, nms_topk=100, post_nms=100, **kwargs):
+        super().__init__(**kwargs)
+        n_scales = 2 + num_extra
+        if sizes is None:
+            # linearly spaced scales, paired with the next scale's
+            # geometric mean (the reference SSD sizing rule)
+            lo, hi = 0.2, 0.9
+            s = _np.linspace(lo, hi, n_scales + 1)
+            sizes = [(float(s[i]), float(_np.sqrt(s[i] * s[i + 1])))
+                     for i in range(n_scales)]
+        if ratios is None:
+            ratios = [(1.0, 2.0, 0.5)] * n_scales
+        assert len(sizes) == len(ratios) == n_scales
+        self._classes = classes
+        self._sizes = sizes
+        self._ratios = ratios
+        self._nms_thresh = nms_thresh
+        self._nms_topk = nms_topk
+        self._post_nms = post_nms
+        self.features = _SSDFeatures(num_extra=num_extra)
+        self.class_preds = nn.HybridSequential()
+        self.box_preds = nn.HybridSequential()
+        for sz, rt in zip(sizes, ratios):
+            a = len(sz) + len(rt) - 1
+            self.class_preds.add(nn.Conv2D(a * (classes + 1), 3,
+                                           padding=1))
+            self.box_preds.add(nn.Conv2D(a * 4, 3, padding=1))
+
+    def forward(self, x):
+        feats = self.features(x)
+        cls_preds, loc_preds, anchors = [], [], []
+        for i, feat in enumerate(feats):
+            cp = self.class_preds[i](feat)       # (N, A*(C+1), H, W)
+            bp = self.box_preds[i](feat)         # (N, A*4, H, W)
+            N, _, H, W = cp.shape
+            a = len(self._sizes[i]) + len(self._ratios[i]) - 1
+            cls_preds.append(
+                cp.transpose(0, 2, 3, 1).reshape(
+                    N, H * W * a, self._classes + 1))
+            loc_preds.append(
+                bp.transpose(0, 2, 3, 1).reshape(N, H * W * a * 4))
+            anchors.append(_op('multibox_prior', feat,
+                               sizes=self._sizes[i],
+                               ratios=self._ratios[i], clip=True))
+        cls_pred = _op('concatenate', cls_preds, axis=1)  # (N, A, C+1)
+        loc_pred = _op('concatenate', loc_preds, axis=1)  # (N, A*4)
+        anchor = _op('concatenate', anchors, axis=1)      # (1, A, 4)
+
+        # is_training (not is_recording): inside a hybridized trace the
+        # recorder is off but the train flag carries through, so the
+        # training branch compiles correctly under hybridize too
+        if _tape.is_training():
+            return cls_pred, loc_pred, anchor
+
+        cls_prob = _op('softmax', cls_pred, axis=-1)
+        cls_prob = cls_prob.transpose(0, 2, 1)            # (N, C+1, A)
+        dets = _op('multibox_detection', cls_prob, loc_pred, anchor,
+                   nms_threshold=self._nms_thresh,
+                   nms_topk=self._nms_topk)               # (N, A, 6)
+        # fixed-size output: top post_nms by score (clamped to the
+        # anchor count — small inputs/configs can have A < post_nms)
+        scores = dets[:, :, 1]
+        k = min(self._post_nms, int(scores.shape[1]))
+        idx = _op('topk', scores, axis=1, k=k,
+                  ret_typ='indices', is_ascend=False, dtype='int32')
+        top = _op('take_along_axis', dets,
+                  mnp.expand_dims(idx, -1).astype('int32'), 1)
+        return top[:, :, 0], top[:, :, 1], top[:, :, 2:]
+
+
+def ssd_300_resnet18_v1(classes=20, **kwargs):
+    """SSD-300-class model over the resnet18 trunk (reference
+    example/ssd ssd_300 config; GluonCV naming convention)."""
+    return SSD(classes=classes, **kwargs)

@@ -210,7 +210,10 @@ class YOLOv3(HybridBlock):
             if i < 2:
                 route = self.routes[i](route_in)
 
-        if _tape.is_recording():
+        # is_training (not is_recording): inside a hybridized trace the
+        # recorder is off but the train flag carries through, so the
+        # training branch compiles correctly under hybridize too
+        if _tape.is_training():
             return tuple(stage_preds)                 # training: raw heads
 
         decoded = [self._decode_stage(p, i)
