@@ -26,10 +26,18 @@ _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     'librecordio.so')
 
 
-def _build():
-    cmd = ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', '-o', _OUT,
-           _SRC, '-lpthread']
-    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _compile_and_load(src, out, extra_libs=(), opt='-O3'):
+    """Build-if-stale + dlopen, shared by every native component."""
+    if not os.path.exists(out) or (
+            os.path.exists(src) and
+            os.path.getmtime(src) > os.path.getmtime(out)):
+        cmd = ['g++', opt, '-std=c++17', '-shared', '-fPIC', '-o', out,
+               src] + list(extra_libs) + ['-lpthread']
+        subprocess.run(cmd, check=True, capture_output=True)
+    return ctypes.CDLL(out)
+
 
 
 def get_lib():
@@ -40,11 +48,7 @@ def get_lib():
             return _lib
         _tried = True
         try:
-            if not os.path.exists(_OUT) or (
-                    os.path.exists(_SRC) and
-                    os.path.getmtime(_SRC) > os.path.getmtime(_OUT)):
-                _build()
-            lib = ctypes.CDLL(_OUT)
+            lib = _compile_and_load(_SRC, _OUT)
         except Exception as e:  # toolchain missing / build failure
             logging.info('native recordio unavailable (%s); '
                          'using pure-Python path', e)
@@ -192,14 +196,8 @@ def get_imagepipe_lib():
             return _ip_lib
         _ip_tried = True
         try:
-            if not os.path.exists(_IP_OUT) or (
-                    os.path.exists(_IP_SRC) and
-                    os.path.getmtime(_IP_SRC) > os.path.getmtime(_IP_OUT)):
-                cmd = ['g++', '-O3', '-std=c++17', '-shared', '-fPIC',
-                       '-o', _IP_OUT, _IP_SRC, '-ljpeg', '-lpng',
-                       '-lpthread']
-                subprocess.run(cmd, check=True, capture_output=True)
-            lib = ctypes.CDLL(_IP_OUT)
+            lib = _compile_and_load(_IP_SRC, _IP_OUT,
+                                    extra_libs=('-ljpeg', '-lpng'))
         except Exception as e:
             logging.info('native image pipeline unavailable (%s); '
                          'using Python decode path', e)
@@ -219,3 +217,94 @@ def get_imagepipe_lib():
         lib.ipipe_close.argtypes = [c.c_void_p]
         _ip_lib = lib
         return _ip_lib
+
+
+# ------------------------------------------------------- text parsers
+_tp_lock = threading.Lock()
+_tp_lib = None
+_tp_tried = False
+_TP_SRC = os.path.join(os.path.dirname(_SRC), 'textparse.cc')
+_TP_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       'libtextparse.so')
+
+
+def get_textparse_lib():
+    """Load (building if needed) the threaded libsvm/CSV parser
+    (src_native/textparse.cc — role of the reference's iter_libsvm.cc /
+    iter_csv.cc dmlc parsers); None -> callers use the numpy path."""
+    global _tp_lib, _tp_tried
+    with _tp_lock:
+        if _tp_lib is not None or _tp_tried:
+            return _tp_lib
+        _tp_tried = True
+        try:
+            lib = _compile_and_load(_TP_SRC, _TP_OUT)
+        except Exception as e:
+            logging.info('native text parser unavailable (%s); '
+                         'using numpy path', e)
+            return None
+        c = ctypes
+        lib.tp_load_libsvm.restype = c.c_void_p
+        lib.tp_load_libsvm.argtypes = [c.c_char_p, c.c_int64, c.c_int64]
+        lib.tp_load_csv.restype = c.c_void_p
+        lib.tp_load_csv.argtypes = [c.c_char_p, c.c_int64]
+        lib.tp_rows.restype = c.c_int64
+        lib.tp_rows.argtypes = [c.c_void_p]
+        lib.tp_error.restype = c.c_char_p
+        lib.tp_error.argtypes = [c.c_void_p]
+        lib.tp_copy_data.argtypes = [c.c_void_p, c.POINTER(c.c_float)]
+        lib.tp_copy_labels.argtypes = [c.c_void_p, c.POINTER(c.c_float)]
+        lib.tp_free.argtypes = [c.c_void_p]
+        _tp_lib = lib
+        return _tp_lib
+
+
+def parse_libsvm(path, width, label_width=1):
+    """Parse a libsvm file into (data (N, width), labels (N, label_width))
+    float32 arrays with the threaded native parser; None if unavailable."""
+    import numpy as _np
+    lib = get_textparse_lib()
+    if lib is None:
+        return None
+    h = lib.tp_load_libsvm(str(path).encode(), width, label_width)
+    try:
+        err = lib.tp_error(h)
+        if err:
+            msg = err.decode()
+            if msg.startswith('cannot open'):
+                raise FileNotFoundError(msg)
+            raise ValueError(f'libsvm parse error: {msg}')
+        n = lib.tp_rows(h)
+        data = _np.empty((n, width), _np.float32)
+        labels = _np.empty((n, label_width), _np.float32)
+        lib.tp_copy_data(h, data.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)))
+        lib.tp_copy_labels(h, labels.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)))
+        return data, labels
+    finally:
+        lib.tp_free(h)
+
+
+def parse_csv(path, width):
+    """Parse a CSV of floats into an (N, width) float32 array with the
+    threaded native parser; None if unavailable."""
+    import numpy as _np
+    lib = get_textparse_lib()
+    if lib is None:
+        return None
+    h = lib.tp_load_csv(str(path).encode(), width)
+    try:
+        err = lib.tp_error(h)
+        if err:
+            msg = err.decode()
+            if msg.startswith('cannot open'):
+                raise FileNotFoundError(msg)
+            raise ValueError(f'csv parse error: {msg}')
+        n = lib.tp_rows(h)
+        data = _np.empty((n, width), _np.float32)
+        lib.tp_copy_data(h, data.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)))
+        return data
+    finally:
+        lib.tp_free(h)

@@ -306,13 +306,18 @@ class PrefetchingIter(DataIter):
 
 def CSVIter(data_csv, data_shape, label_csv=None, label_shape=(1,),
             batch_size=1, **kwargs):
-    """Reference src/io/iter_csv.cc — host-side CSV load into NDArrayIter."""
-    data = _np.loadtxt(data_csv, delimiter=',').reshape((-1,) + tuple(
-        data_shape))
-    label = None
-    if label_csv is not None:
-        label = _np.loadtxt(label_csv, delimiter=',').reshape(
-            (-1,) + tuple(label_shape))
+    """Reference src/io/iter_csv.cc — threaded native parse
+    (src_native/textparse.cc) with a numpy fallback, into NDArrayIter."""
+    from .. import _native
+
+    def load(path, shape):
+        parsed = _native.parse_csv(path, int(_np.prod(shape)))
+        if parsed is None:
+            parsed = _np.loadtxt(path, delimiter=',')
+        return parsed.reshape((-1,) + tuple(shape))
+
+    data = load(data_csv, data_shape)
+    label = load(label_csv, label_shape) if label_csv is not None else None
     return NDArrayIter(data, label, batch_size=batch_size, **kwargs)
 
 
@@ -321,7 +326,30 @@ def LibSVMIter(data_libsvm, data_shape, label_libsvm=None,
     """Reference src/io/iter_libsvm.cc — parse libsvm ``label idx:val``
     lines into dense batches (the TPU form: CSR text is a host format;
     on-device the batch is a dense matrix, with RowSparse available via
-    ndarray.sparse for the embedding path)."""
+    ndarray.sparse for the embedding path). The parse runs in the
+    threaded native parser (src_native/textparse.cc) when the toolchain
+    is available, else pure Python."""
+    from .. import _native
+
+    def load_label_file():
+        # separate label file: plain values per line (reference
+        # iter_libsvm.cc label_libsvm layout), no idx:val tokens
+        with open(label_libsvm) as f:
+            lab = _np.asarray(
+                [[float(v) for v in line.replace(',', ' ').split()]
+                 for line in f if line.strip()], _np.float32)
+        return lab.reshape((-1,) + tuple(label_shape))
+
+    width = int(_np.prod(data_shape))
+    lwidth = int(_np.prod(label_shape))
+    native = _native.parse_libsvm(data_libsvm, width, lwidth)
+    if native is not None:
+        data, inline_labels = native
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = load_label_file() if label_libsvm is not None else \
+            inline_labels.reshape((-1,) + tuple(label_shape))
+        return NDArrayIter(data, label, batch_size=batch_size, **kwargs)
+
     def parse(path, width):
         rows, labels = [], []
         with open(path) as f:
@@ -341,13 +369,7 @@ def LibSVMIter(data_libsvm, data_shape, label_libsvm=None,
     data, inline_labels = parse(data_libsvm, width)
     data = data.reshape((-1,) + tuple(data_shape))
     if label_libsvm is not None:
-        # separate label file: plain values per line (reference
-        # iter_libsvm.cc label_libsvm layout), no idx:val tokens
-        with open(label_libsvm) as f:
-            label = _np.asarray(
-                [[float(v) for v in line.replace(',', ' ').split()]
-                 for line in f if line.strip()], _np.float32)
-        label = label.reshape((-1,) + tuple(label_shape))
+        label = load_label_file()
     else:
         label = inline_labels.reshape((-1,) + tuple(label_shape))
     return NDArrayIter(data, label, batch_size=batch_size, **kwargs)

@@ -1,0 +1,214 @@
+// Multi-threaded text-format parsers for the data pipeline.
+//
+// Role of the reference's C++ iterators src/io/iter_libsvm.cc and
+// src/io/iter_csv.cc (both dmlc Parser-based, chunked + threaded): parse
+// libsvm "label idx:val ..." lines or CSV rows into dense float batches
+// on the host, off the Python GIL. The file is split at line boundaries
+// into one chunk per hardware thread; rows are stitched back in order.
+//
+// Flat C ABI (ctypes-friendly, matching src_native/recordio.cc style):
+//   tp_load_libsvm(path, width, label_width) -> handle
+//   tp_load_csv(path, width)                 -> handle
+//   tp_rows(handle)                          -> int64
+//   tp_copy_data(handle, float*)   /  tp_copy_labels(handle, float*)
+//   tp_error(handle)                         -> const char* ("" if ok)
+//   tp_free(handle)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Parsed {
+  int64_t width = 0;
+  int64_t label_width = 0;
+  std::vector<float> data;    // rows x width
+  std::vector<float> labels;  // rows x label_width
+  std::string error;
+};
+
+struct Chunk {
+  const char* begin;
+  const char* end;
+  std::vector<float> data;
+  std::vector<float> labels;
+  std::string error;
+};
+
+// Advance to the first character after the next '\n' at or past p.
+const char* NextLineStart(const char* p, const char* end) {
+  while (p < end && *p != '\n') ++p;
+  return p < end ? p + 1 : end;
+}
+
+bool ReadFile(const char* path, std::string* out, std::string* err) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    *err = std::string("cannot open ") + path;
+    return false;
+  }
+  f.seekg(0, std::ios::end);
+  out->resize(static_cast<size_t>(f.tellg()));
+  f.seekg(0);
+  f.read(&(*out)[0], static_cast<std::streamsize>(out->size()));
+  return true;
+}
+
+void ParseLibsvmChunk(Chunk* c, int64_t width, int64_t label_width) {
+  const char* p = c->begin;
+  while (p < c->end) {
+    const char* line_end = p;
+    while (line_end < c->end && *line_end != '\n') ++line_end;
+    if (line_end > p) {  // skip empty lines
+      size_t row0 = c->data.size();
+      c->data.resize(row0 + width, 0.0f);
+      // labels: leading comma-separated floats before the first idx:val
+      const char* q = p;
+      int64_t nlab = 0;
+      while (q < line_end && nlab < label_width) {
+        char* after = nullptr;
+        float v = strtof(q, &after);
+        if (after == q) break;
+        c->labels.push_back(v);
+        ++nlab;
+        q = after;
+        if (q < line_end && *q == ',') { ++q; continue; }
+        break;
+      }
+      if (nlab < label_width) {
+        c->error = "libsvm line has fewer labels than label_width";
+        return;
+      }
+      // idx:val pairs
+      while (q < line_end) {
+        while (q < line_end && (*q == ' ' || *q == '\t' || *q == '\r'))
+          ++q;
+        if (q >= line_end) break;
+        char* after = nullptr;
+        long idx = strtol(q, &after, 10);
+        if (after == q || after >= line_end || *after != ':') {
+          c->error = "malformed libsvm token";
+          return;
+        }
+        q = after + 1;
+        float v = strtof(q, &after);
+        if (after == q) { c->error = "malformed libsvm value"; return; }
+        q = after;
+        if (idx < 0 || idx >= width) {
+          c->error = "libsvm feature index out of range for width";
+          return;
+        }
+        c->data[row0 + idx] = v;
+      }
+    }
+    p = line_end < c->end ? line_end + 1 : c->end;
+  }
+}
+
+void ParseCsvChunk(Chunk* c, int64_t width) {
+  const char* p = c->begin;
+  while (p < c->end) {
+    const char* line_end = p;
+    while (line_end < c->end && *line_end != '\n') ++line_end;
+    if (line_end > p) {
+      size_t row0 = c->data.size();
+      c->data.resize(row0 + width, 0.0f);
+      const char* q = p;
+      int64_t got = 0;
+      for (int64_t i = 0; i < width && q < line_end; ++i) {
+        char* after = nullptr;
+        float v = strtof(q, &after);
+        if (after == q) break;
+        c->data[row0 + i] = v;
+        ++got;
+        q = after;
+        if (q < line_end && (*q == ',' || *q == ' ')) ++q;
+      }
+      // strict like np.loadtxt: ragged rows are an error, not padding
+      while (q < line_end && (*q == '\r' || *q == ' ')) ++q;
+      if (got != width || q != line_end) {
+        c->error = "csv row width mismatch";
+        return;
+      }
+    }
+    p = line_end < c->end ? line_end + 1 : c->end;
+  }
+}
+
+Parsed* LoadThreaded(const char* path, int64_t width, int64_t label_width,
+                     bool libsvm) {
+  auto* out = new Parsed();
+  out->width = width;
+  out->label_width = label_width;
+  std::string buf;
+  if (!ReadFile(path, &buf, &out->error)) return out;
+
+  unsigned n_threads = std::max(1u, std::thread::hardware_concurrency());
+  size_t approx = buf.size() / n_threads + 1;
+  std::vector<Chunk> chunks;
+  const char* p = buf.data();
+  const char* end = buf.data() + buf.size();
+  while (p < end) {
+    const char* stop = p + approx < end ? p + approx : end;
+    stop = NextLineStart(stop - 1, end);  // align to line boundary
+    chunks.push_back(Chunk{p, stop});
+    p = stop;
+  }
+  std::vector<std::thread> workers;
+  for (auto& c : chunks) {
+    workers.emplace_back([&c, width, label_width, libsvm] {
+      if (libsvm) ParseLibsvmChunk(&c, width, label_width);
+      else ParseCsvChunk(&c, width);
+    });
+  }
+  for (auto& w : workers) w.join();
+  for (auto& c : chunks) {
+    if (!c.error.empty()) { out->error = c.error; return out; }
+    out->data.insert(out->data.end(), c.data.begin(), c.data.end());
+    out->labels.insert(out->labels.end(), c.labels.begin(),
+                       c.labels.end());
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tp_load_libsvm(const char* path, int64_t width,
+                     int64_t label_width) {
+  return LoadThreaded(path, width, label_width, true);
+}
+
+void* tp_load_csv(const char* path, int64_t width) {
+  return LoadThreaded(path, width, 0, false);
+}
+
+int64_t tp_rows(void* h) {
+  auto* p = static_cast<Parsed*>(h);
+  return p->width ? static_cast<int64_t>(p->data.size()) / p->width : 0;
+}
+
+const char* tp_error(void* h) {
+  return static_cast<Parsed*>(h)->error.c_str();
+}
+
+void tp_copy_data(void* h, float* dst) {
+  auto* p = static_cast<Parsed*>(h);
+  std::memcpy(dst, p->data.data(), p->data.size() * sizeof(float));
+}
+
+void tp_copy_labels(void* h, float* dst) {
+  auto* p = static_cast<Parsed*>(h);
+  std::memcpy(dst, p->labels.data(), p->labels.size() * sizeof(float));
+}
+
+void tp_free(void* h) { delete static_cast<Parsed*>(h); }
+
+}  // extern "C"

@@ -208,3 +208,75 @@ def test_image_record_iter_batches_do_not_alias(tmp_path):
     it.next()                                 # refills the host buffer
     onp.testing.assert_array_equal(b1.asnumpy(), snap)
     it.close()
+
+
+def test_native_textparse_libsvm_and_csv(tmp_path):
+    """Threaded native parser (src_native/textparse.cc) matches the
+    Python fallback (reference iter_libsvm.cc / iter_csv.cc roles)."""
+    from mxnet_tpu import _native
+    lib = _native.get_textparse_lib()
+    if lib is None:
+        import pytest
+        pytest.skip('toolchain unavailable')
+    import numpy as onp
+    rng = onp.random.RandomState(0)
+    # 1000 rows exercises the multi-chunk threaded path
+    lines = []
+    want = onp.zeros((1000, 8), 'f')
+    labs = onp.zeros((1000,), 'f')
+    for i in range(1000):
+        nz = rng.choice(8, 3, replace=False)
+        vals = rng.randn(3).astype('f')
+        want[i, nz] = vals
+        labs[i] = i % 5
+        lines.append(f'{i % 5} ' + ' '.join(
+            f'{j}:{v:.6f}' for j, v in zip(nz, vals)))
+    p = tmp_path / 'big.libsvm'
+    p.write_text('\n'.join(lines) + '\n')
+    data, labels = _native.parse_libsvm(str(p), 8, 1)
+    onp.testing.assert_allclose(data, want, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(labels.ravel(), labs)
+    # CSV
+    c = tmp_path / 'big.csv'
+    mat = rng.randn(500, 6).astype('f')
+    c.write_text('\n'.join(','.join(f'{v:.6f}' for v in row)
+                           for row in mat) + '\n')
+    got = _native.parse_csv(str(c), 6)
+    onp.testing.assert_allclose(got, mat, rtol=1e-5, atol=1e-6)
+
+
+def test_csv_and_libsvm_iters_use_native(tmp_path):
+    from mxnet_tpu import io as mxio
+    import numpy as onp
+    d = tmp_path / 'd.csv'
+    d.write_text('1,2\n3,4\n5,6\n7,8\n')
+    l = tmp_path / 'l.csv'
+    l.write_text('0\n1\n0\n1\n')
+    it = mxio.CSVIter(str(d), (2,), label_csv=str(l), batch_size=2)
+    b = next(it)
+    onp.testing.assert_allclose(b.data[0].asnumpy(), [[1, 2], [3, 4]])
+    onp.testing.assert_allclose(b.label[0].asnumpy().ravel(), [0, 1])
+
+
+def test_native_textparse_strictness(tmp_path):
+    """Native parsers must FAIL like the fallbacks on malformed input
+    (round-2 review): out-of-range index, missing labels, ragged CSV,
+    missing file."""
+    import pytest
+    from mxnet_tpu import _native
+    if _native.get_textparse_lib() is None:
+        pytest.skip('toolchain unavailable')
+    p = tmp_path / 'bad.libsvm'
+    p.write_text('1 500:1.5\n')
+    with pytest.raises(ValueError, match='out of range'):
+        _native.parse_libsvm(str(p), 4, 1)
+    p2 = tmp_path / 'short.libsvm'
+    p2.write_text('1 0:1.0\n')
+    with pytest.raises(ValueError, match='fewer labels'):
+        _native.parse_libsvm(str(p2), 4, 3)
+    c = tmp_path / 'ragged.csv'
+    c.write_text('1,2,3\n4,5\n')
+    with pytest.raises(ValueError, match='width mismatch'):
+        _native.parse_csv(str(c), 3)
+    with pytest.raises(FileNotFoundError):
+        _native.parse_csv(str(tmp_path / 'nope.csv'), 3)
