@@ -1,0 +1,74 @@
+"""Large sparse-gradient embeddings: matrix factorization.
+
+The use case row_sparse exists for (reference example/sparse +
+Embedding(sparse_grad=True)): two million-row embedding tables train
+with O(batch) gradient storage — the gradient is (values, ids), the
+lazy optimizer touches only referenced rows, and row_sparse_pull
+returns row slices.
+
+Run: python examples/sparse_embedding.py [--rows 1000000] [--cpu]
+"""
+
+import argparse
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--rows', type=int, default=1_000_000)
+    parser.add_argument('--dim', type=int, default=16)
+    parser.add_argument('--steps', type=int, default=40)
+    parser.add_argument('--batch', type=int, default=512)
+    parser.add_argument('--cpu', action='store_true')
+    args = parser.parse_args()
+
+    if args.cpu:
+        import os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import _cpu_guard
+        _cpu_guard.force_cpu()
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.ndarray import sparse as _sp
+
+    N, D = args.rows, args.dim
+    users = gluon.nn.Embedding(N, D, sparse_grad=True)
+    items = gluon.nn.Embedding(N, D, sparse_grad=True)
+    users.initialize(init=mx.initializer.Normal(0.1))
+    items.initialize(init=mx.initializer.Normal(0.1))
+    params = {f'u_{k}': v for k, v in users.collect_params().items()}
+    params.update({f'i_{k}': v for k, v in items.collect_params().items()})
+    trainer = gluon.Trainer(params, 'adagrad', {'learning_rate': 0.5},
+                            kvstore=None)
+
+    rng = onp.random.default_rng(0)
+    # keep ids integral: float32 would alias rows above 2^24
+    u = mx.np.array(rng.integers(0, N, args.batch))
+    i = mx.np.array(rng.integers(0, N, args.batch))
+    y = mx.np.array(rng.uniform(0.5, 1.5, args.batch).astype('f'))
+
+    for step in range(args.steps):
+        with autograd.record():
+            pred = (users(u) * items(i)).sum(-1)
+            loss = ((pred - y) ** 2).mean()
+        loss.backward()
+        g = users.weight.grad()
+        assert isinstance(g, _sp.RowSparseNDArray)   # O(batch) storage
+        trainer.step(1)
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f'step {step}: mse {float(loss.asnumpy()):.5f} '
+                  f'(grad rows: {g.data.shape[0]} of {N:,})')
+
+    # serve a few rows without densifying the table
+    kv = mx.kvstore.create('device')
+    kv.init('users', users.weight.data())
+    pulled = kv.row_sparse_pull('users', row_ids=u[:4])
+    print('pulled row slices:', pulled.data.shape)
+
+
+if __name__ == '__main__':
+    main()
