@@ -17,7 +17,7 @@ from . import pipeline
 from . import moe
 from . import checkpoint
 from .checkpoint import (save_sharded, restore_sharded,
-                         SharedCheckpointManager)
+                         SharedCheckpointManager, restore_or_init)
 from .pipeline import pipeline_apply, stack_stage_params
 from .moe import moe_ffn
 

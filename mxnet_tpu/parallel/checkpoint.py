@@ -18,6 +18,7 @@ bridge a Gluon Block onto the collective path.
 import os as _os
 
 import jax
+import jax.numpy as jnp
 import numpy as _np
 
 from ..ndarray.ndarray import NDArray
@@ -50,6 +51,63 @@ def _to_raw(tree):
 
     return jax.tree.map(conv, tree,
                         is_leaf=lambda x: isinstance(x, (NDArray, Parameter)))
+
+
+def _globalize(tree):
+    """Multi-process saves require GLOBAL arrays; replicated host-local
+    leaves (the Trainer's data-parallel params — identical on every
+    rank) are wrapped as fully-replicated global arrays so orbax can
+    serialize them collectively. Sharded/global leaves pass through."""
+    if jax.process_count() == 1:
+        return tree
+    import numpy as _onp
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    mesh = Mesh(_onp.array([per_proc[p] for p in sorted(per_proc)]),
+                ('rep',))
+
+    locals_ = [x for x in jax.tree.leaves(tree)
+               if isinstance(x, jax.Array) and x.is_fully_addressable]
+    if locals_:
+        # loud failure instead of silent nondeterminism: a host-local
+        # leaf that differs across ranks (rank-local RNG key, counter)
+        # cannot be saved as "replicated" — cheap scalar fingerprints
+        # ride one collective
+        fps = jnp.stack([x.astype(jnp.float32).sum() for x in locals_])
+        multihost_utils.assert_equal(
+            fps, 'checkpoint leaves must be identical across ranks; '
+                 'rank-local state cannot be saved as replicated')
+
+    def conv(x):
+        if isinstance(x, jax.Array) and x.is_fully_addressable:
+            return multihost_utils.host_local_array_to_global_array(
+                _onp.asarray(x), mesh, P())
+        return x
+
+    return jax.tree.map(conv, tree)
+
+
+def _localize(tree):
+    """Inverse of :func:`_globalize` on restore: fully-replicated global
+    leaves come back as ordinary host-local arrays."""
+    if jax.process_count() == 1:
+        return tree
+
+    def conv(x):
+        # only fully-REPLICATED globals localize (their one addressable
+        # shard IS the whole value); genuinely sharded leaves pass
+        # through untouched — truncating them to a local shard would
+        # silently corrupt mesh-sharded training state
+        if isinstance(x, jax.Array) and not x.is_fully_addressable \
+                and x.sharding.is_fully_replicated:
+            return jnp.asarray(x.addressable_data(0))
+        return x
+
+    return jax.tree.map(conv, tree)
 
 
 def save_sharded(directory, tree, force=True):
@@ -110,7 +168,8 @@ class SharedCheckpointManager:
 
     def save(self, step, tree):
         ocp = _ocp
-        self._mgr.save(step, args=ocp.args.StandardSave(_to_raw(tree)))
+        self._mgr.save(step, args=ocp.args.StandardSave(
+            _globalize(_to_raw(tree))))
         self._mgr.wait_until_finished()
 
     def restore(self, step=None, template=None):
@@ -118,9 +177,10 @@ class SharedCheckpointManager:
         if step is None:
             step = self._mgr.latest_step()
         if template is not None:
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(_to_raw(template)))
-        return self._mgr.restore(step)
+            return _localize(self._mgr.restore(
+                step, args=ocp.args.StandardRestore(
+                    _globalize(_to_raw(template)))))
+        return _localize(self._mgr.restore(step))
 
     def latest_step(self):
         return self._mgr.latest_step()

@@ -1,0 +1,100 @@
+"""Elastic crash-and-resume worker script.
+
+SURVEY §5 failure detection / elastic recovery: the reference has only
+PS heartbeats (``get_num_dead_node``) — its recovery model is "restart
+the job". This exercises OUR recovery model end to end with real fault
+injection: the whole SPMD job is killed mid-training (rank 0 calls
+``os._exit(1)`` at a chosen step on the first launch), the launcher
+relaunches it, and ``restore_or_init`` resumes from the newest sharded
+checkpoint; the resumed run must converge to EXACTLY the same weights
+as an uninterrupted run (training is deterministic given the restored
+state).
+
+Run (the pytest wrapper in test_dist_multiproc.py does this twice):
+    MX_CRASH_AT_STEP=4 python tools/launch.py -n 2 --launcher local \
+        python tests/nightly/elastic_resume.py <ckpt_dir>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import _cpu_guard  # noqa: E402
+_cpu_guard.force_cpu()
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, parallel  # noqa: E402
+
+TOTAL_STEPS = 8
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    crash_at = int(os.environ.get('MX_CRASH_AT_STEP', '-1'))
+    parallel.init_distributed()
+    import jax
+    rank, size = jax.process_index(), jax.process_count()
+
+    onp.random.seed(3)
+    w_true = onp.random.randn(6, 1).astype('f')
+    x_all = onp.random.randn(32 * size, 6).astype('f')
+    y_all = x_all @ w_true
+    shard = slice(rank * 32, (rank + 1) * 32)
+    x = mx.np.array(x_all[shard])
+    y = mx.np.array(y_all[shard])
+
+    net = gluon.nn.Dense(1, in_units=6)
+    net.initialize(init=mx.initializer.Zero())
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.05, 'momentum': 0.9},
+                            kvstore='dist_tpu_sync')
+    loss_fn = gluon.loss.L2Loss()
+
+    mgr = parallel.SharedCheckpointManager(ckpt_dir, max_to_keep=3)
+
+    def snapshot():
+        # weights AND optimizer state: momentum must survive the crash
+        # or the resumed trajectory diverges from the uninterrupted one
+        out = {'weight': net.weight.data()._data,
+               'bias': net.bias.data()._data}
+        for i, s in trainer._states.items():
+            if s is not None:
+                out[f'mom_{i}'] = s._data
+        return out
+
+    state, start = parallel.restore_or_init(mgr, snapshot)
+    if start is not None and start >= 0:
+        net.weight.set_data(mx.np.array(onp.asarray(state['weight'])))
+        net.bias.set_data(mx.np.array(onp.asarray(state['bias'])))
+        from mxnet_tpu.ndarray.ndarray import NDArray
+        import jax.numpy as jnp
+        for k, v in state.items():
+            if k.startswith('mom_'):
+                trainer._states[int(k[4:])] = NDArray(
+                    jnp.asarray(onp.asarray(v)))
+        print(f'worker {rank}: resumed from step {start}', flush=True)
+
+    for step in range(start + 1, TOTAL_STEPS):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(1)
+        mgr.save(step, snapshot())      # save() waits internally
+        if step == crash_at:
+            # fault injection: hard-kill THIS process mid-job (no
+            # cleanup, no checkpoint flush beyond what save completed)
+            print(f'worker {rank}: injected crash at step {step}',
+                  flush=True)
+            os._exit(1)
+
+    mgr.close()
+    w = net.weight.data().asnumpy()
+    print(f'worker {rank}/{size}: done at step {TOTAL_STEPS - 1}, '
+          f'wsum {float(w.sum()):.6f}', flush=True)
+
+
+if __name__ == '__main__':
+    main()

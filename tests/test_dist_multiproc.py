@@ -74,3 +74,45 @@ def test_two_process_dist_async_kvstore():
     assert res.returncode == 0, out[-4000:]
     for r in range(2):
         assert f'worker {r}/2: all dist_async assertions passed' in out
+
+
+@pytest.mark.timeout(620)   # three 180s launches + slack
+def test_elastic_crash_and_resume(tmp_path):
+    """Real fault injection (SURVEY §5): the 2-process job is hard-killed
+    mid-training, relaunched, resumes from the newest sharded checkpoint,
+    and converges to the SAME weights as an uninterrupted run."""
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+
+    def launch(ckpt, crash_at, port):
+        e = dict(env)
+        if crash_at >= 0:
+            e['MX_CRASH_AT_STEP'] = str(crash_at)
+        return subprocess.run(
+            [sys.executable, os.path.join(ROOT, 'tools', 'launch.py'),
+             '-n', '2', '--launcher', 'local', '--port', str(port),
+             sys.executable,
+             os.path.join(ROOT, 'tests', 'nightly', 'elastic_resume.py'),
+             str(ckpt)],
+            capture_output=True, text=True, timeout=180, env=e, cwd=ROOT)
+
+    # uninterrupted reference run
+    res = launch(tmp_path / 'ref', -1, 49921)
+    assert res.returncode == 0, (res.stdout + res.stderr)[-3000:]
+
+    # crashed run: rank processes exit at step 4 -> nonzero returncode
+    res1 = launch(tmp_path / 'ckpt', 4, 49922)
+    assert res1.returncode != 0
+    assert 'injected crash at step 4' in res1.stdout + res1.stderr
+
+    # relaunch: resumes from the newest checkpoint and finishes
+    res2 = launch(tmp_path / 'ckpt', -1, 49923)
+    out2 = res2.stdout + res2.stderr
+    assert res2.returncode == 0, out2[-3000:]
+    assert 'resumed from step' in out2
+    import re
+    # identical final weights as the uninterrupted run (regex: worker
+    # stdout lines can interleave mid-line through the launcher)
+    ref_w = sorted(re.findall(r'wsum (-?\d+\.\d+)', res.stdout))
+    got_w = sorted(re.findall(r'wsum (-?\d+\.\d+)', res2.stdout))
+    assert ref_w == got_w and len(got_w) == 2, (ref_w, got_w)
