@@ -40,7 +40,11 @@ def _register_rules(np_, large=(1024, 1024), nn_scale=8):
     for n in ['exp', 'log', 'sqrt', 'sin', 'cos', 'tanh', 'abs', 'square',
               'relu', 'sigmoid', 'erf', 'gelu', 'softplus', 'silu', 'sign',
               'floor', 'ceil', 'rint', 'negative', 'reciprocal', 'cbrt',
-              'log1p', 'expm1']:
+              'log1p', 'expm1',
+              # round-2 additions
+              'softsign', 'quadratic', 'div_sqrt_dim', 'round_ste',
+              'sign_ste', 'gradient_multiplier', 'square_sum',
+              'amp_cast']:
         rule(n, args=lambda u=u: (u(*LARGE),))
     for n in ['add', 'subtract', 'multiply', 'true_divide', 'power',
               'maximum', 'minimum', 'hypot', 'arctan2', 'logaddexp']:
