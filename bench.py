@@ -367,7 +367,8 @@ def bench_llama_decode(args, mx):
         net.cast(dtype)
     n_new = max(args.iters, 32)
     out = net.generate(prompt, max_new_tokens=n_new)       # compile
-    out.wait_to_read()
+    float(out.asnumpy()[0, -1])   # dependent readback: wait_to_read
+    # returns early through the tunnel, leaving compile+exec unpaid
     # time a DIFFERENT prompt: the dev tunnel content-caches identical
     # (program, inputs) executions, so re-timing the warmup prompt would
     # measure the cache instead of the decode loop
@@ -440,7 +441,8 @@ def bench_yolo(args, mx):
     outs = net(batch_i(0))          # compile (also covers --warmup 0)
     for i in range(args.warmup):
         outs = net(batch_i(i + 1))
-    outs[1].wait_to_read()
+    float(outs[1].asnumpy().ravel()[0])  # force compile+exec (tunnel's
+    # wait_to_read returns early for device-only work)
     t0 = time.perf_counter()
     results = []
     for i in range(args.iters):
