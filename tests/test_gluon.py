@@ -341,16 +341,15 @@ def test_hybridize_structure_dependent_outputs_not_confused():
 def test_batchnorm_relu_layer():
     """Reference basic_layers.py:449 BatchNormReLU
     (_contrib_BatchNormWithReLU): BN then fused relu."""
-    import numpy as onp
     net = mx.gluon.nn.BatchNormReLU()
     net.initialize()
-    x = mx.np.array(onp.random.RandomState(0).randn(4, 3, 5, 5).astype('f'))
+    x = mx.np.array(np.random.RandomState(0).randn(4, 3, 5, 5).astype('f'))
     out = net(x).asnumpy()
     assert (out >= 0).all()
     bn = mx.gluon.nn.BatchNorm()
     bn.initialize()
-    ref = onp.maximum(bn(x).asnumpy(), 0)
-    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+    ref = np.maximum(bn(x).asnumpy(), 0)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
 
 
 def test_hybrid_sequential_rnn_cell_alias():
@@ -358,8 +357,56 @@ def test_hybrid_sequential_rnn_cell_alias():
     cell.add(mx.gluon.rnn.LSTMCell(8))
     cell.add(mx.gluon.rnn.LSTMCell(8))
     cell.initialize()
-    import numpy as onp
-    x = mx.np.array(onp.ones((2, 4), 'f'))
+    x = mx.np.array(np.ones((2, 4), 'f'))
     out, states = cell(x, cell.begin_state(batch_size=2))
     assert out.shape == (2, 8)
     assert isinstance(cell, mx.gluon.rnn.SequentialRNNCell)
+
+
+def test_pure_function_scan_training():
+    """HybridBlock.pure_function: pure jax export powers lax.scan train
+    loops — loss decreases and BatchNorm running stats ride the carry."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    net = mx.gluon.nn.HybridSequential(
+        mx.gluon.nn.Dense(8, in_units=4),
+        mx.gluon.nn.BatchNorm(),
+        mx.gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    rng = np.random.RandomState(0)
+    feats = rng.randn(16, 4).astype('f')
+    feats[::2] += 2.0                      # separable classes
+    x0 = mx.np.array(feats)
+    net(x0)
+    pure, in_raws, params, aux = net.pure_function(x0, train=True)
+    labels = jnp.arange(16) % 2
+    key = jax.random.PRNGKey(0)
+
+    def step(carry, i):
+        ps, aux_s = carry
+
+        def loss_of(ps_):
+            outs, new_aux = pure(jax.random.fold_in(key, i),
+                                 in_raws, ps_, aux_s)
+            logp = jax.nn.log_softmax(outs[0].astype(jnp.float32))
+            return -logp[jnp.arange(16), labels].mean(), new_aux
+
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(ps)
+        ps = jax.tree.map(lambda w, g: w - 0.1 * g, ps, grads)
+        return (ps, tuple(new_aux)), loss
+
+    (ps1, aux1), losses = jax.jit(
+        lambda c: lax.scan(step, c, jnp.arange(20)))((params, aux))
+    assert float(losses[-1]) < float(losses[0])
+    # BatchNorm running stats must have moved through the carry
+    moved = any(not np.allclose(np.asarray(a0), np.asarray(a1))
+                for a0, a1 in zip(aux, aux1))
+    assert moved
+    # inference form: aux passes through unchanged
+    pure_eval, in_raws, params, aux = net.pure_function(x0, train=False)
+    outs, aux_out = pure_eval(key, in_raws, params, aux)
+    for a0, a1 in zip(aux, aux_out):
+        np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
