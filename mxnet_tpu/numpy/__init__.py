@@ -78,6 +78,60 @@ def shares_memory(a, b):
     return False
 
 
+# ------------------------------------------------- official-numpy fallback
+# (reference python/mxnet/numpy/fallback.py): any public numpy callable
+# not implemented on-device resolves to a host-side wrapper — NDArray
+# args round-trip through numpy, array results wrap back. Intended for
+# the host-utility tail (set ops, text IO, printing, dynamic-shape
+# ops); device math belongs in the op registry.
+_FALLBACK_BLOCK = {'save', 'savez', 'savez_compressed', 'load',
+                   'fromfile', 'frombuffer', 'memmap', 'test'}
+
+
+def __getattr__(name):
+    if name.startswith('_') or name in _FALLBACK_BLOCK or \
+            not hasattr(_onp, name):
+        raise AttributeError(f'module {__name__!r} has no attribute '
+                             f'{name!r}')
+    target = getattr(_onp, name)
+    if not callable(target) or isinstance(target, type):
+        raise AttributeError(f'module {__name__!r} has no attribute '
+                             f'{name!r}')
+
+    def _fallback(*args, **kwargs):
+        def remap(f, x):
+            if isinstance(x, (list, tuple)):
+                parts = [remap(f, e) for e in x]
+                if isinstance(x, tuple) and type(x) is not tuple:
+                    return type(x)(*parts)   # namedtuple (UniqueAll...)
+                return type(x)(parts)
+            return f(x)
+
+        def host(x):
+            return x.asnumpy() if isinstance(x, NDArray) else x
+
+        def wrap(o):
+            return array(o) if isinstance(o, _onp.ndarray) else o
+
+        out = target(*[remap(host, a) for a in args],
+                     **{k: remap(host, v) for k, v in kwargs.items()})
+        return remap(wrap, out)
+
+    _fallback.__name__ = name
+    _fallback.__doc__ = (f'Official-numpy HOST fallback for np.{name} '
+                         '(not a device op; reference numpy/fallback.py).')
+    return _fallback
+
+
+def __dir__():
+    names = set(globals())
+    names.update(n for n in dir(_onp)
+                 if not n.startswith('_') and n not in _FALLBACK_BLOCK
+                 and callable(getattr(_onp, n))
+                 and not isinstance(getattr(_onp, n), type))
+    return sorted(names)
+
+
 class linalg:
     """``mx.np.linalg`` namespace (reference numpy/linalg.py)."""
 

@@ -26,3 +26,4 @@ from . import optimizer_ops  # noqa: F401
 from . import fft_ops       # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import legacy_ops    # noqa: F401
+from . import numpy_extras  # noqa: F401

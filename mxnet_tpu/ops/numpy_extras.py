@@ -1,0 +1,160 @@
+"""NumPy-parity tail ops (round-2 coverage closure).
+
+Reference: the ``_npi_*`` long tail (src/operator/numpy/) plus the
+array-api aliases modern NumPy exposes. Everything here lowers to one
+jnp call (XLA fuses); names that cannot have static output shapes
+(set ops, extract, trim_zeros, ...) are served instead by the
+official-numpy HOST fallback in mxnet_tpu/numpy/__init__.py — the
+reference's numpy/fallback.py design.
+"""
+
+import jax.numpy as jnp
+
+from .registry import register
+
+# array-api aliases: one registration per name, all trivial jnp passthroughs
+_ALIAS_1IN = {
+    'acos': jnp.acos, 'asin': jnp.asin, 'atan': jnp.atan,
+    'acosh': jnp.acosh, 'asinh': jnp.asinh, 'atanh': jnp.atanh,
+    'bitwise_invert': jnp.bitwise_invert,
+    'matrix_transpose': jnp.matrix_transpose,
+    'nancumsum': jnp.nancumsum, 'nancumprod': jnp.nancumprod,
+    'modf': jnp.modf, 'frexp': jnp.frexp,
+}
+_ALIAS_2IN = {
+    'atan2': jnp.atan2, 'logaddexp2': jnp.logaddexp2, 'pow': jnp.pow,
+    'bitwise_left_shift': jnp.bitwise_left_shift,
+    'bitwise_right_shift': jnp.bitwise_right_shift,
+    'vecdot': jnp.vecdot, 'divmod': jnp.divmod,
+}
+
+for _name, _fn in _ALIAS_1IN.items():
+    n_out = 2 if _name in ('modf', 'frexp') else 1
+    register(_name, n_out=n_out)(
+        (lambda f: lambda x, **kw: f(x, **kw))(_fn))
+for _name, _fn in _ALIAS_2IN.items():
+    n_out = 2 if _name == 'divmod' else 1
+    register(_name, n_out=n_out)(
+        (lambda f: lambda a, b, **kw: f(a, b, **kw))(_fn))
+
+
+@register('permute_dims')
+def permute_dims(x, axes=None):
+    if axes is None:
+        axes = tuple(range(x.ndim))[::-1]
+    return jnp.permute_dims(x, tuple(axes))
+
+
+def _gradient_n_out(a, kw):
+    axis = kw.get('axis')
+    if axis is None:
+        nd = getattr(a[0], 'ndim', None)
+        return nd if nd else 1
+    return len(axis) if isinstance(axis, (tuple, list)) else 1
+
+
+@register('gradient', n_out=_gradient_n_out)
+def gradient(f, *varargs, axis=None):
+    """np.gradient on the device incl. spacing varargs (reference
+    fallback op list)."""
+    out = jnp.gradient(f, *varargs, axis=axis)
+    return out if not isinstance(out, list) else tuple(out)
+
+
+@register('digitize', differentiable=False)
+def digitize(x, bins, right=False):
+    return jnp.digitize(x, bins, right=right)
+
+
+@register('isin', differentiable=False)
+def isin(element, test_elements, invert=False):
+    return jnp.isin(element, test_elements, invert=invert)
+
+
+@register('nanmedian')
+def nanmedian(a, axis=None, keepdims=False):
+    return jnp.nanmedian(a, axis=axis, keepdims=keepdims)
+
+
+@register('nanpercentile')
+def nanpercentile(a, q, axis=None, keepdims=False):
+    return jnp.nanpercentile(a, q, axis=axis, keepdims=keepdims)
+
+
+@register('nanquantile')
+def nanquantile(a, q, axis=None, keepdims=False):
+    return jnp.nanquantile(a, q, axis=axis, keepdims=keepdims)
+
+
+@register('nanstd')
+def nanstd(a, axis=None, ddof=0, keepdims=False):
+    return jnp.nanstd(a, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register('nanvar')
+def nanvar(a, axis=None, ddof=0, keepdims=False):
+    return jnp.nanvar(a, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register('trapezoid')
+def trapezoid(y, x=None, dx=1.0, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+@register('partition', differentiable=False)
+def partition(a, kth, axis=-1):
+    return jnp.partition(a, kth, axis=axis)
+
+
+@register('argpartition', differentiable=False)
+def argpartition(a, kth, axis=-1):
+    return jnp.argpartition(a, kth, axis=axis)
+
+
+@register('put_along_axis')
+def put_along_axis(arr, indices, values, axis):
+    return jnp.put_along_axis(arr, indices.astype(jnp.int32), values,
+                              axis=axis, inplace=False)
+
+
+@register('select')
+def select(condlist, choicelist, default=0):
+    return jnp.select(list(condlist), list(choicelist), default=default)
+
+
+@register('choose')
+def choose(a, choices, mode='clip'):
+    return jnp.choose(a.astype(jnp.int32), list(choices), mode=mode)
+
+
+@register('lexsort', differentiable=False)
+def lexsort(keys, axis=-1):
+    return jnp.lexsort(list(keys), axis=axis)
+
+
+@register('histogram2d', differentiable=False, n_out=3)
+def histogram2d(x, y, bins=10, range=None, density=None):
+    h, ex, ey = jnp.histogram2d(x, y, bins=bins, range=range,
+                                density=density)
+    return h, ex, ey
+
+
+@register('histogram_bin_edges', differentiable=False)
+def histogram_bin_edges(a, bins=10, range=None):
+    return jnp.histogram_bin_edges(a, bins=bins, range=range)
+
+
+@register('geomspace')
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, axis=0):
+    return jnp.geomspace(start, stop, num=num, endpoint=endpoint,
+                         dtype=dtype, axis=axis)
+
+
+@register('compress', differentiable=False,
+          dynamic_shape=lambda a, kw: kw.get(
+              'size', a[3] if len(a) > 3 else None) is None)
+def compress(condition, a, axis=None, size=None, fill_value=0):
+    """Static-size form: `size` pads/truncates (jnp requirement under
+    jit); without it the op only works eagerly with concrete masks."""
+    return jnp.compress(condition.astype(bool), a, axis=axis, size=size,
+                        fill_value=fill_value)
