@@ -508,6 +508,61 @@ def bench_yolo(args, mx):
     }
 
 
+def bench_resnet_int8(args, mx):
+    """INT8 post-training-quantized ResNet-50 inference (reference
+    quantization flow: QuantizeGraph + calibration; here quantize_net's
+    MXU int8 dot path). Device-loop measurement like bench_resnet;
+    vs_baseline anchors to the same V100 fp16 number so the int8 and
+    bf16 rows compare directly."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mxnet_tpu import quantization
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    ctx = mx.current_context()
+    print(f'context: {ctx} (int8 PTQ)', file=sys.stderr)
+    net = vision.resnet50_v1()
+    net.initialize(ctx=ctx)
+    calib = mx.np.ones((8, 3, 224, 224), ctx=ctx) * 0.5
+    net(calib)
+    qnet = quantization.quantize_net(net, calib_data=[calib],
+                                     calib_mode='naive')
+    qnet.hybridize(static_alloc=True)
+
+    x = mx.np.ones((args.batch, 3, 224, 224), ctx=ctx)
+    pure, in_raws, params, aux = qnet.pure_function(x, train=False)
+    key = jax.random.PRNGKey(0)
+
+    def fwd(acc, i):
+        xi = in_raws[0] * (1.0 + 2.0 ** -6 * i.astype(jnp.float32)) \
+            + acc * jnp.float32(1e-12)
+        outs, _ = pure(jax.random.fold_in(key, i), (xi,), params, aux)
+        return outs[0][0, 0].astype(jnp.float32), None
+
+    K = args.iters
+    run_dev = jax.jit(lambda a0: lax.scan(fwd, a0, jnp.arange(K)))
+    acc, _ = run_dev(jnp.float32(0.0))
+    float(acc)                              # force compile+exec
+    times = []
+    for rep in range(2):
+        acc, _ = run_dev(acc)               # settle (first post-compile
+        float(acc)                          # exec pays tunnel overhead)
+        t0 = time.perf_counter()
+        acc, _ = run_dev(acc + rep + 1)
+        float(acc)
+        times.append(time.perf_counter() - t0)
+    ips = args.batch * K / min(times)
+    return {
+        'metric': f'resnet50_int8_inference_batch{args.batch}',
+        'value': round(ips, 2),
+        'unit': 'img/s',
+        'vs_baseline': round(ips / BASELINES['bf16'], 3),
+        'timing_spread': _spread(times),
+    }
+
+
 def bench_suite(args, mx):
     """Default: ResNet-50 TRAIN as the primary metric (BASELINE.json
     north star) + inference / BERT / kvstore in "extras" — one driver-
@@ -574,6 +629,8 @@ def main():
         result = bench_kvstore(args)
     elif args.model in ('llama_decode', 'llama'):
         result = bench_llama_decode(args, mx)
+    elif args.model in ('resnet50_int8', 'int8'):
+        result = bench_resnet_int8(args, mx)
     elif args.model in ('yolo3', 'yolo'):
         result = bench_yolo(args, mx)
     else:
