@@ -116,3 +116,22 @@ def test_elastic_crash_and_resume(tmp_path):
     ref_w = sorted(re.findall(r'wsum (-?\d+\.\d+)', res.stdout))
     got_w = sorted(re.findall(r'wsum (-?\d+\.\d+)', res2.stdout))
     assert ref_w == got_w and len(got_w) == 2, (ref_w, got_w)
+
+
+@pytest.mark.timeout(300)
+def test_four_process_dist_sync_kvstore():
+    """4-process world: fused buckets, compression, and ZeRO-1 key
+    ownership spread across more ranks than keys-per-rank (the n=2
+    tests cannot see owner-balancing effects)."""
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'tools', 'launch.py'),
+         '-n', '4', '--launcher', 'local', '--port', '49914',
+         sys.executable,
+         os.path.join(ROOT, 'tests', 'nightly', 'dist_sync_kvstore.py')],
+        capture_output=True, text=True, timeout=280, env=env, cwd=ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    for r in range(4):
+        assert f'worker {r}/4: all dist kvstore assertions passed' in out
