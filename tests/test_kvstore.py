@@ -296,3 +296,19 @@ def test_fusion_bucketing_units():
     assert owners[0] == 0 and set(owners[1:]) == {1}
     # deterministic
     assert owners == fusion.assign_owners([100, 1, 1, 1], 2)
+
+
+def test_horovod_byteps_alias_surface():
+    """The in-tree horovod/byteps names are documented COMPAT ALIASES of
+    the XLA-collective store: same allreduce semantics, plugin-specific
+    attrs present (reference kvstore/horovod.py surface)."""
+    for name in ('horovod', 'byteps'):
+        kv = kvstore.create(name)
+        assert kv.num_workers == 1 and kv.rank == 0
+        out = mx.np.zeros((3,))
+        kv.init(0, mx.np.zeros((3,)))
+        kv.pushpull(0, mx.np.ones((3,)) * 2, out=out)
+        np.testing.assert_allclose(out.asnumpy(), 2.0)
+    hv = kvstore.create('horovod')
+    assert hv.local_rank == 0
+    assert 'COMPAT ALIAS' in type(hv).__doc__
