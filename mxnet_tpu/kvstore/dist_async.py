@@ -17,11 +17,17 @@ like ``update_on_kvstore`` on the reference PS. Semantics > transport
 speed here (the VERDICT r1 item 4 contract); the synchronous fast path
 remains dist_tpu_sync's fused collectives.
 
-Wire format: pickled (cmd, key, dtype, shape) header + raw bytes.
+Wire format: JSON (cmd, key, dtype, shape) header + raw bytes — JSON,
+not pickle, so a reachable port cannot execute code via a crafted
+header.  The one pickled payload (``set_optimizer``) is gated behind a
+shared-secret token (``MXNET_KVSTORE_SECRET``); without a configured
+secret it is only accepted from loopback peers.  The server binds the
+coordinator interface from ``MX_COORDINATOR`` rather than 0.0.0.0.
 Server address: rank 0's host from ``MX_COORDINATOR`` with port offset
 ``MXNET_KVSTORE_ASYNC_PORT`` (default coordinator port + 29).
 """
 
+import json
 import os
 import pickle
 import socket
@@ -46,7 +52,7 @@ def _recv_exact(sock, n):
 
 
 def _send_msg(sock, header, payload=b''):
-    head = pickle.dumps(header)
+    head = json.dumps(header).encode('utf-8')
     sock.sendall(struct.pack('!II', len(head), len(payload)))
     sock.sendall(head)
     if payload:
@@ -55,7 +61,7 @@ def _send_msg(sock, header, payload=b''):
 
 def _recv_msg(sock):
     hlen, plen = struct.unpack('!II', _recv_exact(sock, 8))
-    header = pickle.loads(_recv_exact(sock, hlen))
+    header = json.loads(_recv_exact(sock, hlen).decode('utf-8'))
     payload = _recv_exact(sock, plen) if plen else b''
     return header, payload
 
@@ -65,11 +71,20 @@ class _AsyncServer(threading.Thread):
     Every request handler applies immediately under the store lock —
     the async branch of DataHandleDefault."""
 
-    def __init__(self, port):
+    def __init__(self, port, bind_host='127.0.0.1'):
         super().__init__(daemon=True)
         self._store = {}
         self._updater = None
         self._lock = threading.Lock()
+        self._secret = os.environ.get('MXNET_KVSTORE_SECRET', '')
+        # addresses that count as "same host" for the no-secret
+        # set_optimizer gate: loopback plus the bind interface itself
+        # (rank 0 dialing hostA:port arrives with hostA's own source IP)
+        self._local_peers = {'127.0.0.1', '::1'}
+        try:
+            self._local_peers.add(socket.gethostbyname(bind_host))
+        except OSError:
+            pass
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -80,16 +95,24 @@ class _AsyncServer(threading.Thread):
                 while True:
                     try:
                         header, payload = _recv_msg(self.request)
-                    except (ConnectionError, OSError):
+                    except (ConnectionError, OSError, ValueError):
                         return
-                    reply, rpayload = outer._dispatch(header, payload)
+                    reply, rpayload = outer._dispatch(
+                        header, payload, self.client_address[0])
                     _send_msg(self.request, reply, rpayload)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Server(('0.0.0.0', port), Handler)
+        # bind the coordinator interface (not 0.0.0.0): workers reach us
+        # at this address anyway, and nothing else should
+        try:
+            self._server = Server((bind_host, port), Handler)
+        except OSError:
+            # coordinator hostname may not be a local interface name
+            # (NAT/containers): fall back to all interfaces like ps-lite
+            self._server = Server(('0.0.0.0', port), Handler)
 
     def run(self):
         self._server.serve_forever(poll_interval=0.05)
@@ -98,7 +121,7 @@ class _AsyncServer(threading.Thread):
         self._server.shutdown()
 
     # ----------------------------------------------------------- handlers
-    def _dispatch(self, header, payload):
+    def _dispatch(self, header, payload, peer='127.0.0.1'):
         cmd = header['cmd']
         if cmd == 'init':
             arr = _onp.frombuffer(payload, header['dtype']).reshape(
@@ -130,6 +153,24 @@ class _AsyncServer(threading.Thread):
             return {'ok': True, 'dtype': str(data.dtype),
                     'shape': data.shape}, data.tobytes()
         if cmd == 'set_optimizer':
+            # the only pickled payload on the wire: gate it.  With a
+            # configured shared secret, require the token; without one,
+            # only trust loopback peers (same-host job).
+            import hmac
+            if self._secret:
+                if not hmac.compare_digest(header.get('token', ''),
+                                           self._secret):
+                    return {'ok': False,
+                            'error': 'set_optimizer rejected: bad or '
+                                     'missing MXNET_KVSTORE_SECRET '
+                                     'token'}, b''
+            elif not peer.startswith('127.') \
+                    and peer not in self._local_peers:
+                return {'ok': False,
+                        'error': 'set_optimizer rejected from non-'
+                                 'local peer: set '
+                                 'MXNET_KVSTORE_SECRET on all ranks '
+                                 'to enable remote optimizer setup'}, b''
             from ..optimizer import get_updater
             opt = pickle.loads(payload)
             with self._lock:
@@ -145,8 +186,17 @@ class _AsyncServer(threading.Thread):
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                 else:
-                    self._barrier_cv.wait_for(
+                    released = self._barrier_cv.wait_for(
                         lambda: self._barrier_gen != gen, timeout=120)
+                    if not released:
+                        # undo our arrival so later barriers don't
+                        # release one worker early, and surface the
+                        # failure to the caller instead of silently
+                        # proceeding unsynchronized
+                        self._barrier_count -= 1
+                        return {'ok': False,
+                                'error': 'barrier timeout after 120s: '
+                                         'not all workers arrived'}, b''
             return {'ok': True}, b''
         return {'ok': False, 'error': f'unknown cmd {cmd!r}'}, b''
 
@@ -183,11 +233,16 @@ class KVStoreDistAsync(KVStoreBase):
             # is likewise shared across kvstore handles)
             self._server = _SERVERS.get(self._port)
             if self._server is None:
-                self._server = _AsyncServer(self._port)
+                bind = '127.0.0.1' if host in ('127.0.0.1',
+                                               'localhost') else host
+                self._server = _AsyncServer(self._port, bind_host=bind)
                 self._server.start()
                 _SERVERS[self._port] = self._server
-        # connect (rank 0 serves itself over loopback too — one code path)
-        target = '127.0.0.1' if self._rank == 0 else host
+        # every rank (rank 0 included) connects to the advertised
+        # coordinator host: the server may be bound to that interface
+        # only, so rank 0 dialing loopback would be refused
+        target = '127.0.0.1' if host in ('127.0.0.1', 'localhost') \
+            else host
         last = None
         for _ in range(100):
             try:
@@ -272,8 +327,19 @@ class KVStoreDistAsync(KVStoreBase):
 
     def set_optimizer(self, optimizer):
         """Pickle the optimizer to the server (reference
-        _send_command_to_servers + kSetMultiPrecision path)."""
-        self._rpc({'cmd': 'set_optimizer'}, pickle.dumps(optimizer))
+        _send_command_to_servers + kSetMultiPrecision path).  Only rank
+        0 actually sends it — the reference likewise issues the server
+        command from rank 0 alone, and the Trainer calls this on every
+        rank.  Ordering is safe: workers cannot push before the
+        broadcast barrier in ``_init_params``, which rank 0 only
+        reaches after this RPC completes.  The request carries the
+        shared-secret token so the server will unpickle it (see module
+        docstring)."""
+        if self._rank != 0:
+            return
+        self._rpc({'cmd': 'set_optimizer',
+                   'token': os.environ.get('MXNET_KVSTORE_SECRET', '')},
+                  pickle.dumps(optimizer))
 
     def set_updater(self, updater):
         raise NotImplementedError(

@@ -135,9 +135,32 @@ class KVStoreTPUSync(KVStoreLocal):
                 and self._zero1_update(keys, merged, vals_lists, outs,
                                        order)):
             return
+        if self._updater is not None and self._nproc > 1:
+            # a key whose optimizer state was created under ZeRO-1 has
+            # that state sharded on its owner rank only; silently
+            # continuing with replicated updates (e.g. after toggling
+            # MXNET_KVSTORE_ZERO1 or enabling compression mid-run)
+            # would diverge from it
+            self._guard_update_mode(keys, 'replicated')
         if self._nproc > 1 or gc.active:
             merged = self._bucketed_allreduce(keys, merged, order, gc)
         self._apply_merged(keys, merged, vals_lists, outs)
+
+    def _guard_update_mode(self, keys, mode):
+        """Pin each key's updater-state layout ('zero1' sharded vs
+        'replicated') on first update; raise on a mid-run switch."""
+        if not hasattr(self, '_update_mode'):
+            self._update_mode = {}
+        for k in keys:
+            prev = self._update_mode.setdefault(k, mode)
+            if prev != mode:
+                raise RuntimeError(
+                    f'kvstore key {k!r}: optimizer state was created '
+                    f'under {prev!r} updates but this pushpull selected '
+                    f'{mode!r} (MXNET_KVSTORE_ZERO1 toggled or gradient '
+                    'compression enabled mid-run?). Switching layouts '
+                    'mid-run silently abandons sharded state; restart '
+                    'training with a consistent configuration.')
 
     def _bucketed_allreduce(self, keys, merged, order, gc):
         import numpy as _onp
@@ -206,6 +229,7 @@ class KVStoreTPUSync(KVStoreLocal):
         dt = merged[0].dtype
         if any(m.dtype != dt for m in merged):
             return False
+        self._guard_update_mode(keys, 'zero1')
         for k in keys:
             if k not in self._store:
                 raise ValueError(

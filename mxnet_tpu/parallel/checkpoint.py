@@ -70,25 +70,30 @@ def _globalize(tree):
     mesh = Mesh(_onp.array([per_proc[p] for p in sorted(per_proc)]),
                 ('rep',))
 
-    locals_ = [x for x in jax.tree.leaves(tree)
-               if isinstance(x, jax.Array) and x.is_fully_addressable]
-    if locals_:
-        # loud failure instead of silent nondeterminism: a host-local
-        # leaf that differs across ranks (rank-local RNG key, counter)
-        # cannot be saved as "replicated" — cheap scalar fingerprints
-        # ride one collective
-        fps = jnp.stack([x.astype(jnp.float32).sum() for x in locals_])
-        multihost_utils.assert_equal(
-            fps, 'checkpoint leaves must be identical across ranks; '
-                 'rank-local state cannot be saved as replicated')
+    # loud failure instead of silent nondeterminism: a host-local leaf
+    # that differs across ranks (rank-local RNG key, counter) cannot be
+    # saved as "replicated".  Fingerprint = CRC32 of the exact bytes —
+    # a float sum would pass rank-divergent state with equal sums (e.g.
+    # permuted embedding rows).  One host copy per leaf, dropped as the
+    # global array is built, so peak host memory stays one-leaf-deep.
+    import zlib
+    crcs = []
 
     def conv(x):
         if isinstance(x, jax.Array) and x.is_fully_addressable:
+            h = _onp.asarray(x)
+            crcs.append(zlib.crc32(h.tobytes()))
             return multihost_utils.host_local_array_to_global_array(
-                _onp.asarray(x), mesh, P())
+                h, mesh, P())
         return x
 
-    return jax.tree.map(conv, tree)
+    out = jax.tree.map(conv, tree)
+    if crcs:
+        multihost_utils.assert_equal(
+            _onp.array(crcs, dtype=_onp.uint32),
+            'checkpoint leaves must be identical across ranks; '
+            'rank-local state cannot be saved as replicated')
+    return out
 
 
 def _localize(tree):

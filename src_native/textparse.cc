@@ -74,7 +74,9 @@ void ParseLibsvmChunk(Chunk* c, int64_t width, int64_t label_width) {
       while (q < line_end && nlab < label_width) {
         char* after = nullptr;
         float v = strtof(q, &after);
-        if (after == q) break;
+        // bound to this line: strtof skips '\n' and would otherwise
+        // parse the NEXT line's label on a whitespace-only line
+        if (after == q || after > line_end) break;
         c->labels.push_back(v);
         ++nlab;
         q = after;
@@ -97,8 +99,18 @@ void ParseLibsvmChunk(Chunk* c, int64_t width, int64_t label_width) {
           return;
         }
         q = after + 1;
+        // bound the value parse to this line: a trailing "idx:" would
+        // otherwise let strtof skip the '\n' and consume the next
+        // line's label as the value
+        if (q >= line_end) {
+          c->error = "malformed libsvm value";
+          return;
+        }
         float v = strtof(q, &after);
-        if (after == q) { c->error = "malformed libsvm value"; return; }
+        if (after == q || after > line_end) {
+          c->error = "malformed libsvm value";
+          return;
+        }
         q = after;
         if (idx < 0 || idx >= width) {
           c->error = "libsvm feature index out of range for width";
