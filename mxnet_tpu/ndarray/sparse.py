@@ -12,8 +12,10 @@ or segment-sum that XLA maps well onto TPU; there are no dynamic nnz
 shapes inside jit (nnz is fixed per array instance, like the reference
 where aux shapes are part of the NDArray). Generic ops fall back to dense
 via ``tostype('default')`` exactly like the reference's storage-fallback
-path (src/common/exec_utils.h), while the dedicated ops below
-(``dot``, ``elemwise_add``, ``retain``, ``where``) use the structure.
+path (src/common/exec_utils.h); the dedicated paths — CSR/RSP ``dot``
+(incl. matvec + transpose), ``elemwise_add`` (csr+csr, rsp+rsp),
+``retain``, ``cast_storage``, CSR row slicing and scalar math — are
+O(nnz) and never materialize the dense equivalent.
 """
 
 import numpy as _np
@@ -134,7 +136,12 @@ class RowSparseNDArray(BaseSparseNDArray):
 
 class CSRNDArray(BaseSparseNDArray):
     """Compressed sparse row storage (reference sparse.py CSRNDArray;
-    kCSRStorage, ndarray.h:64)."""
+    kCSRStorage, ndarray.h:64).
+
+    Values live on device; ``dot``/``add``/scalar math/row slicing are
+    O(nnz) gather/scatter/segment-sum programs (the FComputeEx sparse
+    kernels of ``src/operator/tensor/dot.cc`` re-expressed for XLA) —
+    the dense equivalent is never materialized on those paths."""
 
     def __init__(self, data, indptr, indices, shape, ctx=None):
         data = data if isinstance(data, NDArray) else array(data)
@@ -149,15 +156,17 @@ class CSRNDArray(BaseSparseNDArray):
     def stype(self):
         return 'csr'
 
-    def _to_dense_raw(self):
-        n_rows, n_cols = self._shape
-        indptr = self.indptr._data
+    def _row_ids(self):
+        """Row id per nnz element — searchsorted over indptr, O(nnz log R)
+        on device (the role of the reference's CSR row pointer walks)."""
         nnz = self.data.shape[0]
-        # row id per nnz element via searchsorted over indptr
-        pos = jnp.arange(nnz)
-        rows = jnp.searchsorted(indptr, pos, side='right') - 1
+        return (jnp.searchsorted(self.indptr._data, jnp.arange(nnz),
+                                 side='right') - 1).astype(jnp.int32)
+
+    def _to_dense_raw(self):
         dense = jnp.zeros(self._shape, dtype=self._dtype)
-        return dense.at[rows, self.indices._data].set(self.data._data)
+        return dense.at[self._row_ids(), self.indices._data].set(
+            self.data._data)
 
     def copy(self):
         return CSRNDArray(self.data.copy(), self.indptr.copy(),
@@ -167,6 +176,49 @@ class CSRNDArray(BaseSparseNDArray):
         self.data = fresh.data
         self.indptr = fresh.indptr
         self.indices = fresh.indices
+
+    def __getitem__(self, key):
+        """Row slicing stays CSR with O(selected nnz) work (reference
+        sparse.py CSRNDArray.__getitem__ / slice op on kCSRStorage)."""
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if not isinstance(key, slice) or key.step not in (None, 1):
+            return NDArray(self._to_dense_raw())[key]
+        start, stop, _ = key.indices(self._shape[0])
+        indptr_host = _np.asarray(self.indptr.asnumpy())
+        lo, hi = int(indptr_host[start]), int(indptr_host[stop])
+        return CSRNDArray(
+            NDArray(self.data._data[lo:hi]),
+            array(indptr_host[start:stop + 1] - lo),
+            NDArray(self.indices._data[lo:hi]),
+            (stop - start, self._shape[1]), self._ctx)
+
+    # scalar math preserves sparsity (reference elemwise_mul(csr, scalar)
+    # keeps kCSRStorage; + 0-preserving ops only)
+    def _scalar_same_structure(self, fn):
+        return CSRNDArray(NDArray(fn(self.data._data)), self.indptr,
+                          self.indices, self._shape, self._ctx)
+
+    def __mul__(self, other):
+        if _np.isscalar(other):
+            return self._scalar_same_structure(lambda d: d * other)
+        if isinstance(other, NDArray) and not isinstance(
+                other, BaseSparseNDArray) and other.shape == self._shape:
+            # csr * dense → csr: gather the dense values at nnz coords
+            rows = self._row_ids()
+            vals = other._data[rows, self.indices._data]
+            return self._scalar_same_structure(lambda d: d * vals)
+        return NotImplemented
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if _np.isscalar(other):
+            return self._scalar_same_structure(lambda d: d / other)
+        return NotImplemented
+
+    def __neg__(self):
+        return self._scalar_same_structure(lambda d: -d)
 
 
 # ------------------------------------------------------------ constructors
@@ -226,18 +278,16 @@ def cast_storage(arr, stype):
     if stype == 'csr':
         if dense.ndim != 2:
             raise ValueError('csr storage requires 2-D')
-        indptr = [0]
-        indices = []
-        data = []
-        for r in range(dense.shape[0]):
-            nz = _np.nonzero(dense[r])[0]
-            indices.extend(nz.tolist())
-            data.extend(dense[r, nz].tolist())
-            indptr.append(len(indices))
+        # vectorized compression (no Python row loop): nonzero scan +
+        # per-row bincount → indptr (reference cast_storage_dns_csr_impl)
+        rows, cols = _np.nonzero(dense)
+        counts = _np.bincount(rows, minlength=dense.shape[0])
+        indptr = _np.zeros(dense.shape[0] + 1, dtype='int64')
+        _np.cumsum(counts, out=indptr[1:])
         return CSRNDArray(
-            array(_np.asarray(data, dtype=dense.dtype)),
-            array(_np.asarray(indptr, dtype='int64')),
-            array(_np.asarray(indices, dtype='int64')),
+            array(dense[rows, cols]),
+            array(indptr),
+            array(cols.astype('int64')),
             dense.shape, arr._ctx)
     raise ValueError(f'unknown storage type {stype}')
 
@@ -254,17 +304,18 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
             rhs, BaseSparseNDArray):
         data = lhs.data._data
         indices = lhs.indices._data.astype(jnp.int32)
-        indptr = lhs.indptr._data
-        nnz = data.shape[0]
-        rows = (jnp.searchsorted(indptr, jnp.arange(nnz), side='right')
-                - 1).astype(jnp.int32)
+        rows = lhs._row_ids()
         rd = rhs._data
         if transpose_b:
             rd = rd.T
-        gathered = rd[indices] * data[:, None]        # (nnz, N)
+        vec = rd.ndim == 1          # matvec: (R,C)·(C,) → (R,)
+        scale = data if vec else data[:, None]
+        gathered = rd[indices] * scale            # (nnz,) or (nnz, N)
         if transpose_a:
-            out = jnp.zeros((lhs.shape[1], rd.shape[1]), dtype=rd.dtype)
-            out = out.at[indices].add(rd[rows] * data[:, None])
+            out_shape = (lhs.shape[1],) if vec else (lhs.shape[1],
+                                                     rd.shape[1])
+            out = jnp.zeros(out_shape, dtype=rd.dtype)
+            out = out.at[indices].add(rd[rows] * scale)
             return NDArray(out)
         out = jax.ops.segment_sum(gathered, rows,
                                   num_segments=lhs.shape[0])
@@ -292,7 +343,32 @@ def retain(rsp, indices):
 
 
 def add(lhs, rhs):
-    """elemwise_add with sparse-aware fast path (rsp + rsp → rsp)."""
+    """elemwise_add with sparse-aware fast paths (rsp+rsp → rsp,
+    csr+csr → csr; reference elemwise_binary_op_basic.cc FComputeEx)."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray) \
+            and lhs.shape == rhs.shape:
+        # structure merged on host (nnz_out is data-dependent — the
+        # reference likewise sizes the output aux arrays on CPU);
+        # values summed on device: O(nnz), never dense
+        li = _np.asarray(lhs.indices.asnumpy(), dtype='int64')
+        ri = _np.asarray(rhs.indices.asnumpy(), dtype='int64')
+        lp = _np.asarray(lhs.indptr.asnumpy(), dtype='int64')
+        rp = _np.asarray(rhs.indptr.asnumpy(), dtype='int64')
+        lrow = _np.repeat(_np.arange(lhs.shape[0]), _np.diff(lp))
+        rrow = _np.repeat(_np.arange(rhs.shape[0]), _np.diff(rp))
+        keys = _np.concatenate([lrow * lhs.shape[1] + li,
+                                rrow * rhs.shape[1] + ri])
+        uniq, inv = _np.unique(keys, return_inverse=True)
+        out = jnp.zeros((len(uniq),), dtype=lhs.dtype)
+        out = out.at[jnp.asarray(inv[:len(li)])].add(lhs.data._data)
+        out = out.at[jnp.asarray(inv[len(li):])].add(rhs.data._data)
+        orow = (uniq // lhs.shape[1]).astype('int64')
+        ocol = (uniq % lhs.shape[1]).astype('int64')
+        counts = _np.bincount(orow, minlength=lhs.shape[0])
+        indptr = _np.zeros(lhs.shape[0] + 1, dtype='int64')
+        _np.cumsum(counts, out=indptr[1:])
+        return CSRNDArray(NDArray(out), array(indptr), array(ocol),
+                          lhs.shape, lhs._ctx)
     if isinstance(lhs, RowSparseNDArray) and isinstance(
             rhs, RowSparseNDArray) and lhs.shape == rhs.shape:
         li = _np.asarray(lhs.indices.asnumpy(), dtype='int64')
