@@ -79,6 +79,20 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = _tuplize(dilate, ndim) or (1,) * ndim
     pad = _tuplize(pad, ndim) or (0,) * ndim
     dn, layout = _conv_dn(ndim, layout)
+    if (weight.shape[2:] == (1,) * ndim and any(s > 1 for s in stride)
+            and all(p == 0 for p in pad) and layout.startswith('NC')):
+        # A strided 1x1 conv only ever reads the stride-grid positions,
+        # so slice first and convolve stride-1.  Forward is identical;
+        # the payoff is the VJP: XLA expands the data-gradient of a
+        # strided conv into an lhs-dilated conv at FULL resolution
+        # (4x the needed FLOPs for stride 2 — 26.3G vs 6.6G per
+        # ResNet-50 downsample, ~7% of the whole train step), while the
+        # slice's gradient is a cheap scatter and the stride-1 conv's
+        # gradient stays at the low resolution.
+        idx = (slice(None), slice(None)) + tuple(
+            slice(None, None, s) for s in stride)
+        data = data[idx]
+        stride = (1,) * ndim
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
