@@ -299,7 +299,16 @@ def bench_resnet_train(args, mx):
           f'MFU {mfu:.1%} of v5e {V5E_BF16_FLOPS / 1e12:.0f} TFLOP/s',
           file=sys.stderr)
 
-    # imperative Trainer path on the same workload, fed by NDArrayIter
+    # imperative Trainer path on the same workload, fed by NDArrayIter.
+    # A fresh NON-hybridized net: this metric measures the eager
+    # imperative engine (bulked dispatch, _bulk.py) — `net` above was
+    # hybridized for the device-loop primary and would measure
+    # _CachedGraph instead.
+    net = vision.resnet50_v1()
+    net.initialize(ctx=ctx)
+    net(mx.np.ones((1, 3, 224, 224), ctx=ctx))
+    if dtype != 'float32':
+        net.cast(dtype)
     trainer = gluon.Trainer(net.collect_params(), 'sgd',
                             {'learning_rate': lr, 'momentum': momentum})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
@@ -309,20 +318,39 @@ def bench_resnet_train(args, mx):
     lab = rng.integers(0, 1000, B * 2).astype(onp.float32)
     epsnd = mx.np.full((1,), 2.0 ** -6, dtype=dtype, ctx=ctx)
 
-    def imperative(n, base):
-        it = mxio.NDArrayIter(images, lab, batch_size=B, shuffle=False)
+    # Device-resident batches: the imperative metric measures per-step
+    # dispatch (the engine), matching the device-loop primary metric's
+    # input regime. Host-fed feeding is timed separately below — on the
+    # axon tunnel host->device runs at ~35-80 MB/s (docs/benchmarking.md),
+    # which alone caps a 19 MB batch at ~60 img/s regardless of engine.
+    it = mxio.NDArrayIter(images, lab, batch_size=B, shuffle=False)
+    dev_batches = [(b.data[0].astype(dtype).as_in_context(ctx),
+                    b.label[0].as_in_context(ctx)) for b in it]
+
+    def imperative(n, base, host_feed=False):
         got = 0
         loss = None
         while got < n:
-            try:
-                b = next(it)
-            except StopIteration:
-                it.reset()
-                continue
+            if host_feed:
+                it.reset() if got % len(dev_batches) == 0 else None
+                try:
+                    b = next(it)
+                except StopIteration:
+                    it.reset()
+                    continue
+                x = b.data[0].astype(dtype).as_in_context(ctx)
+                y = b.label[0].as_in_context(ctx)
+            else:
+                x, y = dev_batches[got % len(dev_batches)]
+            # per-iteration value scale rides a device array, not a
+            # baked Python scalar: a varying scalar constant would key
+            # a fresh bulk-segment plan every step (compile storm
+            # guard would then drop to eager) — _bulk.py docstring
+            scale = mx.np.full((1,), float(base + got), dtype=dtype,
+                               ctx=ctx)
             with autograd.record():
-                out = net(b.data[0].astype(dtype)
-                          + epsnd * float(base + got)).astype('float32')
-                loss = loss_fn(out, b.label[0]).mean()
+                out = net(x + epsnd * scale).astype('float32')
+                loss = loss_fn(out, y).mean()
             loss.backward()
             trainer.step(B)
             got += 1
@@ -333,6 +361,11 @@ def bench_resnet_train(args, mx):
     t0 = time.perf_counter()
     imperative(imp_iters, 100)
     imp_ips = B * imp_iters / (time.perf_counter() - t0)
+    imperative(1, 200, host_feed=True)
+    t0 = time.perf_counter()
+    hf_iters = max(imp_iters // 2, 3)
+    imperative(hf_iters, 300, host_feed=True)
+    imp_hf_ips = B * hf_iters / (time.perf_counter() - t0)
 
     return {
         'metric': f'resnet50_train_{args.dtype}_batch{B}',
@@ -342,6 +375,7 @@ def bench_resnet_train(args, mx):
         'mfu': round(mfu, 3),
         'timing_spread': _spread(times),
         'imperative_img_s': round(imp_ips, 2),
+        'imperative_hostfeed_img_s': round(imp_hf_ips, 2),
     }
 
 

@@ -1,0 +1,482 @@
+"""Bulked (lazy) eager execution — the imperative engine's fast path.
+
+TPU-native re-design of the reference engine's operation bulking
+(include/mxnet/engine.h:310 ``StartBulk``/``StopBulk``,
+src/imperative/imperative_utils.h:636 ``RunGraph`` bulk segments): the
+reference fuses up to ``MXNET_ENGINE_BULK_SIZE`` consecutive engine pushes
+into one scheduled unit to amortize per-op dispatch. Here the per-op cost
+being amortized is an XLA executable launch (and, on the axon dev tunnel, a
+2-5 ms RPC), so bulking goes further: consecutive imperative ops are
+*recorded* into a segment and compiled into ONE cached XLA program, flushed
+at sync points.
+
+How it works
+------------
+* ``registry.apply_op`` offers each invoke()-dispatched op to
+  :func:`try_record`. If bulking is active, the op is appended to the
+  thread-local :class:`_Segment` and the caller receives **lazy** NDArrays
+  (``NDArray._lazy`` holds a :class:`LazyRef` with the abstract value;
+  ``NDArray._data`` materializes on touch).
+* The segment keeps a **trie** keyed by (op name, static-argument key,
+  grad-activity, input wiring): a training loop's second iteration walks the
+  same trie path and reuses the recorded output avals — no re-abstract-eval,
+  no retracing, no per-op device dispatch.
+* A **flush** (sync point: ``_data`` touch, ``backward()``, segment-size
+  cap, explicit ``engine.bulk`` exit) compiles — once per (trie node, live
+  output set) — a jitted replay of the whole segment and executes it as one
+  device program. Subsequent identical segments are a dict hit + one call.
+* Autograd: per-op tape nodes are *not* created inside a segment. Instead
+  the flush populates ONE :class:`_tape.TapeNode` covering the segment,
+  whose vjp re-linearizes the jitted replay (rematerialized backward — the
+  standard TPU trade of FLOPs for memory/launches). Ops that would not have
+  been recorded eagerly (recording off, non-differentiable, no tracked
+  input) get ``lax.stop_gradient`` in the replay, reproducing the eager
+  tape's gradient-blocking exactly.
+
+Reference: engine.h:310-317 (bulk API), imperative_utils.h:636 (bulked
+graph execution), docs faq env_var MXNET_ENGINE_BULK_SIZE.
+
+Correctness guards:
+* ops with unhashable static arguments (device arrays baked as constants,
+  numpy buffers) fall back to eager dispatch (registry builds no bulk key);
+* a trie position whose children keep multiplying (a Python-scalar constant
+  that changes every iteration, e.g. a hand-rolled schedule) is marked
+  unstable and ops at it run eagerly — one compile cannot be reused, so
+  caching would turn into a compile-per-step storm;
+* dynamic-output-shape ops raise under abstract evaluation and fall back;
+* deferred-compute capture, per-op profiling, ``naive_engine`` and jit
+  tracing all bypass bulking (checked by the registry / via tracer inputs).
+"""
+
+import os
+import threading
+import weakref
+
+import jax
+from jax import lax
+
+from . import _tape
+
+_MAX_SIBLINGS = 16     # distinct static-arg keys per (position, op) before
+                       # the position is treated as unstable
+_RETRY = 13            # re-admit every Nth attempt while unstable, so a
+                       # later loop with STABLE constants can recover
+_MAX_TOTAL = 64        # hard cap on keys per (position, op): bounds the
+                       # worst-case compile count from a varying constant
+
+
+class LazyRef:
+    """A pending value: output ``key`` of a segment, materialized at flush."""
+
+    __slots__ = ('seg', 'key', 'aval', 'value', '__weakref__')
+
+    def __init__(self, seg, key, aval):
+        self.seg = seg
+        self.key = key          # (entry_idx, out_idx)
+        self.aval = aval        # jax.ShapeDtypeStruct
+        self.value = None
+
+
+class _Entry:
+    __slots__ = ('fn', 'in_refs', 'n_out', 'multi', 'stopgrad', 'out_refs')
+
+    def __init__(self, fn, in_refs, n_out, multi, stopgrad):
+        self.fn = fn
+        self.in_refs = in_refs      # tuple of (0, boundary_idx) | (1, ei, oi)
+        self.n_out = n_out
+        self.multi = multi
+        self.stopgrad = stopgrad
+        self.out_refs = []          # weakrefs to LazyRefs
+
+
+class _TrieNode:
+    __slots__ = ('children', 'out_avals', 'multi', 'plans', 'op_counts',
+                 'attempts')
+
+    def __init__(self):
+        self.children = {}
+        self.out_avals = None       # this entry's output avals
+        self.multi = False
+        self.plans = {}             # out_keys -> _Plan (flush-here plans)
+        self.op_counts = {}         # op name -> distinct keys seen here
+        self.attempts = {}          # op name -> turned-away attempts
+
+
+class _Plan:
+    __slots__ = ('jfwd', 'fwd_raw', 'replay', 'out_keys', 'vjp_cache')
+
+    def __init__(self, jfwd, fwd_raw, replay, out_keys):
+        self.jfwd = jfwd
+        self.fwd_raw = fwd_raw      # unjitted: boundary -> output tuple
+        self.replay = replay        # unjitted full-env replay, for re-vjp
+        self.out_keys = out_keys
+        self.vjp_cache = {}         # nonzero-cot index tuple -> jitted vjp
+
+
+class _SegVjp:
+    """Segment-level vjp: recompute-based, jitted, cached per cotangent
+    sparsity pattern. ``indexed`` lets the tape skip materializing zero
+    cotangents for the (typically many) outputs that received none."""
+
+    __slots__ = ('plan', 'boundary')
+
+    def __init__(self, plan, boundary):
+        self.plan = plan
+        self.boundary = boundary
+
+    def indexed(self, present):
+        idxs = tuple(sorted(present))
+        jf = self.plan.vjp_cache.get(idxs)
+        if jf is None:
+            replay = self.plan.replay
+            sel = tuple(self.plan.out_keys[i] for i in idxs)
+
+            def vjp_apply(boundary, cts):
+                def f(*b):
+                    env = replay(*b)
+                    return tuple(env[ei][oi] for ei, oi in sel)
+                _, vjp = jax.vjp(f, *boundary)
+                return vjp(cts)
+
+            jf = jax.jit(vjp_apply)
+            self.plan.vjp_cache[idxs] = jf
+        return jf(tuple(self.boundary), tuple(present[i] for i in idxs))
+
+    def __call__(self, cots):
+        # full-cotangent fallback (create_graph and other tape paths that
+        # pre-build dense cotangent lists)
+        if not isinstance(cots, tuple):
+            cots = (cots,)
+        return self.indexed(dict(enumerate(cots)))
+
+
+class _Segment:
+    def __init__(self, state):
+        self.state = state
+        self.lock = threading.RLock()
+        self.boundary = []          # raw jax arrays
+        self.boundary_ids = {}      # id(raw) -> index
+        self.boundary_ags = []      # AGInfo|None per boundary input
+        self.entries = []
+        self.trie_pos = state.trie
+        self.agrefs = []            # ((ei, oi), weakref(AGInfo))
+        self.tape_node = None
+        self.flushed = False
+
+    # ------------------------------------------------------------- recording
+    def add(self, op, arrays, fn, bulk_key, grad_active):
+        """Append one op. Returns list of LazyRefs, or None (caller goes
+        eager; segment left consistent)."""
+        in_refs = []
+        in_avals = []
+        descr = []
+        for nd in arrays:
+            ref = nd._lazy
+            if ref is not None and ref.seg is self and ref.value is None:
+                ei, oi = ref.key
+                in_refs.append((1, ei, oi))
+                in_avals.append(ref.aval)
+                descr.append((1, ei, oi))
+            else:
+                raw = nd._raw if ref is None else ref.value
+                bidx = self.boundary_ids.get(id(raw))
+                if bidx is None:
+                    bidx = len(self.boundary)
+                    self.boundary.append(raw)
+                    self.boundary_ids[id(raw)] = bidx
+                    self.boundary_ags.append(getattr(nd, '_ag', None))
+                in_refs.append((0, bidx, 0))
+                in_avals.append(
+                    jax.ShapeDtypeStruct(raw.shape, raw.dtype))
+                descr.append((0, bidx, str(raw.dtype)) + tuple(raw.shape))
+
+        key = (op.name, bulk_key, grad_active, tuple(descr))
+        node = self.trie_pos
+        child = node.children.get(key)
+        if child is None:
+            cnt = node.op_counts.get(op.name, 0)
+            if cnt >= _MAX_SIBLINGS:
+                # this op at this position keeps arriving with fresh
+                # static arguments (e.g. a Python-scalar schedule):
+                # caching would compile per iteration, so go eager —
+                # but re-admit every _RETRY-th attempt (a later loop
+                # with stable constants then recovers the fast path)
+                # up to a hard key cap that bounds total compiles.
+                a = node.attempts.get(op.name, 0) + 1
+                node.attempts[op.name] = a
+                if cnt >= _MAX_TOTAL or a % _RETRY:
+                    return None
+            node.op_counts[op.name] = cnt + 1
+            try:
+                out = jax.eval_shape(fn, *in_avals)
+            except Exception:
+                return None         # dynamic shape / trace-hostile op
+            child = _TrieNode()
+            child.multi = isinstance(out, (tuple, list))
+            outs = list(out) if child.multi else [out]
+            child.out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype)
+                               for o in outs]
+            node.children[key] = child
+            self.state.misses += 1
+        else:
+            self.state.hits += 1
+
+        ei = len(self.entries)
+        entry = _Entry(fn, tuple(in_refs), len(child.out_avals),
+                       child.multi, not grad_active)
+        self.entries.append(entry)
+        self.trie_pos = child
+
+        refs = []
+        for oi, aval in enumerate(child.out_avals):
+            ref = LazyRef(self, (ei, oi), aval)
+            entry.out_refs.append(weakref.ref(ref))
+            refs.append(ref)
+        return refs, child.multi
+
+    def note_ag(self, key, ag):
+        """Register a provisional AGInfo for a segment output; the flush
+        patches its index. Returns the (shared) segment TapeNode."""
+        if self.tape_node is None:
+            self.tape_node = _tape.TapeNode(None, [], [], 0,
+                                            'bulk_segment', multi=True)
+        self.agrefs.append((key, weakref.ref(ag)))
+        return self.tape_node
+
+    # --------------------------------------------------------------- flushing
+    def flush(self):
+        with self.lock:
+            if self.flushed:
+                return
+            self.flushed = True
+            if not self.entries:
+                return
+            self.state.flushes += 1
+
+            live_keys = []
+            live_refs = []
+            for ei, e in enumerate(self.entries):
+                for oi, w in enumerate(e.out_refs):
+                    ref = w()
+                    if ref is not None:
+                        live_keys.append((ei, oi))
+                        live_refs.append(ref)
+            out_keys = tuple(live_keys)
+
+            plan = self.trie_pos.plans.get(out_keys)
+            if plan is None:
+                replay = _build_replay(self.entries)
+
+                def fwd(*boundary):
+                    env = replay(*boundary)
+                    return tuple(env[ei][oi] for ei, oi in out_keys)
+
+                plan = _Plan(jax.jit(fwd), fwd, replay, out_keys)
+                self.trie_pos.plans[out_keys] = plan
+                self.state.compiles += 1
+
+            outs = plan.jfwd(*self.boundary)
+
+            for i, ref in enumerate(live_refs):
+                ref.value = outs[i]
+                ref.seg = None
+
+            if self.tape_node is not None:
+                pos = {k: i for i, k in enumerate(out_keys)}
+                node = self.tape_node
+                node.fn = plan.fwd_raw
+                node.in_vals = list(self.boundary)
+                node.parents = list(self.boundary_ags)
+                node.n_out = len(out_keys)
+                node.out_avals = [r.aval for r in live_refs]
+                node.vjp_fn = _SegVjp(plan, tuple(self.boundary))
+                for key, agw in self.agrefs:
+                    ag = agw()
+                    if ag is not None and key in pos:
+                        ag.index = pos[key]
+            # release recording state (tape node keeps what it needs)
+            self.entries = []
+            self.agrefs = []
+
+
+def _build_replay(entries):
+    entries = tuple(entries)
+
+    def replay(*boundary):
+        env = []
+        for e in entries:
+            ins = []
+            for r in e.in_refs:
+                if r[0] == 0:
+                    ins.append(boundary[r[1]])
+                else:
+                    ins.append(env[r[1]][r[2]])
+            outs = e.fn(*ins)
+            outs = list(outs) if isinstance(outs, (tuple, list)) \
+                else [outs]
+            if e.stopgrad:
+                outs = [lax.stop_gradient(o) for o in outs]
+            env.append(outs)
+        return env
+
+    return replay
+
+
+# ------------------------------------------------------------------- state
+class _State(threading.local):
+    def __init__(self):
+        self.segment = None
+        self.trie = _TrieNode()
+        self.enabled = None         # None = resolve from env/backend
+        self.size = int(os.environ.get('MXNET_ENGINE_BULK_SIZE', 4096))
+        self.force_depth = 0
+        self.disabled_depth = 0
+        self.hits = 0
+        self.misses = 0
+        self.flushes = 0
+        self.compiles = 0
+
+
+_st = _State()
+_env_default = None
+
+
+def _default_enabled():
+    """Default: on for accelerator backends (where per-op launch overhead
+    dominates), off for CPU (tests / debugging keep strict per-op eager)."""
+    global _env_default
+    if _env_default is None:
+        env = os.environ.get('MXNET_ENGINE_BULK', 'auto')
+        if env == '0':
+            _env_default = False
+        elif env == '1':
+            _env_default = True
+        else:
+            try:
+                _env_default = jax.default_backend() != 'cpu'
+            except Exception:
+                _env_default = False
+    return _env_default
+
+
+def active():
+    if _st.disabled_depth:
+        return False
+    if _st.force_depth:
+        return True
+    if _st.enabled is not None:
+        return _st.enabled
+    return _default_enabled()
+
+
+def set_enabled(flag):
+    """Explicit thread-local on/off switch."""
+    flush_current()
+    _st.enabled = flag
+
+
+def set_size(n):
+    _st.size = n
+
+
+def stats():
+    return {'hits': _st.hits, 'misses': _st.misses,
+            'flushes': _st.flushes, 'compiles': _st.compiles}
+
+
+def reset():
+    """Drop the segment trie and all cached plans (flushes first)."""
+    flush_current()
+    _st.trie = _TrieNode()
+
+
+class force:
+    """Context manager: force bulking on (engine.bulk) or off
+    (naive_engine / profiling scopes)."""
+
+    def __init__(self, on, size=None):
+        self.on = on
+        self.size = size
+        self.prev_size = None
+
+    def __enter__(self):
+        if self.on:
+            _st.force_depth += 1
+            if self.size:
+                self.prev_size = _st.size
+                _st.size = self.size
+        else:
+            flush_current()
+            _st.disabled_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        if self.on:
+            _st.force_depth -= 1
+            if self.prev_size is not None:
+                _st.size = self.prev_size
+            flush_current()
+        else:
+            _st.disabled_depth -= 1
+        return False
+
+
+def _current():
+    seg = _st.segment
+    if seg is not None and seg.flushed:
+        _st.segment = None
+        seg = None
+    return seg
+
+
+def flush_current():
+    seg = _current()
+    if seg is not None:
+        seg.flush()
+        _st.segment = None
+
+
+def materialize(ref):
+    if ref.value is None and ref.seg is not None:
+        ref.seg.flush()
+
+
+# ------------------------------------------------------------ dispatch hook
+def try_record(op, arrays, fn, bulk_key, grad_active):
+    """Offer an op to the bulking engine. Returns ``(refs, multi)`` — the
+    output LazyRefs (caller wraps them and registers AGInfos via
+    register_ag, then calls cap_check) and the tuple-return flag — or
+    None (caller dispatches eagerly)."""
+    if not active():
+        return None
+    for nd in arrays:
+        ref = nd._lazy
+        if ref is None:
+            raw = nd._raw
+            if raw is None or isinstance(raw, jax.core.Tracer):
+                return None
+        elif ref.value is None and ref.seg is not None \
+                and ref.seg is not _st.segment:
+            # lazy value from a foreign (e.g. other-thread) segment:
+            # settle it before taking our own lock (avoids lock nesting)
+            ref.seg.flush()
+    seg = _current()
+    if seg is None:
+        seg = _Segment(_st)
+        _st.segment = seg
+    with seg.lock:
+        return seg.add(op, arrays, fn, bulk_key, grad_active)
+
+
+def register_ag(ref, ag):
+    """Attach a provisional AGInfo to a just-recorded output."""
+    return ref.seg.note_ag(ref.key, ag)
+
+
+def cap_check():
+    """Flush if the current segment hit the bulk-size cap. Called by the
+    dispatcher after outputs (and their AGInfos) are fully wired."""
+    seg = _current()
+    if seg is not None and len(seg.entries) >= _st.size:
+        seg.flush()
+        _st.segment = None

@@ -62,7 +62,8 @@ class AGInfo:
     buffer and grad_req) or an *output* of a recorded TapeNode.
     """
 
-    __slots__ = ('node', 'index', 'variable', 'grad', 'grad_req')
+    __slots__ = ('node', 'index', 'variable', 'grad', 'grad_req',
+                 '__weakref__')
 
     def __init__(self, node=None, index=0, variable=False, grad=None,
                  grad_req='write'):
@@ -163,6 +164,21 @@ def mark_variables(variables, gradients, grad_reqs='write'):
         var._ag = AGInfo(variable=True, grad=grad, grad_req=req)
 
 
+_ONES_CACHE = {}
+
+
+def _ones_cached(shape, dtype):
+    """Head cotangent seed; immutable, so cached per (shape, dtype) — a
+    fresh device allocation per backward() is pure dispatch latency."""
+    key = (tuple(shape), str(dtype))
+    got = _ONES_CACHE.get(key)
+    if got is None:
+        if len(_ONES_CACHE) > 256:
+            _ONES_CACHE.clear()
+        got = _ONES_CACHE[key] = jnp.ones(shape, dtype=dtype)
+    return got
+
+
 def _toposort(head_infos):
     """Reverse-topological order of TapeNodes reachable from heads."""
     order, seen, stack = [], set(), []
@@ -203,6 +219,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     reference's create_graph handling in MXGradient.
     """
     from .ndarray.ndarray import NDArray  # local import to avoid cycle
+    from . import _bulk
+    _bulk.flush_current()   # segment tape nodes must be complete
 
     head_infos = []
     for h in heads:
@@ -239,7 +257,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
 
     for h, info, hg in zip(heads, head_infos, head_grads):
         if hg is None:
-            g = jnp.ones(h.shape, dtype=h._data.dtype)
+            g = _ones_cached(h.shape, h._data.dtype)
         else:
             g = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
         _push(info, g)
@@ -250,23 +268,32 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     prev_train = set_training(train_mode)
     try:
         for node in order:
-            out_cots = []
-            any_cot = False
+            present = {}
             for i in range(node.n_out):
                 c = cots.pop((id(node), i), None)
-                if c is None:
-                    aval = node.out_avals[i]
-                    c = jnp.zeros(aval.shape, dtype=aval.dtype)
-                else:
-                    any_cot = True
-                out_cots.append(c)
-            if not any_cot:
+                if c is not None:
+                    present[i] = c
+            if not present:
                 continue
-            if node.vjp_fn is not None:
-                vjp_fn = node.vjp_fn
+            indexed = getattr(node.vjp_fn, 'indexed', None)
+            if indexed is not None:
+                # segment node: zero cotangents are synthesized inside
+                # the jitted vjp (symbolic zeros) instead of N host ops
+                in_cots = indexed({
+                    i: (c.dense() if isinstance(c, RowSparseCot) else c)
+                    for i, c in present.items()})
             else:
-                _, vjp_fn = jax.vjp(node.fn, *node.in_vals)
-            in_cots = vjp_fn(tuple(out_cots) if node.multi else out_cots[0])
+                out_cots = [
+                    present.get(i) if present.get(i) is not None
+                    else jnp.zeros(node.out_avals[i].shape,
+                                   dtype=node.out_avals[i].dtype)
+                    for i in range(node.n_out)]
+                if node.vjp_fn is not None:
+                    vjp_fn = node.vjp_fn
+                else:
+                    _, vjp_fn = jax.vjp(node.fn, *node.in_vals)
+                in_cots = vjp_fn(tuple(out_cots) if node.multi
+                                 else out_cots[0])
             for parent, cot in zip(node.parents, in_cots):
                 _push(parent, cot)
             if not retain_graph:

@@ -2,15 +2,21 @@
 
 Reference: ``python/mxnet/engine.py`` (bulk context manager) over the C++
 ThreadedEngine (src/engine/). The TPU design does not rebuild the dependency
-scheduler — XLA's async stream execution provides it (SURVEY §7 table). What
-remains meaningful:
+scheduler — XLA's async stream execution provides it (SURVEY §7 table) — but
+the reference's *operation bulking* (engine.h:310 StartBulk/StopBulk,
+MXNET_ENGINE_BULK_SIZE) is real here and goes further: consecutive eager ops
+are recorded into a lazy segment and compiled into ONE cached XLA program,
+flushed at sync points (see mxnet_tpu/_bulk.py).
 
-* ``bulk(n)`` — the reference fuses n engine ops into one push
-  (engine.h:310). Here op fusion is XLA's job; the eager analog is jit, so
-  bulk() is an accepted no-op kept for API parity.
+* ``bulk(n)`` — scope in which up to n eager ops fuse into one device
+  program (reference engine.py:15 bulk; engine.h:310).
+* ``set_bulk_size(n)`` — process default; 0/1 disables bulking.
 * ``naive_engine()`` — the reference's `MXNET_ENGINE_TYPE=NaiveEngine`
-  debugging switch (src/engine/engine.cc:32) maps to `jax.disable_jit()`:
-  fully synchronous, op-by-op execution for debugging.
+  debugging switch (src/engine/engine.cc:32) maps to `jax.disable_jit()`
+  plus bulking off: fully synchronous op-by-op execution.
+
+Bulking defaults: on for accelerator backends, off for CPU; override with
+MXNET_ENGINE_BULK=0/1 and MXNET_ENGINE_BULK_SIZE (docs/env_vars.md).
 """
 
 import contextlib
@@ -18,22 +24,41 @@ import os
 
 import jax
 
+from . import _bulk
+
 
 @contextlib.contextmanager
 def bulk(size):
-    """Reference engine.py bulk — fusion is XLA's job here; no-op scope."""
-    yield
+    """Fuse up to ``size`` eager ops into one device program (reference
+    engine.py:15 bulk / engine.h:310 StartBulk). ``size <= 1`` disables
+    bulking for the scope, matching set_bulk_size's contract."""
+    with _bulk.force(size is not None and size > 1, size):
+        yield
 
 
 @contextlib.contextmanager
 def naive_engine():
     """Synchronous op-by-op execution (≙ MXNET_ENGINE_TYPE=NaiveEngine)."""
-    with jax.disable_jit():
-        yield
+    with _bulk.force(False):
+        with jax.disable_jit():
+            yield
 
 
 def set_bulk_size(size):
+    """Set the default bulk-segment size; 0 or 1 disables bulking
+    (reference engine.py:set_bulk_size / MXNET_ENGINE_BULK_SIZE)."""
+    if size and size > 1:
+        _bulk.set_enabled(True)
+        _bulk.set_size(size)
+    else:
+        _bulk.set_enabled(False)
     return size
+
+
+def bulk_stats():
+    """Bulking-engine counters (hits/misses/flushes/compiles) — handy for
+    asserting that a loop reuses its compiled segments."""
+    return _bulk.stats()
 
 
 _ENGINE_TYPE = os.environ.get('MXNET_ENGINE_TYPE', 'ThreadedEnginePerDevice')

@@ -50,16 +50,22 @@ def is_tracing():
     return _trace_state().aux_writes is not None
 
 
-def record_aux_update(param, raw_value):
+def record_aux_update(param, value):
     """Layers call this to update an auxiliary state (e.g. BN running
-    mean). Eagerly: rebind now. Tracing: collected as an extra output of
-    the compiled graph."""
+    mean). Eagerly: rebind now (keeping a pending bulked value lazy).
+    Tracing: collected as an extra output of the compiled graph. Accepts
+    an NDArray or a raw array."""
+    from ..ndarray.ndarray import NDArray as _ND
     st = _trace_state()
     if st.aux_writes is not None:
-        st.aux_writes[id(param)] = (param, raw_value)
+        raw = value._data if isinstance(value, _ND) else value
+        st.aux_writes[id(param)] = (param, raw)
+    elif isinstance(value, _ND):
+        for c in list(param._data):
+            param._data[c]._adopt_lazy(value)
     else:
         for c in list(param._data):
-            param._data[c]._rebind(raw_value)
+            param._data[c]._rebind(value)
 
 
 class ParameterDict(dict):

@@ -182,10 +182,13 @@ class BatchNorm(HybridBlock):
                 eps=self._epsilon, axis=self._axis,
                 fix_gamma=not self._scale)
             m = self._momentum
-            new_mean = m * self.running_mean.data()._data + \
-                (1 - m) * mean.detach()._data
-            new_var = m * self.running_var.data()._data + \
-                (1 - m) * var.detach()._data
+            # NDArray-level math (not raw jnp): under bulked eager the
+            # blend stays inside the segment instead of flushing it at
+            # every BatchNorm layer
+            new_mean = self.running_mean.data() * m + \
+                mean.detach() * (1 - m)
+            new_var = self.running_var.data() * m + \
+                var.detach() * (1 - m)
             record_aux_update(self.running_mean, new_mean)
             record_aux_update(self.running_var, new_var)
             return out

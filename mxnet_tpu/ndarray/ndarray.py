@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from .. import _tape
 from ..context import Context, current_context
 
-__all__ = ['NDArray', 'array', 'concatenate_dtypes', '_wrap_out']
+__all__ = ['NDArray', 'array', 'concatenate_dtypes', '_wrap_out',
+           '_wrap_lazy']
 
 _INT_TYPES = (int, _np.integer)
 
@@ -62,17 +63,51 @@ class NDArray:
     __array_priority__ = 1000.0
 
     def __init__(self, data, ctx=None):
-        self._data = data
+        self._lazy = None
+        self._raw = data
         self._ctx = ctx
         self._ag = None
+
+    @property
+    def _data(self):
+        """The raw payload. Materializes a pending bulked value — reading
+        ``_data`` is a sync point for the bulking engine (_bulk.py), just
+        as reading a reference NDArray waits on its engine var."""
+        ref = self._lazy
+        if ref is not None:
+            if ref.value is None:
+                from .. import _bulk
+                _bulk.materialize(ref)
+            self._raw = ref.value
+            self._lazy = None
+        return self._raw
+
+    @_data.setter
+    def _data(self, raw):
+        self._lazy = None
+        self._raw = raw
+
+    def _adopt_lazy(self, other):
+        """Rebind to another NDArray's (possibly pending) payload without
+        forcing a flush — the lazy analog of ``_rebind(other._data)``."""
+        self._lazy = other._lazy
+        self._raw = other._raw
+        if self._ag is not None and not self._ag.variable:
+            self._ag = None
 
     # ------------------------------------------------------------------ basic
     @property
     def shape(self):
+        ref = self._lazy
+        if ref is not None and ref.value is None:
+            return tuple(ref.aval.shape)
         return tuple(self._data.shape)
 
     @property
     def dtype(self):
+        ref = self._lazy
+        if ref is not None and ref.value is None:
+            return _np.dtype(ref.aval.dtype)
         return _np.dtype(self._data.dtype)
 
     @property
@@ -84,7 +119,7 @@ class NDArray:
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return len(self.shape)
 
     @property
     def context(self):
@@ -260,7 +295,11 @@ class NDArray:
                        retain_graph=retain_graph, train_mode=train_mode)
 
     def detach(self):
-        out = NDArray(self._data, ctx=self._ctx)
+        # share the (possibly pending) payload without forcing a flush:
+        # detaching is a lineage operation, not a sync point
+        out = NDArray(None, ctx=self._ctx)
+        out._lazy = self._lazy
+        out._raw = self._raw
         return out
 
     # --------------------------------------------------------------- indexing
@@ -541,6 +580,18 @@ def _wrap_out(raw, input_arrays):
             ctx = a._ctx
             break
     return NDArray(raw, ctx=ctx)
+
+
+def _wrap_lazy(ref, input_arrays):
+    """Wrap a pending bulk-segment output (same ctx rules as _wrap_out)."""
+    ctx = None
+    for a in input_arrays:
+        if isinstance(a, NDArray) and a._ctx is not None:
+            ctx = a._ctx
+            break
+    nd = NDArray(None, ctx=ctx)
+    nd._lazy = ref
+    return nd
 
 
 def array(source_array, ctx=None, dtype=None, device=None):

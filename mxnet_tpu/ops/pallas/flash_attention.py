@@ -89,8 +89,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref=None, l_ref=None, *,
         o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     else:
         o_ref[0] = acc.astype(o_ref.dtype)
-        m_ref[0] = m[:, 0]
-        l_ref[0] = l[:, 0]
+        m_ref[0, 0] = m[:, 0]
+        l_ref[0, 0] = l[:, 0]
 
 
 def _flash_call(q, k, v, sm_scale, causal, block_q, block_k, interpret,
@@ -115,19 +115,26 @@ def _flash_call(q, k, v, sm_scale, causal, block_q, block_k, interpret,
         pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
     ]
     if return_stats:
+        # stats ride as (bh, 1, t) blocked (1, 1, block_q): the Mosaic
+        # lowering requires the last two block dims to divide (8, 128)
+        # or equal the array dims — a 2-D (1, block_q) block over
+        # (bh, t) violates that on real TPU (sublane dim 1 vs bh)
         out_specs = [
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ]
         out_shape = [
             jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ]
-    else:
-        out_specs = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
-        out_shape = jax.ShapeDtypeStruct((bh, t, d), q.dtype)
+        acc, m, l = pl.pallas_call(
+            kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shape, interpret=interpret)(q, k, v)
+        return acc, m[:, 0], l[:, 0]
+    out_specs = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+    out_shape = jax.ShapeDtypeStruct((bh, t, d), q.dtype)
     return pl.pallas_call(
         kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
         out_shape=out_shape, interpret=interpret)(q, k, v)

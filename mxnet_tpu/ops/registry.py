@@ -19,7 +19,9 @@ implicit: jax's abstract evaluation computes output avals during dispatch.
 import functools
 
 import jax
+import numpy as _np
 
+from .. import _bulk
 from .. import _deferred_compute as _dc
 from .. import _rng, _tape
 from .. import profiler as _prof
@@ -109,7 +111,31 @@ def list_ops():
     return dict(_OPS)
 
 
-def apply_op(op, arrays, fn, n_out=None, name=None, _from_invoke=False):
+class _Unkeyable(TypeError):
+    pass
+
+
+def _hashable(x):
+    """Best-effort hashable token for a static op argument; raises
+    _Unkeyable for values (device arrays, numpy buffers) that must not be
+    baked into a bulk-segment cache key."""
+    if x is None or isinstance(x, (bool, int, float, str, bytes, complex)):
+        return x
+    if isinstance(x, (tuple, list)):
+        return tuple(_hashable(e) for e in x)
+    if isinstance(x, slice):
+        return ('__slice__', x.start, x.stop, x.step)
+    if isinstance(x, _np.dtype):
+        return ('__dtype__', str(x))
+    if isinstance(x, _np.generic):
+        return x.item()
+    if isinstance(x, type):
+        return ('__type__', x.__name__)
+    raise _Unkeyable(repr(type(x)))
+
+
+def apply_op(op, arrays, fn, n_out=None, name=None, _from_invoke=False,
+             bulk_key=None):
     """Imperative dispatch of a pure function over NDArray inputs.
 
     ``arrays``: NDArray inputs participating in autograd. ``fn``: closure over
@@ -121,12 +147,30 @@ def apply_op(op, arrays, fn, n_out=None, name=None, _from_invoke=False):
     dispatchers like fused RNN) record an *opaque* node: the captured graph
     stays executable, but tojson() refuses it with a clear error.
     """
-    from ..ndarray.ndarray import NDArray, _wrap_out
+    from ..ndarray.ndarray import NDArray, _wrap_out, _wrap_lazy
+
+    recording = _tape.is_recording() and _tape._needs_grad(arrays)
+    profiling = _prof._is_profiling_ops()
+
+    # ---- bulked (lazy) dispatch: record into the segment instead of
+    # executing; the flush runs the whole segment as one XLA program.
+    if (bulk_key is not None and arrays and not profiling
+            and not _dc.is_deferred_compute()):
+        grad_active = recording and op.differentiable
+        rec = _bulk.try_record(op, arrays, fn, bulk_key, grad_active)
+        if rec is not None:
+            refs, multi = rec
+            wrapped = [_wrap_lazy(r, arrays) for r in refs]
+            if grad_active:
+                for i, (w, r) in enumerate(zip(wrapped, refs)):
+                    ag = _tape.AGInfo(node=None, index=i)
+                    ag.node = _bulk.register_ag(r, ag)
+                    w._ag = ag
+            _bulk.cap_check()
+            return tuple(wrapped) if multi else wrapped[0]
 
     raws = [a._data for a in arrays]
-    recording = _tape.is_recording() and _tape._needs_grad(arrays)
     vjp_fn = None
-    profiling = _prof._is_profiling_ops()
     if profiling:
         import time as _time
         _t0 = _time.perf_counter()
@@ -209,8 +253,41 @@ def invoke(op_name, args, kwargs):
               and k not in op.static_argnames}
     kw_static = {k: (v._data if isinstance(v, NDArray) else v)
                  for k, v in kwargs.items() if k not in kw_arr}
+    # lift raw device arrays (e.g. the injected PRNG key) into traced
+    # inputs: they are data, not attributes — baking them would poison
+    # the bulk-segment cache and they carry no gradient anyway.
+    # NOT under deferred compute: the capture path must keep seeing the
+    # stochastic 'key' in kwargs so it can skip it and re-draw at replay
+    # (a lifted key would be frozen into the exported graph).
+    if not _dc.is_deferred_compute():
+        for k in list(kw_static):
+            v = kw_static[k]
+            if isinstance(v, jax.Array) and k not in op.static_argnames:
+                kw_arr[k] = NDArray(v)
+                del kw_static[k]
     kw_keys = list(kw_arr)
     arrays = arrays + [kw_arr[k] for k in kw_keys]
+
+    # bulk-segment cache key over everything that is baked into ``fn``
+    # (reference analog: the op attr dict that keys CachedOp buckets)
+    try:
+        arrpos = {(i, j) for i, j in arr_slots}
+        key_parts = []
+        for i, c in enumerate(consts):
+            if (i, None) in arrpos:
+                key_parts.append('@')
+            elif isinstance(c, list):
+                key_parts.append(tuple(
+                    '@' if (i, j) in arrpos else _hashable(e)
+                    for j, e in enumerate(c)))
+            else:
+                key_parts.append(_hashable(c))
+        bulk_key = (tuple(key_parts),
+                    tuple(sorted((k, _hashable(v))
+                                 for k, v in kw_static.items())),
+                    tuple(kw_keys))
+    except _Unkeyable:
+        bulk_key = None
 
     fn_raw = op.fn
     npos = len(arr_slots)
@@ -243,15 +320,20 @@ def invoke(op_name, args, kwargs):
         # kWriteTo into an existing array) — skip the tape/vjp work
         prev_rec = _tape.set_recording(False)
         try:
-            res = apply_op(op, arrays, fn, name=op.name, _from_invoke=True)
+            res = apply_op(op, arrays, fn, name=op.name, _from_invoke=True,
+                           bulk_key=bulk_key)
         finally:
             _tape.set_recording(prev_rec)
     else:
-        res = apply_op(op, arrays, fn, name=op.name, _from_invoke=True)
+        res = apply_op(op, arrays, fn, name=op.name, _from_invoke=True,
+                       bulk_key=bulk_key)
     if out is not None:
         if isinstance(res, tuple):
             raise ValueError('out= not supported for multi-output op')
-        out._rebind(res._data)
+        if res._lazy is not None and res._lazy.value is None:
+            out._adopt_lazy(res)     # keep the write inside the segment
+        else:
+            out._rebind(res._data)
         if _dc.is_deferred_compute():
             _dc.record(op, args, kw_static, kw_keys, arrays, res, out)
         return out

@@ -1,0 +1,229 @@
+"""Bulked eager execution (mxnet_tpu/_bulk.py).
+
+Reference contract: engine.h:310 StartBulk/StopBulk + engine.py bulk()
+context — consecutive imperative ops fuse into one engine push. Here the
+fused unit is a cached XLA program; these tests pin laziness, sync points,
+cache reuse, autograd equivalence, and the eager-fallback guards.
+"""
+import gc
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _bulk, autograd, engine, gluon
+
+
+def test_lazy_until_sync_point():
+    with engine.bulk(100):
+        a = mx.np.ones((3, 3))
+        b = a * 2 + 1
+        assert b._lazy is not None and b._lazy.value is None
+        # shape/dtype/ndim come from the abstract value, no flush
+        assert b.shape == (3, 3)
+        assert b.dtype == onp.float32
+        assert b.ndim == 2
+        assert b._lazy.value is None
+        got = b.asnumpy()           # sync point
+    onp.testing.assert_allclose(got, onp.full((3, 3), 3.0))
+
+
+def test_chain_parity_and_cache_reuse():
+    def run():
+        with engine.bulk(100):
+            a = mx.np.arange(12).reshape(3, 4).astype('float32')
+            b = mx.np.tanh(a) @ mx.np.ones((4, 2))
+            c = (b * b).sum()
+            return float(c)
+
+    v1 = run()
+    compiles = _bulk.stats()['compiles']
+    v2 = run()                      # identical segment: trie + plan hit
+    assert _bulk.stats()['compiles'] == compiles
+    assert v1 == v2
+    expect = ((onp.tanh(onp.arange(12).reshape(3, 4)) @
+               onp.ones((4, 2))) ** 2).sum()
+    assert abs(v1 - expect) < 1e-4
+
+
+def test_autograd_matches_eager():
+    def grads(bulked):
+        x = mx.np.array([[1., 2.], [3., 4.]])
+        x.attach_grad()
+        ctx = engine.bulk(1000) if bulked else engine.naive_engine()
+        with ctx:
+            with autograd.record():
+                y = ((x * x).sum() + (3 * x).sum())
+            y.backward()
+        return x.grad.asnumpy()
+
+    onp.testing.assert_allclose(grads(True), grads(False), rtol=1e-6)
+
+
+def test_pause_blocks_gradient_inside_segment():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with engine.bulk(100):
+        with autograd.record():
+            y = x * 3
+            with autograd.pause():
+                z = y * 10          # recorded w/o grad: must block flow
+            w = (y + z).sum()
+        w.backward()
+    # d w/dx = 3 (through y) + 0 (z path stopped) — eager tape semantics
+    onp.testing.assert_allclose(x.grad.asnumpy(), [3.0])
+
+
+def test_out_kwarg_stays_in_segment():
+    with engine.bulk(100):
+        a = mx.np.ones((4,))
+        out = mx.np.zeros((4,))
+        mx.np.add(a, a, out=out)
+        assert out._lazy is not None and out._lazy.value is None
+        onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), 2.0))
+
+
+def test_cross_segment_chaining():
+    with engine.bulk(100):
+        a = mx.np.ones((2, 2)) * 4
+        _ = a.asnumpy()             # flush mid-stream
+        b = a + 1                   # new segment consumes flushed value
+        onp.testing.assert_allclose(b.asnumpy(), onp.full((2, 2), 5.0))
+
+
+def test_size_cap_flushes():
+    with engine.bulk(2):
+        a = mx.np.ones((2,))
+        b = a + 1
+        c = b + 1                   # second entry: cap reached, flush
+        assert c._lazy is None or c._lazy.value is not None
+        onp.testing.assert_allclose(c.asnumpy(), onp.full((2,), 3.0))
+
+
+def test_varying_scalar_marks_unstable_not_compile_storm():
+    compiles0 = _bulk.stats()['compiles']
+    for i in range(40):
+        with engine.bulk(100):
+            a = mx.np.ones((2,))
+            b = a * float(i)        # scalar baked into the op: varies
+            assert abs(float(b.asnumpy()[0]) - float(i)) < 1e-6
+    # after _MAX_SIBLINGS distinct constants the position goes eager
+    # (with periodic re-admission); compiles stay bounded instead of
+    # one per iteration
+    assert _bulk.stats()['compiles'] - compiles0 <= _bulk._MAX_SIBLINGS + 6
+
+
+def test_training_loop_parity_with_trainer():
+    def train(bulked):
+        mx.np.random.seed(7)
+        net = gluon.nn.Dense(1, in_units=3)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                                {'learning_rate': 0.1, 'momentum': 0.9})
+        xs = onp.random.default_rng(0).standard_normal((8, 3)).astype('f')
+        ys = (xs @ onp.array([[1.], [2.], [3.]], 'f')).astype('f')
+        ctx = engine.bulk(4096) if bulked else engine.naive_engine()
+        with ctx:
+            for _ in range(5):
+                x, y = mx.np.array(xs), mx.np.array(ys)
+                with autograd.record():
+                    loss = ((net(x) - y) ** 2).mean()
+                loss.backward()
+                trainer.step(1)
+        return {k: v.data().asnumpy()
+                for k, v in net.collect_params().items()}
+
+    got, want = train(True), train(False)
+    for k in want:
+        onp.testing.assert_allclose(got[k], want[k], rtol=2e-5, atol=2e-5)
+
+
+def test_second_iteration_no_retrace():
+    net = gluon.nn.Dense(4, in_units=4)
+    net.initialize()
+
+    def step(i):
+        with engine.bulk(4096):
+            x = mx.np.ones((2, 4)) * (1.0 + 0.0)   # stable constants
+            with autograd.record():
+                y = (net(x) ** 2).sum()
+            y.backward()
+            return float(y.asnumpy())
+
+    step(0)
+    s = _bulk.stats()
+    step(1)
+    s2 = _bulk.stats()
+    assert s2['compiles'] == s['compiles'], 'iteration 2 recompiled'
+    assert s2['misses'] == s['misses'], 'iteration 2 missed the trie'
+
+
+def test_dead_intermediates_not_materialized():
+    _bulk.reset()       # pristine trie (earlier tests mark positions)
+    with engine.bulk(100):
+        a = mx.np.ones((4, 4))
+        b = a * 2           # kept
+        tmp = a * 3         # dropped before flush
+        del tmp
+        gc.collect()
+        seg = _bulk._st.segment
+        n_live = sum(1 for e in seg.entries for w in e.out_refs
+                     if w() is not None)
+        assert n_live == 1
+        onp.testing.assert_allclose(b.asnumpy(), onp.full((4, 4), 2.0))
+
+
+def test_nondifferentiable_op_detached():
+    x = mx.np.array([1.5, 2.5])
+    x.attach_grad()
+    with engine.bulk(100):
+        with autograd.record():
+            y = mx.np.round(x) * x      # round contributes no gradient
+            s = y.sum()
+        s.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.round([1.5, 2.5]))
+
+
+def test_higher_order_through_segment():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with engine.bulk(100):
+        with autograd.record():
+            y = (x ** 3).sum()
+            gx, = autograd.grad(y, [x], create_graph=True)
+            gy = gx.sum()
+        gy.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [12.0])  # d2/dx2 x^3=6x
+
+
+def test_stochastic_op_bulks_with_fresh_keys():
+    with engine.bulk(100):
+        a = mx.np.random.uniform(size=(64,))
+        b = mx.np.random.uniform(size=(64,))
+        va, vb = a.asnumpy(), b.asnumpy()
+    assert not onp.allclose(va, vb)     # distinct keys per call
+
+
+def test_naive_engine_bypasses_bulk():
+    with engine.naive_engine():
+        a = mx.np.ones((2,)) + 1
+        assert a._lazy is None
+    onp.testing.assert_allclose(a.asnumpy(), [2.0, 2.0])
+
+
+def test_set_bulk_size_toggles():
+    try:
+        engine.set_bulk_size(16)
+        a = mx.np.ones((2,)) * 5
+        assert a._lazy is not None          # bulking on
+        engine.set_bulk_size(0)
+        b = mx.np.ones((2,)) * 5
+        assert b._lazy is None              # bulking off
+        onp.testing.assert_allclose(a.asnumpy(), [5.0, 5.0])
+    finally:
+        _bulk._st.enabled = None            # restore env default
+
+
+def test_bulk_stats_surface():
+    s = engine.bulk_stats()
+    assert {'hits', 'misses', 'flushes', 'compiles'} <= set(s)
