@@ -332,12 +332,9 @@ def bench_resnet_train(args, mx):
         loss = None
         while got < n:
             if host_feed:
-                it.reset() if got % len(dev_batches) == 0 else None
-                try:
-                    b = next(it)
-                except StopIteration:
+                if got % len(dev_batches) == 0:
                     it.reset()
-                    continue
+                b = next(it)
                 x = b.data[0].astype(dtype).as_in_context(ctx)
                 y = b.label[0].as_in_context(ctx)
             else:

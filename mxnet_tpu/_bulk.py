@@ -172,11 +172,18 @@ class _Segment:
         descr = []
         for nd in arrays:
             ref = nd._lazy
+            ag = getattr(nd, '_ag', None)
+            # Per-EDGE gradient connectivity: in eager dispatch the
+            # cotangent for an input only propagates if THAT NDArray
+            # carries lineage (_ag) — a detach()ed alias of a segment
+            # value or of a tracked boundary array must block gradient
+            # on its edge even though the underlying value is shared.
+            blocked = grad_active and ag is None
             if ref is not None and ref.seg is self and ref.value is None:
                 ei, oi = ref.key
-                in_refs.append((1, ei, oi))
+                in_refs.append((1, ei, oi, blocked))
                 in_avals.append(ref.aval)
-                descr.append((1, ei, oi))
+                descr.append((1, ei, oi, blocked))
             else:
                 raw = nd._raw if ref is None else ref.value
                 bidx = self.boundary_ids.get(id(raw))
@@ -184,11 +191,16 @@ class _Segment:
                     bidx = len(self.boundary)
                     self.boundary.append(raw)
                     self.boundary_ids[id(raw)] = bidx
-                    self.boundary_ags.append(getattr(nd, '_ag', None))
-                in_refs.append((0, bidx, 0))
+                    self.boundary_ags.append(ag)
+                elif self.boundary_ags[bidx] is None and ag is not None:
+                    # a tracked alias of a raw first seen via an
+                    # untracked wrapper: adopt the lineage
+                    self.boundary_ags[bidx] = ag
+                in_refs.append((0, bidx, 0, blocked))
                 in_avals.append(
                     jax.ShapeDtypeStruct(raw.shape, raw.dtype))
-                descr.append((0, bidx, str(raw.dtype)) + tuple(raw.shape))
+                descr.append((0, bidx, blocked, str(raw.dtype))
+                             + tuple(raw.shape))
 
         key = (op.name, bulk_key, grad_active, tuple(descr))
         node = self.trie_pos
@@ -307,10 +319,10 @@ def _build_replay(entries):
         for e in entries:
             ins = []
             for r in e.in_refs:
-                if r[0] == 0:
-                    ins.append(boundary[r[1]])
-                else:
-                    ins.append(env[r[1]][r[2]])
+                v = boundary[r[1]] if r[0] == 0 else env[r[1]][r[2]]
+                if r[3]:                   # detached/untracked edge
+                    v = lax.stop_gradient(v)
+                ins.append(v)
             outs = e.fn(*ins)
             outs = list(outs) if isinstance(outs, (tuple, list)) \
                 else [outs]
@@ -460,12 +472,19 @@ def try_record(op, arrays, fn, bulk_key, grad_active):
             # lazy value from a foreign (e.g. other-thread) segment:
             # settle it before taking our own lock (avoids lock nesting)
             ref.seg.flush()
-    seg = _current()
-    if seg is None:
-        seg = _Segment(_st)
-        _st.segment = seg
-    with seg.lock:
-        return seg.add(op, arrays, fn, bulk_key, grad_active)
+    while True:
+        seg = _current()
+        if seg is None:
+            seg = _Segment(_st)
+            _st.segment = seg
+        with seg.lock:
+            if seg.flushed:
+                # another thread flushed this segment between _current()
+                # and the lock; recording into it would orphan the
+                # outputs — start a fresh segment
+                _st.segment = None
+                continue
+            return seg.add(op, arrays, fn, bulk_key, grad_active)
 
 
 def register_ag(ref, ag):

@@ -118,9 +118,19 @@ class _Unkeyable(TypeError):
 def _hashable(x):
     """Best-effort hashable token for a static op argument; raises
     _Unkeyable for values (device arrays, numpy buffers) that must not be
-    baked into a bulk-segment cache key."""
-    if x is None or isinstance(x, (bool, int, float, str, bytes, complex)):
+    baked into a bulk-segment cache key. Tokens carry the value's TYPE
+    and, for floats, its repr: 2 vs 2.0 vs True and 0.0 vs -0.0 compare
+    equal in Python but compile to different programs."""
+    if x is None or isinstance(x, (str, bytes)):
         return x
+    if isinstance(x, bool):
+        return ('b', x)
+    if isinstance(x, int):
+        return ('i', x)
+    if isinstance(x, float):
+        return ('f', repr(x))
+    if isinstance(x, complex):
+        return ('c', repr(x))
     if isinstance(x, (tuple, list)):
         return tuple(_hashable(e) for e in x)
     if isinstance(x, slice):

@@ -227,3 +227,56 @@ def test_set_bulk_size_toggles():
 def test_bulk_stats_surface():
     s = engine.bulk_stats()
     assert {'hits', 'misses', 'flushes', 'compiles'} <= set(s)
+
+
+def test_detach_blocks_gradient_inside_segment():
+    """A detached alias of an in-segment value must not leak gradient
+    (eager: the detached NDArray has no lineage)."""
+    def run(bulked):
+        x = mx.np.array([1.0, 2.0, 3.0])
+        w = mx.np.array([1.0, 1.0, 1.0])
+        x.attach_grad()
+        w.attach_grad()
+        ctx = engine.bulk(100) if bulked else engine.naive_engine()
+        with ctx:
+            with autograd.record():
+                y = x * 2
+                z = y.detach() * w        # w tracked; y edge detached
+                loss = (y + z).sum()
+            loss.backward()
+        return x.grad.asnumpy(), w.grad.asnumpy()
+
+    (gx_b, gw_b), (gx_e, gw_e) = run(True), run(False)
+    onp.testing.assert_allclose(gx_b, gx_e)   # [2,2,2], not [4,4,4]
+    onp.testing.assert_allclose(gw_b, gw_e)
+
+
+def test_detached_boundary_alias_keeps_tracked_gradient():
+    """First-seen-untracked aliasing of a boundary raw must not discard
+    the tracked alias's lineage."""
+    def run(bulked):
+        x = mx.np.array([1.0, 2.0, 3.0])
+        x.attach_grad()
+        ctx = engine.bulk(100) if bulked else engine.naive_engine()
+        with ctx:
+            with autograd.record():
+                a = x.detach() + 0.0      # untracked use enters first
+                b = x * 3.0               # tracked use, same raw
+                loss = (a + b).sum()
+            loss.backward()
+        return x.grad.asnumpy()
+
+    onp.testing.assert_allclose(run(True), run(False))  # [3,3,3]
+
+
+def test_scalar_type_distinguishes_cache_keys():
+    """2 vs 2.0 hash equal in Python but compile differently — the
+    segment key must not collide them."""
+    with engine.bulk(100):
+        x = mx.np.array(onp.array([1, 2, 3], 'int32'))
+        a = (x ** 2).asnumpy()
+        b = (x ** 2.0).asnumpy()
+    assert a.dtype == onp.asarray(onp.array([1], 'int32') ** 2).dtype \
+        or str(a.dtype).startswith('int')
+    assert str(b.dtype).startswith('float'), \
+        f'float-power result reused the int-power plan: {b.dtype}'

@@ -1,0 +1,100 @@
+"""Meta-test: every implemented ledger op has a numeric test (VERDICT r2
+item 5 'asserted by a meta-test').
+
+Coverage sources, in order of strength:
+1. the generated numeric sweep (tests/test_op_numeric_sweep.py),
+2. the opperf rule sweep (tests/test_op_sweep.py — forward+grad finite
+   for every ruled op),
+3. a dedicated test referencing the op by name anywhere in tests/.
+
+Any implemented op matched by none of the three fails this test, so an
+op can never be added to the registry (or resolved by the ledger) without
+test coverage following it.
+"""
+import glob
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import ledger, registry
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(HERE), 'benchmark'))
+import opperf  # noqa: E402
+
+FIXTURE = os.path.join(HERE, 'fixtures', 'reference_nnvm_ops.txt')
+
+
+def _implemented():
+    fes = [mx.np, mx.npx, mx.nd, mx.np.random, mx.np.linalg]
+    regs = set(registry.list_ops())
+    out = set()
+    for line in open(FIXTURE):
+        name = line.strip()
+        if not name:
+            continue
+        status, resolved = ledger.account(name, regs, fes)
+        if status == 'implemented':
+            out.add(resolved)
+    return out
+
+
+def _test_texts():
+    texts = {}
+    for f in glob.glob(os.path.join(HERE, 'test_*.py')) + \
+            glob.glob(os.path.join(HERE, 'nightly', '*.py')):
+        if os.path.basename(f) == os.path.basename(__file__):
+            continue
+        texts[os.path.basename(f)] = open(f).read()
+    return texts
+
+
+def test_every_implemented_op_has_a_test():
+    opperf._register_rules(np, large=(16, 16), nn_scale=1)
+    ruled = set(opperf._RULES)
+    texts = _test_texts()
+    sweep = texts['test_op_numeric_sweep.py']
+
+    impl = _implemented()
+    assert len(impl) > 350, 'ledger shrank unexpectedly'
+
+    uncovered = []
+    by_source = {'sweep': 0, 'rules': 0, 'dedicated': 0}
+    for name in sorted(impl):
+        pat = re.compile(r'\b' + re.escape(name) + r'\b')
+        if pat.search(sweep):
+            by_source['sweep'] += 1
+        elif name in ruled:
+            by_source['rules'] += 1
+        elif any(pat.search(t) for fn, t in texts.items()
+                 if fn != 'test_op_numeric_sweep.py'):
+            by_source['dedicated'] += 1
+        else:
+            uncovered.append(name)
+    assert not uncovered, (
+        f'{len(uncovered)} implemented ops have NO test coverage '
+        f'(add to test_op_numeric_sweep.py or a dedicated test): '
+        f'{uncovered}')
+    # guard against the sweep itself rotting away
+    assert by_source['sweep'] >= 100, by_source
+    assert by_source['rules'] >= 70, by_source
+
+
+def test_sweep_keeps_reference_scale():
+    """The reference's test_operator.py has 253 tests; our generated
+    sweep + rule sweep must stay at comparable breadth."""
+    import subprocess
+    out = subprocess.run(
+        [sys.executable, '-m', 'pytest', '--collect-only', '-q',
+         os.path.join(HERE, 'test_op_numeric_sweep.py'),
+         os.path.join(HERE, 'test_op_sweep.py')],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, 'MXNET_TEST_DEVICE': 'cpu'})
+    m = re.search(r'(\d+) tests collected', out.stdout)
+    assert m, out.stdout[-500:]
+    assert int(m.group(1)) >= 400, \
+        f'op sweep shrank to {m.group(1)} collected tests'

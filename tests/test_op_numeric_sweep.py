@@ -1,0 +1,785 @@
+"""Generated numeric operator sweep (VERDICT r2 item 5).
+
+The breadth role of the reference's ``tests/python/unittest/test_operator.py``
+(9.4 kLoC, 253 tests) re-designed as data: every op family gets generated
+numeric tests —
+
+* forward parity against numpy (or a hand reference) where one exists,
+* central-difference numeric gradients vs autograd (f32; the frontend is
+  32-bit by design, so tolerances are wide enough for f32 but tight
+  enough to catch wrong/missing VJP factors),
+* dtype-promotion checks for binary ops against the framework's
+  promotion lattice (``jnp.promote_types`` — TPU-native, bf16-aware; the
+  reference's mxnet.numpy likewise avoids numpy's float64-everywhere),
+* broadcasting corners (mismatched ranks, size-1 axes, scalars,
+  zero-size arrays),
+* descends-the-quadratic checks for every optimizer update kernel,
+* moment sanity for random samplers, numpy parity for linalg.
+
+``test_op_coverage_meta.py`` asserts every implemented ledger op is
+covered here, by the opperf-rule sweep, or by a named dedicated test.
+"""
+import functools
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+rng = onp.random.default_rng
+
+
+# --------------------------------------------------------------- helpers
+def _arr(a, dtype='float32'):
+    return mx.np.array(onp.asarray(a, dtype=dtype))
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, 'asnumpy') else onp.asarray(x)
+
+
+def _fn(name):
+    f = getattr(mx.np, name, None)
+    if f is None:
+        f = getattr(mx.npx, name, None)
+    if f is None:
+        f = getattr(mx.np.linalg, name, None)
+    if f is None:
+        f = getattr(mx.np.random, name, None)
+    assert f is not None, f'no frontend function for {name}'
+    return f
+
+
+def _assert_close(got, want, rtol=2e-4, atol=2e-4, msg=''):
+    onp.testing.assert_allclose(
+        onp.asarray(_np(got), 'float64'), onp.asarray(want, 'float64'),
+        rtol=rtol, atol=atol, err_msg=msg)
+
+
+def numeric_grad(f, x, h=0.02):
+    """Central-difference d(sum f)/dx elementwise at x (f32-friendly)."""
+    x = onp.asarray(x, 'float32')
+    g = onp.zeros_like(x)
+    it = onp.nditer(x, flags=['multi_index'])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += h
+        xm[i] -= h
+        g[i] = (float(_np(f(_arr(xp))).sum())
+                - float(_np(f(_arr(xm))).sum())) / (2 * h)
+        it.iternext()
+    return g
+
+
+def check_grad(name, fn, x_np, rtol=0.06, atol=0.02):
+    x = _arr(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = fn(x).sum()
+    y.backward()
+    got = _np(x.grad)
+    want = numeric_grad(fn, x_np)
+    onp.testing.assert_allclose(got, want, rtol=rtol, atol=atol,
+                                err_msg=f'{name}: autograd vs numeric')
+
+
+# ------------------------------------------------------- unary elementwise
+# name -> (sample domain generator, numpy reference or None)
+def _dom(lo, hi, shape=(2, 3)):
+    return lambda: rng(0).uniform(lo, hi, shape).astype('float32')
+
+
+UNARY = {
+    'arccos':   (_dom(-0.8, 0.8), onp.arccos),
+    'arcsin':   (_dom(-0.8, 0.8), onp.arcsin),
+    'arctanh':  (_dom(-0.8, 0.8), onp.arctanh),
+    'arccosh':  (_dom(1.2, 3.0), onp.arccosh),
+    'arcsinh':  (_dom(-2, 2), onp.arcsinh),
+    'deg2rad':  (_dom(-180, 180), onp.deg2rad),
+    'rad2deg':  (_dom(-3, 3), onp.rad2deg),
+    'radians':  (_dom(-180, 180), onp.radians),
+    'degrees':  (_dom(-3, 3), onp.degrees),
+    'fix':      (_dom(-3, 3), onp.fix),
+    'trunc':    (_dom(-3, 3), onp.trunc),
+    'rsqrt':    (_dom(0.5, 4), lambda x: 1 / onp.sqrt(x)),
+    'rcbrt':    (_dom(0.5, 4), lambda x: 1 / onp.cbrt(x)),
+    'log10':    (_dom(0.5, 9), onp.log10),
+    'log2':     (_dom(0.5, 9), onp.log2),
+    'sinh':     (_dom(-2, 2), onp.sinh),
+    'cosh':     (_dom(-2, 2), onp.cosh),
+    'tan':      (_dom(-1, 1), onp.tan),
+    'digamma':  (_dom(0.5, 4), None),
+    'gammaln':  (_dom(0.5, 4), None),
+    'erfinv':   (_dom(-0.7, 0.7), None),
+}
+_UNSMOOTH = {'fix', 'trunc'}
+
+
+@pytest.mark.parametrize('name', sorted(UNARY))
+def test_unary_forward(name):
+    gen, ref = UNARY[name]
+    x = gen()
+    got = _fn(name)(_arr(x))
+    if ref is not None:
+        _assert_close(got, ref(x.astype('float64')), rtol=1e-4, atol=1e-5,
+                      msg=name)
+    else:
+        assert onp.isfinite(_np(got)).all(), name
+
+
+@pytest.mark.parametrize('name', sorted(set(UNARY) - _UNSMOOTH))
+def test_unary_numeric_grad(name):
+    gen, _ = UNARY[name]
+    check_grad(name, _fn(name), gen())
+
+
+def test_digamma_gammaln_values():
+    # spot values (Abramowitz & Stegun): digamma(1) = -gamma_E
+    _assert_close(_fn('digamma')(_arr([1.0])), [-0.5772157], rtol=1e-4)
+    _assert_close(_fn('gammaln')(_arr([5.0])), [onp.log(24.0)], rtol=1e-5)
+    _assert_close(_fn('erfinv')(_arr([0.5])), [0.4769363], rtol=1e-4)
+
+
+# ------------------------------------------------------ binary elementwise
+BINARY_FLOAT = {
+    'copysign': onp.copysign,
+    'fmax': onp.fmax,
+    'fmin': onp.fmin,
+    'fmod': onp.fmod,
+    'ldexp': None,                       # mx follows x1 * 2**x2
+}
+BINARY_CMP = {
+    'greater': onp.greater,
+    'greater_equal': onp.greater_equal,
+    'less_equal': onp.less_equal,
+    'not_equal': onp.not_equal,
+}
+BINARY_LOGICAL = {
+    'logical_and': onp.logical_and,
+    'logical_or': onp.logical_or,
+    'logical_xor': onp.logical_xor,
+}
+BINARY_INT = {
+    'bitwise_and': onp.bitwise_and,
+    'bitwise_or': onp.bitwise_or,
+    'bitwise_xor': onp.bitwise_xor,
+    'lcm': onp.lcm,
+}
+
+
+def _bin_sample(shape_a=(2, 3), shape_b=(2, 3)):
+    r = rng(1)
+    a = r.uniform(-2, 2, shape_a).astype('float32')
+    b = r.uniform(0.5, 2, shape_b).astype('float32')
+    return a, b
+
+
+@pytest.mark.parametrize('name', sorted(BINARY_FLOAT))
+def test_binary_float_forward(name):
+    a, b = _bin_sample()
+    if name == 'ldexp':                   # exponent must be integral
+        bi = b.astype('int32')
+        got = _fn(name)(_arr(a), _arr(bi, 'int32'))
+        _assert_close(got, onp.ldexp(a, bi), rtol=1e-5, msg=name)
+        return
+    got = _fn(name)(_arr(a), _arr(b))
+    _assert_close(got, BINARY_FLOAT[name](a, b), rtol=1e-5, atol=1e-5,
+                  msg=name)
+
+
+@pytest.mark.parametrize('name', sorted(BINARY_CMP) + sorted(BINARY_LOGICAL))
+def test_binary_bool_forward(name):
+    a, b = _bin_sample()
+    b[0, 0] = a[0, 0]                    # exercise the equal branch
+    ref = {**BINARY_CMP, **BINARY_LOGICAL}[name]
+    got = _np(_fn(name)(_arr(a), _arr(b)))
+    onp.testing.assert_array_equal(got.astype(bool), ref(a, b), err_msg=name)
+
+
+@pytest.mark.parametrize('name', sorted(BINARY_INT))
+def test_binary_int_forward(name):
+    r = rng(2)
+    a = r.integers(0, 16, (2, 3)).astype('int32')
+    b = r.integers(1, 16, (2, 3)).astype('int32')
+    got = _np(_fn(name)(_arr(a, 'int32'), _arr(b, 'int32')))
+    onp.testing.assert_array_equal(got, BINARY_INT[name](a, b), err_msg=name)
+
+
+def test_bitwise_not_forward():
+    a = onp.array([[0, 1, 5]], 'int32')
+    onp.testing.assert_array_equal(
+        _np(_fn('bitwise_not')(_arr(a, 'int32'))), onp.bitwise_not(a))
+
+
+def test_logical_not_forward():
+    a = onp.array([[0.0, 1.0, 2.0]], 'float32')
+    got = _np(_fn('logical_not')(_arr(a)))
+    onp.testing.assert_array_equal(got.astype(bool), onp.logical_not(a))
+
+
+def test_mod_forward_and_grad():
+    """Covers mod and the legacy _mod registration."""
+    a, b = _bin_sample()
+    _assert_close(_fn('mod')(_arr(a), _arr(b)), onp.mod(a, b), rtol=1e-5,
+                  atol=1e-5)
+    check_grad('mod', lambda x: _fn('mod')(x, _arr(b)), a)
+
+
+# broadcasting corners: every float binary op over awkward shape pairs
+_BCAST_SHAPES = [((3, 1), (1, 4)), ((1,), (2, 3)), ((), (2, 2)),
+                 ((0, 3), (1, 3)), ((2, 1, 4), (3, 1))]
+
+
+@pytest.mark.parametrize('name', ['add', 'multiply', 'maximum', 'copysign',
+                                  'fmax', 'greater', 'logical_and'])
+@pytest.mark.parametrize('sa,sb', _BCAST_SHAPES)
+def test_binary_broadcast_corners(name, sa, sb):
+    r = rng(3)
+    a = r.uniform(0.5, 2, sa).astype('float32')
+    b = r.uniform(0.5, 2, sb).astype('float32')
+    ref = {'add': onp.add, 'multiply': onp.multiply,
+           'maximum': onp.maximum, 'copysign': onp.copysign,
+           'fmax': onp.fmax, 'greater': onp.greater,
+           'logical_and': onp.logical_and}[name]
+    got = _np(_fn(name)(_arr(a), _arr(b)))
+    want = ref(a, b)
+    assert got.shape == want.shape, f'{name} {sa}x{sb}'
+    onp.testing.assert_allclose(got.astype('float64'),
+                                want.astype('float64'), rtol=1e-5)
+
+
+# dtype promotion: the framework contract is the jax lattice (bf16-aware;
+# like the reference's mxnet.numpy it does not promote to float64)
+_DTYPE_PAIRS = [('float32', 'float16'), ('float32', 'int32'),
+                ('int32', 'int8'), ('float16', 'int32'),
+                ('bool', 'int32'), ('bfloat16', 'float32')]
+
+
+@pytest.mark.parametrize('name', ['add', 'multiply', 'subtract', 'maximum'])
+@pytest.mark.parametrize('da,db', _DTYPE_PAIRS)
+def test_binary_dtype_promotion(name, da, db):
+    import jax.numpy as jnp
+    a = mx.np.ones((2, 2), dtype=da)
+    b = mx.np.ones((2, 2), dtype=db)
+    out = _fn(name)(a, b)
+    want = jnp.promote_types(da, db)
+    assert str(out.dtype) == str(onp.dtype(want)) or \
+        str(out.dtype) == str(want), \
+        f'{name}({da},{db}) -> {out.dtype}, want {want}'
+
+
+# ------------------------------------------------------------- reductions
+def test_nanprod():
+    x = onp.array([[1.0, onp.nan, 2.0], [3.0, 4.0, onp.nan]], 'float32')
+    _assert_close(_fn('nanprod')(_arr(x)), onp.nanprod(x))
+    _assert_close(_fn('nanprod')(_arr(x), axis=1),
+                  onp.nanprod(x, axis=1))
+
+
+@pytest.mark.parametrize('name,ref', [('all', onp.all), ('any', onp.any)])
+@pytest.mark.parametrize('axis', [None, 0, 1])
+def test_bool_reductions(name, ref, axis):
+    x = onp.array([[0.0, 1.0, 2.0], [0.0, 0.0, 3.0]], 'float32')
+    got = _np(_fn(name)(_arr(x), axis=axis))
+    onp.testing.assert_array_equal(got.astype(bool), ref(x, axis=axis))
+
+
+# ------------------------------------------------- shape / stacking ops
+def test_stack_family_parity():
+    r = rng(4)
+    a = r.standard_normal((2, 3)).astype('float32')
+    b = r.standard_normal((2, 3)).astype('float32')
+    for name, ref in [('hstack', onp.hstack), ('vstack', onp.vstack),
+                      ('dstack', onp.dstack),
+                      ('column_stack', onp.column_stack)]:
+        _assert_close(_fn(name)([_arr(a), _arr(b)]), ref([a, b]), msg=name)
+
+
+def test_atleast_family():
+    for name, ref in [('atleast_1d', onp.atleast_1d),
+                      ('atleast_2d', onp.atleast_2d),
+                      ('atleast_3d', onp.atleast_3d)]:
+        got = _fn(name)(_arr(5.0))
+        assert _np(got).shape == ref(onp.float32(5.0)).shape, name
+
+
+def test_shape_manip_parity():
+    r = rng(5)
+    x = r.standard_normal((2, 3, 4)).astype('float32')
+    _assert_close(_fn('rollaxis')(_arr(x), 2), onp.rollaxis(x, 2))
+    _assert_close(_fn('rot90')(_arr(x)), onp.rot90(x))
+    _assert_close(_fn('delete')(_arr(x), 1, axis=1),
+                  onp.delete(x, 1, axis=1))
+    _assert_close(_fn('diagflat')(_arr(x[0, 0])), onp.diagflat(x[0, 0]))
+    m = _arr(onp.zeros((3, 3), 'float32'))
+    got = _fn('fill_diagonal')(m, 7.0)
+    want = onp.zeros((3, 3), 'float32')
+    onp.fill_diagonal(want, 7.0)
+    _assert_close(got, want)
+    _assert_close(_fn('tri')(3, 4, dtype='float32'), onp.tri(3, 4))
+
+
+def test_reverse_slice_axis_like():
+    r = rng(6)
+    x = r.standard_normal((3, 4)).astype('float32')
+    _assert_close(mx.nd.reverse(mx.nd.array(x), axis=0), x[::-1])
+    _assert_close(mx.npx.slice_axis(_arr(x), axis=1, begin=1, end=3),
+                  x[:, 1:3])
+    y = _arr(onp.zeros((2, 2), 'float32'))
+    _assert_close(mx.npx.slice_like(_arr(x), y), x[:2, :2])
+
+
+def test_index_coord_transforms():
+    idx = onp.array([3, 7], 'int64')
+    got = _fn('unravel_index')(_arr(idx, 'int64'), (2, 4))
+    want = onp.unravel_index(idx, (2, 4))
+    for g, w in (zip(got, want) if isinstance(got, (tuple, list))
+                 else [(got, onp.stack(want))]):
+        onp.testing.assert_array_equal(_np(g), w)
+    multi = (onp.array([0, 1], 'int64'), onp.array([3, 1], 'int64'))
+    got = _fn('ravel_multi_index')(_arr(onp.stack(multi), 'int64'), (2, 4))
+    onp.testing.assert_array_equal(_np(got),
+                                   onp.ravel_multi_index(multi, (2, 4)))
+
+
+def test_interp_parity():
+    xp = onp.array([0.0, 1.0, 2.0], 'float32')
+    fp = onp.array([0.0, 10.0, 20.0], 'float32')
+    x = onp.array([0.5, 1.5], 'float32')
+    _assert_close(_fn('interp')(_arr(x), _arr(xp), _arr(fp)),
+                  onp.interp(x, xp, fp))
+
+
+def test_logspace_parity():
+    _assert_close(_fn('logspace')(0.0, 2.0, 5),
+                  onp.logspace(0.0, 2.0, 5), rtol=1e-4)
+
+
+def test_full_like_parity():
+    x = _arr(onp.zeros((2, 2), 'float32'))
+    _assert_close(_fn('full_like')(x, 3.5), onp.full((2, 2), 3.5))
+
+
+def test_shares_memory_contract():
+    """Functional arrays never alias (reference _npi_share_memory returns
+    actual aliasing; here rebind semantics make every value distinct)."""
+    x = _arr(onp.zeros((4,), 'float32'))
+    assert bool(_fn('shares_memory')(x, x)) in (True, False)
+
+
+def test_sequence_mask_values():
+    x = onp.ones((3, 2, 2), 'float32')           # (seq, batch, feat)
+    out = mx.npx.sequence_mask(_arr(x), _arr([2, 1], 'float32'),
+                               use_sequence_length=True, value=-1.0)
+    got = _np(out)
+    assert (got[0] == 1).all() and (got[2] == -1).all()
+    assert (got[1, 0] == 1).all() and (got[1, 1] == -1).all()
+
+
+def test_smooth_l1_values():
+    x = onp.array([-2.0, -0.5, 0.0, 0.5, 2.0], 'float32')
+    got = _np(mx.nd.smooth_l1(mx.nd.array(x), scalar=1.0))
+    want = onp.where(onp.abs(x) < 1, 0.5 * x * x, onp.abs(x) - 0.5)
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_softmax_cross_entropy_values():
+    logits = onp.array([[1.0, 2.0, 0.5], [0.1, 0.2, 3.0]], 'float32')
+    labels = onp.array([1, 2], 'float32')
+    got = float(_np(mx.nd.softmax_cross_entropy(
+        mx.nd.array(logits), mx.nd.array(labels))).sum())
+    p = onp.exp(logits) / onp.exp(logits).sum(-1, keepdims=True)
+    want = -onp.log(p[[0, 1], [1, 2]]).sum()
+    assert abs(got - want) < 1e-4
+
+
+def test_index_add_copy_update():
+    x = onp.zeros((4, 2), 'float32')
+    v = onp.ones((2, 2), 'float32') * 3
+    idx = onp.array([1, 3], 'int64')
+    got = _np(mx.npx.index_add(_arr(x), _arr(idx, 'int64'), _arr(v)))
+    want = x.copy()
+    want[[1, 3]] += 3
+    onp.testing.assert_allclose(got, want)
+    got2 = _np(mx.npx.index_copy(_arr(x), _arr(idx, 'int64'), _arr(v)))
+    want2 = x.copy()
+    want2[[1, 3]] = 3
+    onp.testing.assert_allclose(got2, want2)
+
+
+def test_all_finite_and_reset_arrays():
+    good = _arr(onp.ones((3,), 'float32'))
+    bad = _arr(onp.array([1.0, onp.inf], 'float32'))
+    assert int(_np(mx.npx.all_finite(good))) == 1
+    assert int(_np(mx.npx.all_finite(bad))) == 0
+    a = _arr(onp.ones((2,), 'float32'))
+    out = mx.nd.reset_arrays(a, num_arrays=1)
+    z = out[0] if isinstance(out, (tuple, list)) else out
+    onp.testing.assert_allclose(_np(z), onp.zeros((2,)))
+
+
+def test_getnnz_and_sparse_retain():
+    from mxnet_tpu.ndarray import sparse as sp
+    dense = onp.array([[0.0, 1.0], [2.0, 0.0], [0.0, 0.0]], 'float32')
+    csr = sp.csr_matrix(dense)
+    getnnz = getattr(mx.npx, 'getnnz', None) or mx.nd.getnnz
+    assert int(_np(getnnz(csr))) == 2
+    rsp = sp.row_sparse_array(onp.array([[1.0, 1], [0, 0], [2, 2]],
+                                        'float32'))
+    kept = mx.nd.sparse_retain(rsp, mx.nd.array(onp.array([0], 'int64')))
+    onp.testing.assert_allclose(_np(kept.todense() if
+                                    hasattr(kept, 'todense') else kept),
+                                [[1, 1], [0, 0], [0, 0]])
+
+
+# ------------------------------------------------------------------ linalg
+def _spd(n=3):
+    a = rng(7).standard_normal((n, n)).astype('float32')
+    return a @ a.T + n * onp.eye(n, dtype='float32')
+
+
+def test_linalg_eigh_family():
+    s = _spd()
+    w_got, v_got = (_np(o) for o in _fn('eigh')(_arr(s)))
+    w_want = onp.linalg.eigh(s.astype('float64'))[0]
+    onp.testing.assert_allclose(onp.sort(w_got), w_want, rtol=1e-3)
+    onp.testing.assert_allclose(
+        onp.sort(_np(_fn('eigvalsh')(_arr(s)))), w_want, rtol=1e-3)
+    # general eig on a symmetric matrix: eigenvalues real, match eigh
+    w = _np(_fn('eigvals')(_arr(s)))
+    onp.testing.assert_allclose(onp.sort(onp.real(w)), w_want, rtol=1e-3)
+    wg = _np(_fn('eig')(_arr(s))[0])
+    onp.testing.assert_allclose(onp.sort(onp.real(wg)), w_want, rtol=1e-3)
+
+
+def test_linalg_svd_solve_pinv_lstsq():
+    s = _spd()
+    u, sv, vt = (_np(o) for o in _fn('svd')(_arr(s)))
+    onp.testing.assert_allclose(
+        onp.sort(sv), onp.sort(onp.linalg.svd(s.astype('float64'))[1]),
+        rtol=1e-3)
+    b = rng(8).standard_normal((3,)).astype('float32')
+    x = _np(_fn('solve')(_arr(s), _arr(b)))
+    onp.testing.assert_allclose(s @ x, b, rtol=1e-3, atol=1e-3)
+    p = _np(_fn('pinv')(_arr(s)))
+    onp.testing.assert_allclose(p, onp.linalg.pinv(s.astype('float64')),
+                                rtol=1e-2, atol=1e-3)
+    sol = _fn('lstsq')(_arr(s), _arr(b.reshape(3, 1)), rcond=None)[0]
+    onp.testing.assert_allclose(_np(sol)[:, 0],
+                                onp.linalg.solve(s.astype('float64'), b),
+                                rtol=1e-2, atol=1e-3)
+    assert int(_np(_fn('matrix_rank')(_arr(s)))) == 3
+    sign, logdet = (_np(o) for o in _fn('slogdet')(_arr(s)))
+    onp.testing.assert_allclose(
+        float(sign) * onp.exp(float(logdet)),
+        onp.linalg.det(s.astype('float64')), rtol=1e-3)
+
+
+def test_linalg_tensor_solve_inv():
+    a = rng(9).standard_normal((2, 2, 2, 2)).astype('float32') + \
+        2 * onp.eye(4).reshape(2, 2, 2, 2).astype('float32')
+    inv = _np(_fn('tensorinv')(_arr(a), ind=2))
+    onp.testing.assert_allclose(
+        inv, onp.linalg.tensorinv(a.astype('float64'), ind=2),
+        rtol=1e-2, atol=1e-3)
+    b = rng(10).standard_normal((2, 2)).astype('float32')
+    x = _np(_fn('tensorsolve')(_arr(a), _arr(b)))
+    onp.testing.assert_allclose(
+        x, onp.linalg.tensorsolve(a.astype('float64'),
+                                  b.astype('float64')),
+        rtol=1e-2, atol=1e-3)
+
+
+def test_legacy_linalg_kernels():
+    """reference src/operator/tensor/la_op.cc family via mx.nd.linalg_*.
+
+    Covers the ledger names: potrf potri gemm gemm2 trmm trsm syrk
+    gelqf syevd sumlogdiag extractdiag makediag.
+    """
+    s = _spd()
+    l = _np(mx.nd.linalg_potrf(mx.nd.array(s)))
+    onp.testing.assert_allclose(l @ l.T, s, rtol=1e-3, atol=1e-3)
+    # potri consumes the Cholesky factor, not A (la_op.cc contract)
+    li = _np(mx.nd.linalg_potri(mx.nd.array(l)))
+    onp.testing.assert_allclose(li, onp.linalg.inv(s.astype('float64')),
+                                rtol=1e-2, atol=1e-2)
+    a = rng(11).standard_normal((2, 3)).astype('float32')
+    b = rng(12).standard_normal((3, 4)).astype('float32')
+    got = _np(mx.nd.linalg_gemm2(mx.nd.array(a), mx.nd.array(b)))
+    onp.testing.assert_allclose(got, a @ b, rtol=1e-4)
+    c = onp.zeros((2, 4), 'float32')
+    got = _np(mx.nd.linalg_gemm(mx.nd.array(a), mx.nd.array(b),
+                                mx.nd.array(c), alpha=2.0))
+    onp.testing.assert_allclose(got, 2 * (a @ b), rtol=1e-4)
+    tri = onp.tril(_spd())
+    y = rng(13).standard_normal((3, 2)).astype('float32')
+    got = _np(mx.nd.linalg_trmm(mx.nd.array(tri), mx.nd.array(y)))
+    onp.testing.assert_allclose(got, tri @ y, rtol=1e-3)
+    got = _np(mx.nd.linalg_trsm(mx.nd.array(tri), mx.nd.array(y)))
+    onp.testing.assert_allclose(tri @ got, y, rtol=1e-2, atol=1e-3)
+    got = _np(mx.nd.linalg_syrk(mx.nd.array(a)))
+    onp.testing.assert_allclose(got, a @ a.T, rtol=1e-4)
+    q, lq = (_np(o) for o in mx.nd.linalg_gelqf(mx.nd.array(a)))
+    onp.testing.assert_allclose(q @ lq if q.shape[0] == 2 else lq @ q,
+                                a, rtol=1e-3, atol=1e-3)
+    w, v = (_np(o) for o in mx.nd.linalg_syevd(mx.nd.array(s)))
+    onp.testing.assert_allclose(
+        onp.sort(w.ravel() if w.ndim > 1 else w),
+        onp.linalg.eigh(s.astype('float64'))[0], rtol=1e-3)
+    d = _np(mx.nd.linalg_sumlogdiag(mx.nd.array(s)))
+    onp.testing.assert_allclose(
+        float(onp.asarray(d).ravel()[0]),
+        onp.log(onp.diag(s)).sum(), rtol=1e-4)
+    ed = _np(mx.nd.linalg_extractdiag(mx.nd.array(s)))
+    onp.testing.assert_allclose(ed, onp.diag(s))
+    md = _np(mx.nd.linalg_makediag(mx.nd.array(onp.array([1.0, 2.0],
+                                                         'float32'))))
+    onp.testing.assert_allclose(md, onp.diag([1.0, 2.0]))
+
+
+# ------------------------------------------------------------ random ops
+_SAMPLERS = {
+    # name -> (kwargs, mean fn, var fn)
+    'exponential': ({'scale': 2.0}, 2.0, 4.0),
+    'gumbel': ({'loc': 0.0, 'scale': 1.0}, 0.5772, 1.6449),
+    'logistic': ({'loc': 0.0, 'scale': 1.0}, 0.0, 3.2899),
+    'rayleigh': ({'scale': 1.0}, 1.2533, 0.4292),
+    'weibull': ({'a': 1.0}, 1.0, 1.0),
+}
+
+
+@pytest.mark.parametrize('name', sorted(_SAMPLERS))
+def test_sampler_moments(name):
+    kwargs, mean, var = _SAMPLERS[name]
+    s = _np(_fn(name)(size=(20000,), **kwargs))
+    assert onp.isfinite(s).all()
+    assert abs(s.mean() - mean) < 6 * (var / 20000) ** 0.5 + 0.05, name
+    assert abs(s.var() - var) / max(var, 1) < 0.25, name
+
+
+def test_negative_binomial_moments():
+    k, p = 5, 0.5
+    s = _np(_fn('negative_binomial')(k=k, p=p, size=(20000,)))
+    want_mean = k * (1 - p) / p
+    assert abs(s.mean() - want_mean) < 0.35
+
+
+# ------------------------------------------------------ optimizer kernels
+def _opt_base():
+    w = onp.array([1.0, -2.0, 3.0], 'float32')
+    g = onp.array([0.5, -0.5, 1.0], 'float32')   # grad of .5*|w|^2-ish
+    return w, g
+
+
+def _assert_descends(new_w, w, g, name):
+    """The update must move each coordinate against the gradient sign."""
+    moved = _np(new_w) - w
+    assert onp.isfinite(_np(new_w)).all(), name
+    assert (onp.sign(moved[g != 0]) == -onp.sign(g[g != 0])).all(), \
+        f'{name}: update moved with the gradient'
+
+
+_ND = mx.nd
+
+
+def _nda(x):
+    return _ND.array(onp.asarray(x, 'float32'))
+
+
+OPT_SINGLE = {
+    'ftrl_update': lambda w, g: _ND.ftrl_update(
+        _nda(w), _nda(g), _nda(onp.zeros_like(w)), _nda(onp.zeros_like(w)),
+        lr=0.1),
+    'rmsprop_update': lambda w, g: _ND.rmsprop_update(
+        _nda(w), _nda(g), _nda(onp.zeros_like(w)), lr=0.1),
+    'rmspropalex_update': lambda w, g: _ND.rmspropalex_update(
+        _nda(w), _nda(g), _nda(onp.zeros_like(w)), _nda(onp.zeros_like(w)),
+        _nda(onp.zeros_like(w)), lr=0.1),
+    'signsgd_update': lambda w, g: _ND.signsgd_update(
+        _nda(w), _nda(g), lr=0.1),
+    'signum_update': lambda w, g: _ND.signum_update(
+        _nda(w), _nda(g), _nda(onp.zeros_like(w)), lr=0.1),
+    'nag_mom_update': lambda w, g: _ND.nag_mom_update(
+        _nda(w), _nda(g), _nda(onp.zeros_like(w)), lr=0.1),
+    'mp_nag_mom_update': lambda w, g: _ND.mp_nag_mom_update(
+        _nda(w), _nda(g), _nda(onp.zeros_like(w)), _nda(w), lr=0.1),
+    'mp_sgd_update': lambda w, g: _ND.mp_sgd_update(
+        _nda(w), _nda(g), _nda(w), lr=0.1),
+    'mp_sgd_mom_update': lambda w, g: _ND.mp_sgd_mom_update(
+        _nda(w), _nda(g), _nda(onp.zeros_like(w)), _nda(w), lr=0.1),
+}
+
+
+@pytest.mark.parametrize('name', sorted(OPT_SINGLE))
+def test_optimizer_update_descends(name):
+    w, g = _opt_base()
+    out = OPT_SINGLE[name](w, g)
+    new_w = out[0] if isinstance(out, (tuple, list)) else out
+    _assert_descends(new_w, w, g, name)
+
+
+def _multi(name, mp=False, n_state=1):
+    w, g = _opt_base()
+    ws = [_nda(w), _nda(w * 0.5)]
+    gs = [_nda(g), _nda(g * 2)]
+    states = [[_nda(onp.zeros_like(w)) for _ in range(n_state)]
+              for _ in ws]
+    w32 = [_nda(w), _nda(w * 0.5)] if mp else []
+    fn = getattr(_ND, name)
+    args = []
+    for i in range(2):
+        args += [ws[i], gs[i]] + states[i] + (w32[i:i + 1] if mp else [])
+    out = fn(*args, lrs=[0.1, 0.1], wds=[0.0, 0.0], num_weights=2)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    _assert_descends(outs[0], w, g, name)
+
+
+@pytest.mark.parametrize('name,mp,ns', [
+    ('multi_sgd_update', False, 0),
+    ('multi_sgd_mom_update', False, 1),
+    ('multi_mp_sgd_update', True, 0),
+    ('multi_mp_sgd_mom_update', True, 1),
+])
+def test_multi_optimizer_updates(name, mp, ns):
+    _multi(name, mp=mp, n_state=ns)
+
+
+@pytest.mark.parametrize('name,mp,ns', [
+    ('preloaded_multi_sgd_update', False, 0),
+    ('preloaded_multi_sgd_mom_update', False, 1),
+    ('preloaded_multi_mp_sgd_update', True, 0),
+    ('preloaded_multi_mp_sgd_mom_update', True, 1),
+])
+def test_preloaded_multi_updates(name, mp, ns):
+    w, g = _opt_base()
+    ws = [_nda(w), _nda(w * 0.5)]
+    gs = [_nda(g), _nda(g * 2)]
+    states = [[_nda(onp.zeros_like(w)) for _ in range(ns)] for _ in ws]
+    w32 = [_nda(w), _nda(w * 0.5)] if mp else []
+    args = []
+    for i in range(2):
+        args += [ws[i], gs[i]] + states[i] + (w32[i:i + 1] if mp else [])
+    args += [_nda([0.1, 0.1]), _nda([0.0, 0.0])]   # preloaded lrs/wds
+    out = getattr(_ND, name)(*args, num_weights=2)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    _assert_descends(outs[0], w, g, name)
+
+
+@pytest.mark.parametrize('name', ['mp_adamw_update', 'multi_mp_adamw_update',
+                                  'multi_mp_lamb_update',
+                                  'multi_mp_lans_update'])
+def test_mp_adamw_lamb_lans_finite(name):
+    w, g = _opt_base()
+    fn = getattr(_ND, name)
+    if name == 'mp_adamw_update':
+        out = fn(_nda(w), _nda(g), _nda(onp.zeros_like(w)),
+                 _nda(onp.zeros_like(w)), _nda(w), lr=0.1, eta=1.0,
+                 rescale_grad=1.0)
+    else:
+        args = []
+        for wi in (w, w * 0.5):
+            args += [_nda(wi), _nda(g), _nda(onp.zeros_like(w)),
+                     _nda(onp.zeros_like(w)), _nda(wi)]
+        kw = dict(num_tensors=2, learning_rates=[0.1, 0.1],
+                  wds=[0.0, 0.0])
+        if 'adamw' in name:
+            kw['etas'] = [1.0, 1.0]
+        else:
+            kw['step_count'] = [1, 1]     # lamb/lans bias correction
+        out = fn(*args, **kw)
+    first = out[0] if isinstance(out, (tuple, list)) else out
+    assert onp.isfinite(_np(first)).all(), name
+
+
+def test_lamb_phases_move_weights():
+    w, g = _opt_base()
+    p1 = _ND.mp_lamb_update_phase1(
+        _nda(w), _nda(g), _nda(onp.zeros_like(w)),
+        _nda(onp.zeros_like(w)), _nda(w), t=1, beta1=0.9, beta2=0.999,
+        wd=0.0)
+    g1 = p1[0] if isinstance(p1, (tuple, list)) else p1
+    out = _ND.mp_lamb_update_phase2(
+        _nda(w), g1, _nda([float(onp.linalg.norm(w))]),
+        _nda([float(onp.linalg.norm(_np(g1)))]), _nda(w), lr=0.1)
+    new_w = out[0] if isinstance(out, (tuple, list)) else out
+    assert onp.isfinite(_np(new_w)).all()
+    assert not onp.allclose(_np(new_w), w)
+
+
+# ------------------------------------------------------------- nn extras
+def test_batch_norm_train_stats():
+    x = rng(14).standard_normal((8, 4)).astype('float32') * 3 + 1
+    out, mean, var = mx.npx.batch_norm_train(
+        _arr(x), _arr(onp.ones(4, 'float32')),
+        _arr(onp.zeros(4, 'float32')), axis=1, eps=1e-5, fix_gamma=False)
+    _assert_close(mean, x.mean(0), rtol=1e-4, atol=1e-4)
+    o = _np(out)
+    onp.testing.assert_allclose(o.mean(0), onp.zeros(4), atol=1e-5)
+    onp.testing.assert_allclose(o.std(0), onp.ones(4), atol=1e-2)
+    # fused relu variant (running-stats form) clips at zero
+    out2 = mx.npx.batch_norm_with_relu(
+        _arr(x), _arr(onp.ones(4, 'float32')),
+        _arr(onp.zeros(4, 'float32')),
+        _arr(x.mean(0)), _arr(x.var(0)), axis=1, eps=1e-5)
+    first = out2[0] if isinstance(out2, (tuple, list)) else out2
+    assert (_np(first) >= 0).all()
+
+
+def test_deconvolution_shape_and_values():
+    x = onp.ones((1, 1, 2, 2), 'float32')
+    w = onp.ones((1, 1, 3, 3), 'float32')
+    out = mx.npx.deconvolution(_arr(x), _arr(w), kernel=(3, 3),
+                               stride=(2, 2), num_filter=1, no_bias=True)
+    assert _np(out).shape == (1, 1, 5, 5)
+    assert float(_np(out).sum()) == pytest.approx(4 * 9, rel=1e-5)
+
+
+def test_upsampling_nearest():
+    x = onp.arange(4, dtype='float32').reshape(1, 1, 2, 2)
+    out = _np(mx.npx.upsampling(_arr(x), scale=2, sample_type='nearest'))
+    assert out.shape == (1, 1, 4, 4)
+    onp.testing.assert_allclose(out[0, 0],
+                                onp.repeat(onp.repeat(x[0, 0], 2, 0), 2, 1))
+
+
+def test_adaptive_avg_pool_and_bilinear_resize():
+    x = rng(15).standard_normal((1, 2, 4, 4)).astype('float32')
+    out = _np(mx.nd.contrib_AdaptiveAvgPooling2D(mx.nd.array(x),
+                                                 output_size=2))
+    onp.testing.assert_allclose(
+        out[0, 0, 0, 0], x[0, 0, :2, :2].mean(), rtol=1e-5)
+    out2 = _np(mx.nd.contrib_BilinearResize2D(mx.nd.array(x), height=8,
+                                              width=8))
+    assert out2.shape == (1, 2, 8, 8)
+    assert onp.isfinite(out2).all()
+
+
+def test_interleaved_matmul_encdec():
+    """reference src/operator/contrib/transformer.cc:650 encdec qk/valatt."""
+    qlen, klen, b, h, d = 3, 4, 2, 2, 5
+    q = rng(16).standard_normal((qlen, b, h * d)).astype('float32')
+    kv = rng(17).standard_normal((klen, b, h * 2 * d)).astype('float32')
+    att = _np(mx.nd.interleaved_matmul_encdec_qk(
+        mx.nd.array(q), mx.nd.array(kv), heads=h))
+    assert att.shape == (b * h, qlen, klen)
+    w = onp.abs(rng(18).standard_normal((b * h, qlen, klen))
+                ).astype('float32')
+    w /= w.sum(-1, keepdims=True)
+    out = _np(mx.nd.interleaved_matmul_encdec_valatt(
+        mx.nd.array(kv), mx.nd.array(w), heads=h))
+    assert out.shape == (qlen, b, h * d)
+    assert onp.isfinite(out).all()
+
+
+def test_getitem_setitem_numeric():
+    """Covers the ledger names: __getitem__ __setitem__ (the advanced
+    indexing ops resolve to the python protocol)."""
+    x = rng(19).standard_normal((4, 5)).astype('float32')
+    m = _arr(x)
+    onp.testing.assert_allclose(_np(m[1:3, ::2]), x[1:3, ::2])
+    onp.testing.assert_allclose(_np(m[onp.array([0, 2])]), x[[0, 2]])
+    m[0, :] = 7.0
+    x[0, :] = 7.0
+    onp.testing.assert_allclose(_np(m), x)
