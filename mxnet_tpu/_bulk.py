@@ -244,16 +244,22 @@ class _Segment:
             ref = LazyRef(self, (ei, oi), aval)
             entry.out_refs.append(weakref.ref(ref))
             refs.append(ref)
-        return refs, child.multi
+        ags = self._make_ags(refs) if grad_active else [None] * len(refs)
+        return refs, child.multi, ags
 
-    def note_ag(self, key, ag):
-        """Register a provisional AGInfo for a segment output; the flush
-        patches its index. Returns the (shared) segment TapeNode."""
+    def _make_ags(self, refs):
+        """Create provisional AGInfos for just-recorded outputs. Called
+        under the segment lock (from add), so a concurrent flush cannot
+        snapshot agrefs between recording and attachment."""
         if self.tape_node is None:
             self.tape_node = _tape.TapeNode(None, [], [], 0,
                                             'bulk_segment', multi=True)
-        self.agrefs.append((key, weakref.ref(ag)))
-        return self.tape_node
+        ags = []
+        for ref in refs:
+            ag = _tape.AGInfo(node=self.tape_node, index=0)
+            self.agrefs.append((ref.key, weakref.ref(ag)))
+            ags.append(ag)
+        return ags
 
     # --------------------------------------------------------------- flushing
     def flush(self):
@@ -455,10 +461,11 @@ def materialize(ref):
 
 # ------------------------------------------------------------ dispatch hook
 def try_record(op, arrays, fn, bulk_key, grad_active):
-    """Offer an op to the bulking engine. Returns ``(refs, multi)`` — the
-    output LazyRefs (caller wraps them and registers AGInfos via
-    register_ag, then calls cap_check) and the tuple-return flag — or
-    None (caller dispatches eagerly)."""
+    """Offer an op to the bulking engine. Returns ``(refs, multi, ags)``
+    — the output LazyRefs (caller wraps them, assigns the provisional
+    AGInfos, then calls cap_check) — or None (caller dispatches
+    eagerly). AGInfo creation happens inside the segment lock so a
+    concurrent flush can never miss them."""
     if not active():
         return None
     for nd in arrays:
@@ -485,11 +492,6 @@ def try_record(op, arrays, fn, bulk_key, grad_active):
                 _st.segment = None
                 continue
             return seg.add(op, arrays, fn, bulk_key, grad_active)
-
-
-def register_ag(ref, ag):
-    """Attach a provisional AGInfo to a just-recorded output."""
-    return ref.seg.note_ag(ref.key, ag)
 
 
 def cap_check():

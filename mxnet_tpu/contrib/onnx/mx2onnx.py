@@ -55,6 +55,9 @@ def _attr(name, value):
         else:
             a.type = _pb.AttributeProto.INTS
             a.ints.extend(int(v) for v in value)
+    elif isinstance(value, _pb.TensorProto):
+        a.type = _pb.AttributeProto.TENSOR
+        a.t.CopyFrom(value)
     else:
         raise TypeError(f'unsupported attr {name}={value!r}')
     return a
@@ -315,6 +318,538 @@ def _reduce(b, node, ins, out):
             b.add('ReduceSum', [ins[0], ax], [out], keepdims=keep)
 
 
+# -------------------------------------------------- round-3 converter batch
+# Closes the gap to the reference's 103 @mx_op.register converters
+# (python/mxnet/contrib/onnx/mx2onnx/_op_translations.py) and goes beyond
+# it with detection (NMS/box) export, which the reference never had.
+
+for _mx, _ox in [('sin', 'Sin'), ('cos', 'Cos'), ('tan', 'Tan'),
+                 ('arcsin', 'Asin'), ('arccos', 'Acos'),
+                 ('arctan', 'Atan'), ('reciprocal', 'Reciprocal'),
+                 ('sign', 'Sign'), ('round', 'Round'), ('isnan', 'IsNaN')]:
+    @_converts(_mx)
+    def _un2(b, node, ins, out, _ox=_ox):
+        b.add(_ox, [ins[0]], [out])
+
+
+@_converts('square')
+def _square(b, node, ins, out):
+    b.add('Mul', [ins[0], ins[0]], [out])
+
+
+@_converts('cast', 'astype')
+def _cast(b, node, ins, out):
+    dt = str(node.kwargs.get('dtype', 'float32'))
+    b.add('Cast', [ins[0]], [out], to=_DTYPE[dt])
+
+
+@_converts('rsqrt')
+def _rsqrt(b, node, ins, out):
+    s = b.add('Sqrt', [ins[0]], [b.uname('sq')])
+    b.add('Reciprocal', [s], [out])
+
+
+@_converts('hard_sigmoid')
+def _hard_sigmoid(b, node, ins, out):
+    kw = node.kwargs
+    b.add('HardSigmoid', [ins[0]], [out],
+          alpha=float(kw.get('alpha', 0.2)),
+          beta=float(kw.get('beta', 0.5)))
+
+
+@_converts('leaky_relu')
+def _leaky(b, node, ins, out):
+    kw = node.kwargs
+    act = kw.get('act_type', 'leaky')
+    if act == 'leaky':
+        b.add('LeakyRelu', [ins[0]], [out],
+              alpha=float(kw.get('slope', 0.25)))
+    elif act == 'elu':
+        b.add('Elu', [ins[0]], [out], alpha=float(kw.get('slope', 0.25)))
+    elif act == 'selu':
+        b.add('Selu', [ins[0]], [out])
+    elif act == 'prelu':
+        b.add('PRelu', [ins[0], ins[1]], [out])
+    else:
+        raise NotImplementedError(f'leaky_relu act_type {act}')
+
+
+@_converts('instance_norm')
+def _instance_norm(b, node, ins, out):
+    b.add('InstanceNormalization', ins[:3], [out],
+          epsilon=float(node.kwargs.get('eps', 1e-5)))
+
+
+@_converts('lrn')
+def _lrn(b, node, ins, out):
+    kw = node.kwargs
+    b.add('LRN', [ins[0]], [out], size=int(kw.get('nsize', 5)),
+          alpha=float(kw.get('alpha', 1e-4)),
+          beta=float(kw.get('beta', 0.75)),
+          bias=float(kw.get('knorm', 2.0)))
+
+
+@_converts('l2_normalization')
+def _l2norm(b, node, ins, out):
+    # channel mode == LpNormalization(axis=1, p=2); instance mode is the
+    # all-but-batch reduction, composed explicitly
+    mode = node.kwargs.get('mode', 'instance')
+    if mode == 'channel':
+        b.add('LpNormalization', [ins[0]], [out], axis=1, p=2)
+        return
+    sq = b.add('Mul', [ins[0], ins[0]], [b.uname('sq')])
+    shape = b.shapes.get((node.uid, 0))
+    if shape is None:
+        raise NotImplementedError(
+            'l2_normalization instance-mode export needs input_shapes')
+    ax = b.const('axes',
+                 _np.asarray(list(range(1, len(shape))), _np.int64))
+    ss = b.add('ReduceSum', [sq, ax], [b.uname('ss')], keepdims=1)
+    eps = b.const('eps', _np.float32(node.kwargs.get('eps', 1e-10)))
+    se = b.add('Add', [ss, eps], [b.uname('se')])
+    rt = b.add('Sqrt', [se], [b.uname('rt')])
+    b.add('Div', [ins[0], rt], [out])
+
+
+@_converts('pad')
+def _pad(b, node, ins, out):
+    kw = node.kwargs
+    pw = kw.get('pad_width')
+    # mxnet pad_width: (before0, after0, before1, after1, ...) ->
+    # onnx pads: all befores then all afters
+    befores = list(pw[0::2])
+    afters = list(pw[1::2])
+    pads = b.const('pads', _np.asarray(befores + afters, _np.int64))
+    mode = {'constant': 'constant', 'edge': 'edge',
+            'reflect': 'reflect'}[kw.get('mode', 'constant')]
+    extra = []
+    if mode == 'constant':
+        extra = [b.const('pval',
+                         _np.float32(kw.get('constant_value', 0.0)))]
+    b.add('Pad', [ins[0], pads] + extra, [out], mode=mode)
+
+
+@_converts('tile')
+def _tile(b, node, ins, out):
+    reps = node.kwargs.get('reps') or node.kwargs.get('repeats')
+    if reps is None and node.args_spec and len(node.args_spec) > 1:
+        reps = node.args_spec[1]        # positional reps
+    if isinstance(reps, int):
+        reps = (reps,)
+    r = b.const('reps', _np.asarray(list(reps), _np.int64))
+    b.add('Tile', [ins[0], r], [out])
+
+
+def _flattened(b, name):
+    shp = b.const('flat', _np.asarray([-1], _np.int64))
+    return b.add('Reshape', [name, shp], [b.uname('flatv')])
+
+
+@_converts('take')
+def _take(b, node, ins, out):
+    axis = node.kwargs.get('axis', 0)
+    data = ins[0]
+    if axis is None:
+        # numpy semantics: axis=None gathers from the flattened array
+        data = _flattened(b, data)
+        axis = 0
+    b.add('Gather', [data] + ins[1:2], [out], axis=int(axis))
+
+
+@_converts('topk')
+def _topk(b, node, ins, out):
+    kw = node.kwargs
+    k = b.const('k', _np.asarray([int(kw.get('k', 1))], _np.int64))
+    axis = int(kw.get('axis', -1))
+    ret = kw.get('ret_typ', 'indices')
+    vals = b.uname('topk_v')
+    idxs = b.uname('topk_i')
+    b.add('TopK', [ins[0], k], [vals, idxs], axis=axis,
+          largest=0 if kw.get('is_ascend') else 1)
+    outs = out if isinstance(out, list) else [out]
+    if ret == 'value':
+        b.add('Identity', [vals], [outs[0]])
+    elif ret == 'both':
+        b.add('Identity', [vals], [outs[0]])
+        b.add('Cast', [idxs], [outs[1]], to=_DTYPE['float32'])
+    else:
+        b.add('Cast', [idxs], [outs[0]], to=_DTYPE['float32'])
+
+
+def _arg_reduce(onnx_op):
+    def conv(b, node, ins, out):
+        axis = node.kwargs.get('axis')
+        data = ins[0]
+        if axis is None:
+            # numpy semantics: axis=None reduces the flattened array
+            data = _flattened(b, data)
+            axis = 0
+        a = b.add(onnx_op, [data], [b.uname('am')], axis=int(axis),
+                  keepdims=int(bool(node.kwargs.get('keepdims', False))))
+        b.add('Cast', [a], [out], to=_DTYPE['float32'])
+    return conv
+
+
+_converts('argmax')(_arg_reduce('ArgMax'))
+_converts('argmin')(_arg_reduce('ArgMin'))
+
+
+def _reduce_generic(onnx_op):
+    def conv(b, node, ins, out):
+        kw = node.kwargs
+        axis = kw.get('axis')
+        if isinstance(axis, int):
+            axis = (axis,)
+        keep = int(bool(kw.get('keepdims', False)))
+        b.add(onnx_op, [ins[0]], [out],
+              axes=list(axis) if axis is not None else None,
+              keepdims=keep)
+    return conv
+
+
+_converts('prod')(_reduce_generic('ReduceProd'))
+_converts('amax', 'max')(_reduce_generic('ReduceMax'))
+_converts('amin', 'min')(_reduce_generic('ReduceMin'))
+
+
+@_converts('norm', 'linalg_norm')
+def _norm(b, node, ins, out):
+    kw = node.kwargs
+    ord_ = kw.get('ord', 2)
+    axis = kw.get('axis')
+    if isinstance(axis, int):
+        axis = (axis,)
+    op = 'ReduceL2' if ord_ in (2, None) else 'ReduceL1'
+    b.add(op, [ins[0]], [out],
+          axes=list(axis) if axis is not None else None,
+          keepdims=int(bool(kw.get('keepdims', False))))
+
+
+@_converts('broadcast_to')
+def _broadcast_to(b, node, ins, out):
+    shape = node.kwargs.get('shape') or node.kwargs.get('size')
+    s = b.const('shape', _np.asarray(list(shape), _np.int64))
+    b.add('Expand', [ins[0], s], [out])
+
+
+@_converts('slice_axis')
+def _slice_axis(b, node, ins, out):
+    kw = node.kwargs
+    axis = int(kw['axis'])
+    end = kw.get('end')
+    if end is None:
+        end = 2 ** 31 - 1
+    b.add('Slice', [ins[0],
+                    b.const('st', _np.asarray([kw.get('begin', 0)],
+                                              _np.int64)),
+                    b.const('en', _np.asarray([end], _np.int64)),
+                    b.const('ax', _np.asarray([axis], _np.int64))], [out])
+
+
+@_converts('shape_array')
+def _shape_array(b, node, ins, out):
+    s = b.add('Shape', [ins[0]], [b.uname('sh')])
+    b.add('Cast', [s], [out], to=_DTYPE['int64'])
+
+
+@_converts('size_array')
+def _size_array(b, node, ins, out):
+    s = b.add('Size', [ins[0]], [b.uname('sz')])
+    b.add('Cast', [s], [out], to=_DTYPE['int64'])
+
+
+@_converts('depth_to_space')
+def _d2s(b, node, ins, out):
+    b.add('DepthToSpace', [ins[0]], [out],
+          blocksize=int(node.kwargs['block_size']), mode='DCR')
+
+
+@_converts('space_to_depth')
+def _s2d(b, node, ins, out):
+    b.add('SpaceToDepth', [ins[0]], [out],
+          blocksize=int(node.kwargs['block_size']))
+
+
+for _mx, _ox in [('equal', 'Equal'), ('greater', 'Greater'),
+                 ('less', 'Less')]:
+    @_converts(_mx)
+    def _cmp(b, node, ins, out, _ox=_ox):
+        b.add(_ox, ins[:2], [out])
+
+
+@_converts('logical_not')
+def _lnot(b, node, ins, out):
+    x = b.add('Cast', [ins[0]], [b.uname('b')], to=_DTYPE['bool'])
+    n = b.add('Not', [x], [b.uname('n')])
+    b.add('Cast', [n], [out], to=_DTYPE['bool'])
+
+
+for _mx, _ox in [('logical_and', 'And'), ('logical_or', 'Or'),
+                 ('logical_xor', 'Xor')]:
+    @_converts(_mx)
+    def _lbin(b, node, ins, out, _ox=_ox):
+        a = b.add('Cast', [ins[0]], [b.uname('a')], to=_DTYPE['bool'])
+        c = b.add('Cast', [ins[1]], [b.uname('c')], to=_DTYPE['bool'])
+        b.add(_ox, [a, c], [out])
+
+
+@_converts('add_n')
+def _add_n(b, node, ins, out):
+    b.add('Sum', list(ins), [out])
+
+
+@_converts('stack')
+def _stack(b, node, ins, out):
+    axis = int(node.kwargs.get('axis', 0))
+    ups = []
+    ax = b.const('uax', _np.asarray([axis], _np.int64))
+    for i, name in enumerate(ins):
+        ups.append(b.add('Unsqueeze', [name, ax], [b.uname('us')]))
+    b.add('Concat', ups, [out], axis=axis)
+
+
+@_converts('where')
+def _where(b, node, ins, out):
+    c = b.add('Cast', [ins[0]], [b.uname('cond')], to=_DTYPE['bool'])
+    b.add('Where', [c, ins[1], ins[2]], [out])
+
+
+@_converts('normal', 'random_normal')
+def _rand_normal(b, node, ins, out):
+    kw = node.kwargs
+    shape = kw.get('size') or kw.get('shape')
+    b.add('RandomNormal', [], [out], shape=list(shape),
+          mean=float(kw.get('loc', kw.get('mean', 0.0)) or 0.0),
+          scale=float(kw.get('scale', kw.get('std', 1.0)) or 1.0))
+
+
+@_converts('uniform', 'random_uniform')
+def _rand_uniform(b, node, ins, out):
+    kw = node.kwargs
+    shape = kw.get('size') or kw.get('shape')
+    b.add('RandomUniform', [], [out], shape=list(shape),
+          low=float(kw.get('low', 0.0) or 0.0),
+          high=float(kw.get('high', 1.0) or 1.0))
+
+
+@_converts('multinomial', 'sample_multinomial')
+def _multinomial(b, node, ins, out):
+    kw = node.kwargs
+    b.add('Multinomial', [ins[0]], [out],
+          sample_size=int(kw.get('shape', kw.get('size', 1)) or 1))
+
+
+@_converts('roi_pooling')
+def _roi_pooling(b, node, ins, out):
+    kw = node.kwargs
+    b.add('MaxRoiPool', ins[:2], [out],
+          pooled_shape=list(kw['pooled_size']),
+          spatial_scale=float(kw.get('spatial_scale', 1.0)))
+
+
+@_converts('roi_align')
+def _roi_align(b, node, ins, out):
+    kw = node.kwargs
+    # mxnet rois are (N, 5) [batch_idx, x1, y1, x2, y2]; onnx wants
+    # rois (N, 4) + batch_indices (N,)
+    bi = b.add('Slice', [ins[1],
+                         b.const('s0', _np.asarray([0], _np.int64)),
+                         b.const('s1', _np.asarray([1], _np.int64)),
+                         b.const('sa', _np.asarray([1], _np.int64))],
+               [b.uname('bi5')])
+    bi = b.add('Squeeze', [bi, b.const('sq', _np.asarray([1], _np.int64))],
+               [b.uname('bis')])
+    bi = b.add('Cast', [bi], [b.uname('bii')], to=_DTYPE['int64'])
+    rois = b.add('Slice', [ins[1],
+                           b.const('r0', _np.asarray([1], _np.int64)),
+                           b.const('r1', _np.asarray([5], _np.int64)),
+                           b.const('ra', _np.asarray([1], _np.int64))],
+                 [b.uname('rois4')])
+    ps = kw['pooled_size']
+    b.add('RoiAlign', [ins[0], rois, bi], [out],
+          output_height=int(ps[0]), output_width=int(ps[1]),
+          spatial_scale=float(kw.get('spatial_scale', 1.0)),
+          sampling_ratio=max(int(kw.get('sample_ratio', 0) or 0), 0),
+          coordinate_transformation_mode='output_half_pixel')
+
+
+@_converts(*[f'_creation_{n}' for n in (
+    'zeros', 'ones', 'full', 'arange', 'linspace', 'logspace', 'eye',
+    'tri', 'indices', 'blackman', 'hamming', 'hanning')])
+def _creation(b, node, ins, out):
+    """Creation args are always static — fold to an initializer."""
+    name = node.op[len('_creation_'):]
+    args = [a for a in (node.args_spec or [])
+            if not isinstance(a, dict)]
+    kwargs = {k: v for k, v in (node.kwargs or {}).items()
+              if not isinstance(v, dict)}
+    value = _np.asarray(getattr(_np, name)(*args, **kwargs))
+    if value.dtype == _np.float64:
+        value = value.astype(_np.float32)
+    b.add('Identity', [b.const(node.name, value)], [out])
+
+
+# ------------------------------------------------------ detection export
+def _emit_nms(b, boxes, scores, out_mask, n, overlap, valid_thresh, topk,
+              mask_shape):
+    """Standard-ONNX NMS returning a keep MASK aligned with the (already
+    score-sorted) candidates. boxes: (B,N,4) corner; scores: (B,N)."""
+    sc3 = b.add('Unsqueeze', [scores,
+                              b.const('ax1', _np.asarray([1], _np.int64))],
+                [b.uname('sc3')])                       # (B,1,N)
+    sel = b.add('NonMaxSuppression',
+                [boxes, sc3,
+                 b.const('mob', _np.asarray(
+                     [int(topk) if topk and topk > 0 else int(n)],
+                     _np.int64)),
+                 b.const('iou', _np.asarray([overlap], _np.float32)),
+                 b.const('sth', _np.asarray([valid_thresh], _np.float32))],
+                [b.uname('sel')])                       # (K,3) int64
+    # scatter ones at (batch, box) pairs -> mask of the static scores
+    # shape. The K-length ones vector is derived from the selection
+    # itself (Equal(col0, col0)) so no dynamic ConstantOfShape is needed.
+    idx = b.add('Gather', [sel, b.const('g02', _np.asarray([0, 2],
+                                                          _np.int64))],
+                [b.uname('selbi')], axis=1)             # (K,2)
+    zeros = b.const('zeros', _np.zeros(mask_shape, _np.float32))
+    col0 = b.add('Gather', [sel, b.const('g0', _np.asarray([0],
+                                                          _np.int64))],
+                 [b.uname('col0')], axis=1)             # (K,1)
+    eq = b.add('Equal', [col0, col0], [b.uname('eqk')])
+    onesk = b.add('Cast', [eq], [b.uname('onesk2')],
+                  to=_DTYPE['float32'])
+    ones = b.add('Squeeze', [onesk, b.const('sq1k', _np.asarray(
+        [1], _np.int64))], [b.uname('onesk')])          # (K,)
+    b.add('ScatterND', [zeros, idx, ones], [out_mask])
+
+
+@_converts('box_nms')
+def _box_nms(b, node, ins, out):
+    """mxnet box_nms as standard ONNX (the reference exporter has no
+    detection support at all — this exceeds it). Static-shape contract
+    preserved: output = score-sorted input with suppressed/invalid
+    entries' score set to -1. Class-aware suppression (id_index >= 0,
+    force_suppress=False) uses the per-class coordinate-offset trick so
+    cross-class IoU is exactly 0."""
+    kw = node.kwargs
+    cs = int(kw.get('coord_start', 2))
+    si = int(kw.get('score_index', 1))
+    ii = int(kw.get('id_index', -1))
+    if kw.get('in_format', 'corner') != 'corner':
+        raise NotImplementedError('box_nms export: corner format only')
+    # box_nms preserves shape: the node's own inferred output shape is
+    # the input shape (shape pre-pass keys by (uid, out_idx))
+    shape = b.shapes.get((node.uid, 0))
+    if shape is None:
+        raise NotImplementedError('box_nms export needs input_shapes')
+    n, c = shape[-2], shape[-1]
+    i64 = lambda name, v: b.const(name, _np.asarray(v, _np.int64))
+
+    def col(name, j, width=1):
+        return b.add('Slice', [ins[0] if name == 'data' else name,
+                               i64('cb', [j]), i64('ce', [j + width]),
+                               i64('ca', [-1])], [b.uname('col')])
+
+    scores0 = b.add('Squeeze', [col('data', si), i64('sq1', [-1])],
+                    [b.uname('scores0')])               # (B,N)
+    vals = b.uname('svals')
+    order = b.uname('sorder')
+    b.add('TopK', [scores0, i64('kk', [n])], [vals, order], axis=-1,
+          largest=1)
+    oexp = b.add('Unsqueeze', [order, i64('ua', [-1])], [b.uname('oe')])
+    oexp = b.add('Expand', [oexp, i64('es', list(shape[:-2]) + [n, c])],
+                 [b.uname('oex')])
+    data_s = b.add('GatherElements', [ins[0], oexp], [b.uname('ds')],
+                   axis=-2)                             # sorted rows
+    boxes = b.add('Slice', [data_s, i64('bb', [cs]), i64('be', [cs + 4]),
+                            i64('ba', [-1])], [b.uname('boxes')])
+    if ii >= 0 and not kw.get('force_suppress', False):
+        ids = b.add('Slice', [data_s, i64('ib', [ii]), i64('ie', [ii + 1]),
+                              i64('ia', [-1])], [b.uname('ids')])
+        off = b.add('Mul', [ids, b.const('koff', _np.float32(4096.0))],
+                    [b.uname('idoff')])
+        boxes = b.add('Add', [boxes, off], [b.uname('boxoff')])
+    mask = b.uname('keepmask')
+    _emit_nms(b, boxes, vals, mask, n,
+              float(kw.get('overlap_thresh', 0.5)),
+              float(kw.get('valid_thresh', 0)),
+              int(kw.get('topk', -1)), tuple(shape[:-1]))
+    half = b.const('halfc', _np.float32(0.5))
+    keep = b.add('Greater', [mask, half], [b.uname('keepb')])
+    # suppressed/invalid entries: score exactly -1 (reference contract)
+    negb = b.const('negones', -_np.ones(tuple(shape[:-1]), _np.float32))
+    new_scores = b.add('Where', [keep, vals, negb], [b.uname('nsc')])
+    nsc3 = b.add('Unsqueeze', [new_scores, i64('u2', [-1])],
+                 [b.uname('nsc3')])
+    parts = []
+    if si > 0:
+        parts.append(b.add('Slice', [data_s, i64('p0', [0]),
+                                     i64('p1', [si]), i64('pa', [-1])],
+                           [b.uname('pre')]))
+    parts.append(nsc3)
+    if si + 1 < c:
+        parts.append(b.add('Slice', [data_s, i64('q0', [si + 1]),
+                                     i64('q1', [c]), i64('qa', [-1])],
+                           [b.uname('post')]))
+    b.add('Concat', parts, [out], axis=-1)
+
+
+@_converts('rnn')
+def _rnn_conv(b, node, ins, out):
+    """Fused RNN -> ONNX LSTM/GRU (single-layer, unidirectional; the
+    configurations the ONNX RNN ops map onto 1:1). Gate reorder:
+    cuDNN-canonical [i,f,g,o] -> ONNX [i,o,f,c]; GRU [r,z,n] -> [z,r,h].
+    Weights must be initializers (they always are for exported models)."""
+    kw = node.kwargs
+    mode = kw.get('mode', 'lstm')
+    L = int(kw.get('num_layers', 1))
+    if L != 1 or kw.get('bidirectional'):
+        raise NotImplementedError('rnn export: 1-layer unidirectional')
+    if mode not in ('lstm', 'gru'):
+        raise NotImplementedError(f'rnn export: mode {mode}')
+    pname = node.inputs[1][0].name
+    flat = b.params.get(pname)
+    if flat is None:
+        raise NotImplementedError('rnn export needs parameter initializer')
+    H = int(kw['state_size'])
+    G = 4 if mode == 'lstm' else 3
+    # input width from the flat parameter length:
+    # len = G*H*I + G*H*H + 2*G*H
+    I = (flat.size - G * H * H - 2 * G * H) // (G * H)
+    wi = flat[:G * H * I].reshape(G, H, I)
+    wh = flat[G * H * I:G * H * I + G * H * H].reshape(G, H, H)
+    bi = flat[G * H * (I + H):G * H * (I + H) + G * H].reshape(G, H)
+    bh = flat[G * H * (I + H) + G * H:].reshape(G, H)
+    perm = [0, 3, 1, 2] if mode == 'lstm' else [1, 0, 2]
+    W = b.const('W', wi[perm].reshape(1, G * H, I))
+    R = b.const('R', wh[perm].reshape(1, G * H, H))
+    B = b.const('B', _np.concatenate(
+        [bi[perm].reshape(-1), bh[perm].reshape(-1)]).reshape(1, 2 * G * H))
+    outs = out if isinstance(out, list) else [out]
+    # our state is already (L*dirs, B, H) == ONNX (num_dir, B, H) for L=1
+    onnx_op = 'LSTM' if mode == 'lstm' else 'GRU'
+    y = b.uname('rnn_y')
+    yh = b.uname('rnn_yh')
+    extra_in = [ins[0], W, R, B, '', ins[2]]
+    extra_out = [y, yh]
+    if mode == 'lstm':
+        extra_in.append(ins[3])
+        yc = b.uname('rnn_yc')
+        extra_out.append(yc)
+    kwargs = dict(hidden_size=H)
+    if mode == 'gru':
+        # cuDNN/mxnet GRU: n = tanh(x_n + b_n + r * (h@Whn + bhn))
+        kwargs['linear_before_reset'] = 1
+    b.add(onnx_op, extra_in, extra_out, **kwargs)
+    # ONNX Y: (T, num_dir, B, H) -> (T, B, H)
+    b.add('Squeeze', [y, b.const('sqd', _np.asarray([1], _np.int64))],
+          [outs[0]])
+    if kw.get('state_outputs') and len(outs) > 1:
+        b.add('Identity', [yh], [outs[1]])
+        if mode == 'lstm' and len(outs) > 2:
+            b.add('Identity', [extra_out[2]], [outs[2]])
+
+
 @_converts('gelu')
 def _gelu(b, node, ins, out):
     # Erf-form decomposition keeps opset at 17 (Gelu is opset 20)
@@ -387,6 +922,7 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
               for k, v in params.items()}
 
     b = _Builder()
+    b.params = params                   # converters needing raw weights
     graph = _pb.GraphProto(name=sym.name)
     out_names = {}                      # (node uid, out idx) -> onnx name
 

@@ -239,6 +239,195 @@ def _import_node(imp, node):
                 if len(ins) > 1 else at.get('axes'))
         return _invoke('sum', [S(0)], dict(
             axis=axes, keepdims=bool(at.get('keepdims', 1))))
+    if op == 'TopK':
+        k = int(imp.const(ins[1]).reshape(())) if len(ins) > 1 \
+            else int(at['k'])
+        return _invoke('topk', [S(0)], dict(
+            k=k, axis=at.get('axis', -1), ret_typ='both',
+            is_ascend=not at.get('largest', 1), dtype='int64'))
+    if op in ('ArgMax', 'ArgMin'):
+        name = 'argmax' if op == 'ArgMax' else 'argmin'
+        return _invoke(name, [S(0)], dict(
+            axis=at.get('axis', 0),
+            keepdims=bool(at.get('keepdims', 1))))
+    if op in ('ReduceProd', 'ReduceMax', 'ReduceMin', 'ReduceL2',
+              'ReduceL1'):
+        axes = (tuple(int(v) for v in imp.const(ins[1]))
+                if len(ins) > 1 and ins[1] else at.get('axes'))
+        kd = bool(at.get('keepdims', 1))
+        if op == 'ReduceL2':
+            return _invoke('norm', [S(0)],
+                           dict(ord=2, axis=axes, keepdims=kd))
+        if op == 'ReduceL1':
+            return _invoke('norm', [S(0)],
+                           dict(ord=1, axis=axes, keepdims=kd))
+        name = {'ReduceProd': 'prod', 'ReduceMax': 'max',
+                'ReduceMin': 'min'}[op]
+        return _invoke(name, [S(0)], dict(axis=axes, keepdims=kd))
+    if op == 'Expand':
+        shape = tuple(int(v) for v in imp.const(ins[1]))
+        return _invoke('broadcast_to', [S(0)], dict(shape=shape))
+    if op == 'Tile':
+        reps = tuple(int(v) for v in imp.const(ins[1]))
+        return _invoke('tile', [S(0)], dict(reps=reps))
+    if op == 'Pad':
+        pads = [int(v) for v in imp.const(ins[1])] if len(ins) > 1 \
+            else list(at['pads'])
+        half = len(pads) // 2
+        pw = []
+        for i in range(half):
+            pw += [pads[i], pads[half + i]]
+        cval = 0.0
+        if len(ins) > 2 and ins[2]:
+            cval = float(imp.const(ins[2]).reshape(()))
+        return _invoke('pad', [S(0)], dict(
+            pad_width=tuple(pw), mode=at.get('mode', 'constant'),
+            constant_value=cval))
+    if op == 'HardSigmoid':
+        return _invoke('hard_sigmoid', [S(0)], dict(
+            alpha=at.get('alpha', 0.2), beta=at.get('beta', 0.5)))
+    if op == 'LeakyRelu':
+        return _invoke('leaky_relu', [S(0)], dict(
+            act_type='leaky', slope=at.get('alpha', 0.01)))
+    if op == 'Elu':
+        return _invoke('leaky_relu', [S(0)], dict(
+            act_type='elu', slope=at.get('alpha', 1.0)))
+    if op == 'Selu':
+        return _invoke('leaky_relu', [S(0)], dict(act_type='selu'))
+    if op == 'PRelu':
+        return _invoke('leaky_relu', [S(0), S(1)],
+                       dict(act_type='prelu'))
+    if op == 'InstanceNormalization':
+        return _invoke('instance_norm', [S(0), S(1), S(2)],
+                       dict(eps=at.get('epsilon', 1e-5)))
+    if op == 'LRN':
+        return _invoke('lrn', [S(0)], dict(
+            nsize=at['size'], alpha=at.get('alpha', 1e-4),
+            beta=at.get('beta', 0.75), knorm=at.get('bias', 1.0)))
+    if op == 'LpNormalization':
+        if at.get('p', 2) != 2 or at.get('axis', -1) not in (1,):
+            raise NotImplementedError(
+                'LpNormalization import supports p=2, axis=1 '
+                f'(got p={at.get("p", 2)}, axis={at.get("axis", -1)})')
+        return _invoke('l2_normalization', [S(0)], dict(mode='channel'))
+    if op == 'Sum':
+        out_s = S(0)
+        for i in range(1, len(ins)):
+            out_s = _invoke('add', [out_s, S(i)], {})
+        return out_s
+    if op in ('Greater', 'Less', 'Equal'):
+        return _invoke(op.lower(), [S(0), S(1)], {})
+    if op == 'Not':
+        return _invoke('logical_not', [S(0)], {})
+    if op in ('And', 'Or', 'Xor'):
+        return _invoke('logical_' + ('xor' if op == 'Xor'
+                                     else op.lower()), [S(0), S(1)], {})
+    if op == 'Shape':
+        return _invoke('shape_array', [S(0)], {})
+    if op == 'Size':
+        return _invoke('size_array', [S(0)], {})
+    if op == 'DepthToSpace':
+        return _invoke('depth_to_space', [S(0)],
+                       dict(block_size=at['blocksize']))
+    if op == 'SpaceToDepth':
+        return _invoke('space_to_depth', [S(0)],
+                       dict(block_size=at['blocksize']))
+    if op == 'RandomNormal':
+        return _invoke('normal', [], dict(
+            loc=at.get('mean', 0.0), scale=at.get('scale', 1.0),
+            size=tuple(at['shape'])))
+    if op == 'RandomUniform':
+        return _invoke('uniform', [], dict(
+            low=at.get('low', 0.0), high=at.get('high', 1.0),
+            size=tuple(at['shape'])))
+    if op == 'Multinomial':
+        return _invoke('multinomial', [S(0)],
+                       dict(shape=at.get('sample_size', 1)))
+    if op == 'MaxRoiPool':
+        return _invoke('roi_pooling', [S(0), S(1)], dict(
+            pooled_size=tuple(at['pooled_shape']),
+            spatial_scale=at.get('spatial_scale', 1.0)))
+    if op == 'RoiAlign':
+        # rebuild mxnet (N,5) rois from rois (N,4) + batch_indices (N,)
+        bi = _invoke('cast', [_invoke('expand_dims', [S(2)],
+                                      dict(axis=1))],
+                     dict(dtype='float32'))
+        rois5 = _invoke('concatenate', [[bi, S(1)]], dict(axis=1))
+        return _invoke('roi_align', [S(0), rois5], dict(
+            pooled_size=(at['output_height'], at['output_width']),
+            spatial_scale=at.get('spatial_scale', 1.0),
+            sample_ratio=at.get('sampling_ratio', 0)))
+    if op == 'GatherElements':
+        return _invoke('take_along_axis',
+                       [S(0), _invoke('cast', [S(1)],
+                                      dict(dtype='int32')),
+                        at.get('axis', 0)], {})
+    if op == 'ConstantOfShape':
+        shape = tuple(int(v) for v in imp.const(ins[0]))
+        val = at.get('value')
+        fill = float(val.reshape(-1)[0]) if val is not None else 0.0
+        dtype = str(val.dtype) if val is not None else 'float32'
+        return _invoke('full', [shape, fill], dict(dtype=dtype))
+    if op == 'ScatterND':
+        # our index_update takes dims-first indices
+        idxT = _invoke('transpose', [S(1)], dict(axes=(1, 0)))
+        return _invoke('index_update', [S(0), idxT, S(2)], {})
+    if op == 'NonMaxSuppression':
+        kwargs = {}
+        if len(ins) > 2 and ins[2]:
+            kwargs['max_output_boxes_per_class'] = \
+                int(imp.const(ins[2]).reshape(()))
+        if len(ins) > 3 and ins[3]:
+            kwargs['iou_threshold'] = \
+                float(imp.const(ins[3]).reshape(()))
+        if len(ins) > 4 and ins[4]:
+            kwargs['score_threshold'] = \
+                float(imp.const(ins[4]).reshape(()))
+        return _invoke('onnx_nms', [S(0), S(1)], kwargs)
+    if op in ('LSTM', 'GRU'):
+        # inverse of the exporter's gate reorder (ONNX [i,o,f,c] ->
+        # cuDNN [i,f,g,o]; ONNX [z,r,h] -> cuDNN [r,z,n])
+        mode = op.lower()
+        if at.get('direction', 'forward') != 'forward':
+            raise NotImplementedError(
+                f'{op} import: forward direction only')
+        if op == 'GRU' and not at.get('linear_before_reset', 0):
+            raise NotImplementedError(
+                'GRU import: linear_before_reset=0 recurrence is not '
+                'representable by the cuDNN-formulation rnn op')
+        n_req = 7 if mode == 'lstm' else 6
+        req_idx = [0, 1, 2, 3, 5] + ([6] if mode == 'lstm' else [])
+        if len(ins) < n_req or any(not ins[i] for i in req_idx):
+            raise NotImplementedError(
+                f'{op} import needs W, R, B and initial state inputs '
+                '(sequence_lens may be empty)')
+        H = int(at['hidden_size'])
+        G = 4 if mode == 'lstm' else 3
+        W = imp.const(ins[1])
+        if W.shape[0] != 1:
+            raise NotImplementedError(
+                f'{op} import: num_directions must be 1, got '
+                f'{W.shape[0]}')
+        W = W.reshape(G, H, -1)
+        R = imp.const(ins[2]).reshape(G, H, H)
+        B = imp.const(ins[3]).reshape(2, G, H)
+        inv = [0, 2, 3, 1] if mode == 'lstm' else [1, 0, 2]
+        flat = _np.concatenate([
+            W[inv].reshape(-1), R[inv].reshape(-1),
+            B[0][inv].reshape(-1), B[1][inv].reshape(-1)])
+        pname = node.output[0] + '_params'
+        imp.env[pname] = flat.astype(_np.float32)
+        imp.consts[pname] = flat.astype(_np.float32)
+        args = [S(0), imp.sym(pname), imp.sym(ins[5])]
+        kwargs = dict(mode=mode, state_size=H, num_layers=1,
+                      state_outputs=True)
+        if mode == 'lstm':
+            args.append(imp.sym(ins[6]))
+        rnn_out = _invoke('rnn', args, kwargs)
+        outs = list(rnn_out)
+        # ONNX Y adds the num_directions axis
+        y = _invoke('expand_dims', [outs[0]], dict(axis=1))
+        return [y] + outs[1:]
     binary = {'Add': 'add', 'Sub': 'subtract', 'Mul': 'multiply',
               'Div': 'true_divide', 'Pow': 'power', 'Max': 'maximum',
               'Min': 'minimum'}
@@ -247,7 +436,10 @@ def _import_node(imp, node):
     unary = {'Relu': 'relu', 'Sigmoid': 'sigmoid', 'Tanh': 'tanh',
              'Exp': 'exp', 'Log': 'log', 'Sqrt': 'sqrt', 'Abs': 'abs',
              'Neg': 'negative', 'Erf': 'erf', 'Floor': 'floor',
-             'Ceil': 'ceil'}
+             'Ceil': 'ceil', 'Sin': 'sin', 'Cos': 'cos', 'Tan': 'tan',
+             'Asin': 'arcsin', 'Acos': 'arccos', 'Atan': 'arctan',
+             'Reciprocal': 'reciprocal', 'Sign': 'sign',
+             'Round': 'round', 'IsNaN': 'isnan'}
     if op in unary:
         return _invoke(unary[op], [S(0)], {})
     raise NotImplementedError(f'no import converter for ONNX op {op!r}')

@@ -925,3 +925,54 @@ def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
     comp = (max_time[:, None] * mu
             + alpha[None, :] * (counts + state - r_T)).sum(-1)
     return ll - comp, r_T
+
+
+@register('onnx_nms', differentiable=False, dynamic_shape=True)
+def onnx_nms(boxes, scores, max_output_boxes_per_class=0,
+             iou_threshold=0.0, score_threshold=None):
+    """ONNX ``NonMaxSuppression`` semantics (opset 10+): greedy per-class
+    NMS returning selected (batch, class, box) index triples, dynamic
+    output count — executes eagerly (the importer's round-trip path for
+    exported box_nms graphs). IoU is corner-order invariant, so corner
+    boxes work directly."""
+    import numpy as onp
+    b = onp.asarray(boxes, 'float32')          # (B, N, 4)
+    s = onp.asarray(scores, 'float32')         # (B, C, N)
+    max_out = int(onp.asarray(max_output_boxes_per_class).reshape(()))
+    if max_out == 0:
+        # spec: max_output_boxes_per_class defaults to 0 = NO output
+        return jnp.zeros((0, 3), jnp.int64)
+    iou_t = float(onp.asarray(iou_threshold).reshape(()))
+    sc_t = None if score_threshold is None else \
+        float(onp.asarray(score_threshold).reshape(()))
+    sel = []
+    x1 = onp.minimum(b[..., 0], b[..., 2])
+    y1 = onp.minimum(b[..., 1], b[..., 3])
+    x2 = onp.maximum(b[..., 0], b[..., 2])
+    y2 = onp.maximum(b[..., 1], b[..., 3])
+    area = (x2 - x1) * (y2 - y1)
+    for bi in range(s.shape[0]):
+        for ci in range(s.shape[1]):
+            order = onp.argsort(-s[bi, ci], kind='stable')
+            if sc_t is not None:
+                order = order[s[bi, ci, order] > sc_t]
+            kept = []
+            for idx in order:
+                if max_out and len(kept) >= max_out:
+                    break
+                ok = True
+                for j in kept:
+                    ix1 = max(x1[bi, idx], x1[bi, j])
+                    iy1 = max(y1[bi, idx], y1[bi, j])
+                    ix2 = min(x2[bi, idx], x2[bi, j])
+                    iy2 = min(y2[bi, idx], y2[bi, j])
+                    inter = max(ix2 - ix1, 0.0) * max(iy2 - iy1, 0.0)
+                    union = area[bi, idx] + area[bi, j] - inter
+                    if union > 0 and inter / union > iou_t:
+                        ok = False
+                        break
+                if ok:
+                    kept.append(int(idx))
+            sel += [[bi, ci, k] for k in kept]
+    out = onp.asarray(sel, 'int64').reshape(-1, 3)
+    return jnp.asarray(out)

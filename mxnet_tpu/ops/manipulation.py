@@ -161,7 +161,19 @@ def rot90(x, k=1, axes=(0, 1)):
 
 
 @register('pad')
-def pad(x, pad_width, mode='constant', constant_values=0):
+def pad(x, pad_width, mode='constant', constant_values=0,
+        constant_value=None):
+    """numpy-style pad; also accepts the reference Pad op's conventions
+    (src/operator/pad.cc): a FLAT (before0, after0, before1, after1, ...)
+    pad_width of length 2*ndim and the ``constant_value`` kwarg."""
+    if constant_value is not None:
+        constant_values = constant_value
+    if (isinstance(pad_width, (tuple, list)) and pad_width
+            and not isinstance(pad_width[0], (tuple, list))
+            and len(pad_width) == 2 * x.ndim):
+        pad_width = tuple(
+            (pad_width[2 * i], pad_width[2 * i + 1])
+            for i in range(x.ndim))
     if mode == 'constant':
         return jnp.pad(x, pad_width, mode=mode,
                        constant_values=constant_values)

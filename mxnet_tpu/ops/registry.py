@@ -138,7 +138,9 @@ def _hashable(x):
     if isinstance(x, _np.dtype):
         return ('__dtype__', str(x))
     if isinstance(x, _np.generic):
-        return x.item()
+        # keep the numpy dtype in the token: np.int32(2)/np.float32(2.0)
+        # compare equal as .item()s but compile differently
+        return ('np', str(x.dtype), repr(x.item()))
     if isinstance(x, type):
         return ('__type__', x.__name__)
     raise _Unkeyable(repr(type(x)))
@@ -169,12 +171,10 @@ def apply_op(op, arrays, fn, n_out=None, name=None, _from_invoke=False,
         grad_active = recording and op.differentiable
         rec = _bulk.try_record(op, arrays, fn, bulk_key, grad_active)
         if rec is not None:
-            refs, multi = rec
+            refs, multi, ags = rec
             wrapped = [_wrap_lazy(r, arrays) for r in refs]
-            if grad_active:
-                for i, (w, r) in enumerate(zip(wrapped, refs)):
-                    ag = _tape.AGInfo(node=None, index=i)
-                    ag.node = _bulk.register_ag(r, ag)
+            for w, ag in zip(wrapped, ags):
+                if ag is not None:
                     w._ag = ag
             _bulk.cap_check()
             return tuple(wrapped) if multi else wrapped[0]
