@@ -155,11 +155,12 @@ class _Segment:
         self.state = state
         self.lock = threading.RLock()
         self.boundary = []          # raw jax arrays
-        self.boundary_ids = {}      # id(raw) -> index
+        self.boundary_ids = {}      # (id(raw), id(ag)) -> index
         self.boundary_ags = []      # AGInfo|None per boundary input
         self.entries = []
         self.trie_pos = state.trie
         self.agrefs = []            # ((ei, oi), weakref(AGInfo))
+        self.ag_by_key = {}         # (ei, oi) -> weakref(AGInfo) we created
         self.tape_node = None
         self.flushed = False
 
@@ -167,6 +168,22 @@ class _Segment:
     def add(self, op, arrays, fn, bulk_key, grad_active):
         """Append one op. Returns list of LazyRefs, or None (caller goes
         eager; segment left consistent)."""
+        # Pass 1 — validate before mutating anything: an in-segment lazy
+        # value whose NDArray carries an _ag DIFFERENT from the AGInfo this
+        # segment attached to that output (detach()+attach_grad alias, a
+        # variable rebound via _adopt_lazy) has lineage the segment graph
+        # cannot express — the cotangent would be misrouted to the recorded
+        # producer. Settle the segment and let the op dispatch eagerly.
+        for nd in arrays:
+            ref = nd._lazy
+            if ref is not None and ref.seg is self and ref.value is None:
+                ag = getattr(nd, '_ag', None)
+                if ag is not None:
+                    w = self.ag_by_key.get(ref.key)
+                    if w is None or w() is not ag:
+                        self.flush()
+                        return None
+
         in_refs = []
         in_avals = []
         descr = []
@@ -186,16 +203,21 @@ class _Segment:
                 descr.append((1, ei, oi, blocked))
             else:
                 raw = nd._raw if ref is None else ref.value
-                bidx = self.boundary_ids.get(id(raw))
+                # key by (buffer, lineage): two NDArrays sharing one raw
+                # buffer but carrying distinct AGInfos (x and
+                # x.detach()+attach_grad — the TBPTT idiom) must occupy
+                # distinct boundary slots, or their gradients collapse
+                # into whichever lineage was recorded first. The raw is
+                # simply passed twice as replay args; jax.vjp then yields
+                # a separate cotangent per slot, matching the eager
+                # tape's per-edge parent links.
+                bkey = (id(raw), id(ag))
+                bidx = self.boundary_ids.get(bkey)
                 if bidx is None:
                     bidx = len(self.boundary)
                     self.boundary.append(raw)
-                    self.boundary_ids[id(raw)] = bidx
+                    self.boundary_ids[bkey] = bidx
                     self.boundary_ags.append(ag)
-                elif self.boundary_ags[bidx] is None and ag is not None:
-                    # a tracked alias of a raw first seen via an
-                    # untracked wrapper: adopt the lineage
-                    self.boundary_ags[bidx] = ag
                 in_refs.append((0, bidx, 0, blocked))
                 in_avals.append(
                     jax.ShapeDtypeStruct(raw.shape, raw.dtype))
@@ -257,7 +279,9 @@ class _Segment:
         ags = []
         for ref in refs:
             ag = _tape.AGInfo(node=self.tape_node, index=0)
-            self.agrefs.append((ref.key, weakref.ref(ag)))
+            w = weakref.ref(ag)
+            self.agrefs.append((ref.key, w))
+            self.ag_by_key[ref.key] = w
             ags.append(ag)
         return ags
 
@@ -315,6 +339,7 @@ class _Segment:
             # release recording state (tape node keeps what it needs)
             self.entries = []
             self.agrefs = []
+            self.ag_by_key = {}
 
 
 def _build_replay(entries):
@@ -345,8 +370,7 @@ class _State(threading.local):
     def __init__(self):
         self.segment = None
         self.trie = _TrieNode()
-        self.enabled = None         # None = resolve from env/backend
-        self.size = int(os.environ.get('MXNET_ENGINE_BULK_SIZE', 4096))
+        self.size_override = None   # set by force(size=...) for this thread
         self.force_depth = 0
         self.disabled_depth = 0
         self.hits = 0
@@ -357,6 +381,13 @@ class _State(threading.local):
 
 _st = _State()
 _env_default = None
+# Process-wide defaults (engine.set_bulk_size documents itself as the
+# process default, matching the reference's MXNET_ENGINE_BULK_SIZE): the
+# enabled switch and segment-size cap are module globals read by every
+# thread; the force/disable depths and size_override remain thread-local
+# scope overrides.
+_enabled = None                 # None = resolve from env/backend
+_size = int(os.environ.get('MXNET_ENGINE_BULK_SIZE', 4096))
 
 
 def _default_enabled():
@@ -382,19 +413,28 @@ def active():
         return False
     if _st.force_depth:
         return True
-    if _st.enabled is not None:
-        return _st.enabled
+    if _enabled is not None:
+        return _enabled
     return _default_enabled()
 
 
 def set_enabled(flag):
-    """Explicit thread-local on/off switch."""
+    """Explicit process-wide on/off switch (flushes the calling thread's
+    pending segment; other threads' segments flush at their own sync
+    points)."""
+    global _enabled
     flush_current()
-    _st.enabled = flag
+    _enabled = flag
 
 
 def set_size(n):
-    _st.size = n
+    """Process-wide default segment-size cap."""
+    global _size
+    _size = n
+
+
+def current_size():
+    return _st.size_override if _st.size_override is not None else _size
 
 
 def stats():
@@ -415,14 +455,14 @@ class force:
     def __init__(self, on, size=None):
         self.on = on
         self.size = size
-        self.prev_size = None
+        self.prev_override = None
 
     def __enter__(self):
         if self.on:
             _st.force_depth += 1
             if self.size:
-                self.prev_size = _st.size
-                _st.size = self.size
+                self.prev_override = _st.size_override
+                _st.size_override = self.size
         else:
             flush_current()
             _st.disabled_depth += 1
@@ -431,8 +471,8 @@ class force:
     def __exit__(self, *exc):
         if self.on:
             _st.force_depth -= 1
-            if self.prev_size is not None:
-                _st.size = self.prev_size
+            if self.size:
+                _st.size_override = self.prev_override
             flush_current()
         else:
             _st.disabled_depth -= 1
@@ -498,6 +538,6 @@ def cap_check():
     """Flush if the current segment hit the bulk-size cap. Called by the
     dispatcher after outputs (and their AGInfos) are fully wired."""
     seg = _current()
-    if seg is not None and len(seg.entries) >= _st.size:
+    if seg is not None and len(seg.entries) >= current_size():
         seg.flush()
         _st.segment = None

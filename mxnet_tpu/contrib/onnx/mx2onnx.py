@@ -766,8 +766,17 @@ def _box_nms(b, node, ins, out):
     if ii >= 0 and not kw.get('force_suppress', False):
         ids = b.add('Slice', [data_s, i64('ib', [ii]), i64('ie', [ii + 1]),
                               i64('ia', [-1])], [b.uname('ids')])
-        off = b.add('Mul', [ids, b.const('koff', _np.float32(4096.0))],
-                    [b.uname('idoff')])
+        # class-aware suppression: translate each class's boxes into a
+        # disjoint coordinate band so cross-class IoU is exactly 0. The
+        # per-class stride is derived IN-GRAPH as (max-min+1) over all
+        # box coordinates — a fixed constant would silently break for
+        # pixel-coordinate boxes from large images.
+        cmax = b.add('ReduceMax', [boxes], [b.uname('cmax')], keepdims=0)
+        cmin = b.add('ReduceMin', [boxes], [b.uname('cmin')], keepdims=0)
+        ext = b.add('Sub', [cmax, cmin], [b.uname('cext')])
+        stride = b.add('Add', [ext, b.const('kone', _np.float32(1.0))],
+                       [b.uname('cstride')])
+        off = b.add('Mul', [ids, stride], [b.uname('idoff')])
         boxes = b.add('Add', [boxes, off], [b.uname('boxoff')])
     mask = b.uname('keepmask')
     _emit_nms(b, boxes, vals, mask, n,

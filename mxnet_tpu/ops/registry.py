@@ -134,7 +134,12 @@ def _hashable(x):
     if isinstance(x, (tuple, list)):
         return tuple(_hashable(e) for e in x)
     if isinstance(x, slice):
-        return ('__slice__', x.start, x.stop, x.step)
+        # recurse: a slice member can itself be unhashable (device array)
+        # — must raise _Unkeyable here so dispatch falls back to eager,
+        # not TypeError later at the trie dict lookup — and np-integer
+        # members must tokenize consistently with the scalar rules
+        return ('__slice__', _hashable(x.start), _hashable(x.stop),
+                _hashable(x.step))
     if isinstance(x, _np.dtype):
         return ('__dtype__', str(x))
     if isinstance(x, _np.generic):

@@ -212,6 +212,7 @@ def test_naive_engine_bypasses_bulk():
 
 
 def test_set_bulk_size_toggles():
+    prev_size = _bulk._size
     try:
         engine.set_bulk_size(16)
         a = mx.np.ones((2,)) * 5
@@ -221,7 +222,8 @@ def test_set_bulk_size_toggles():
         assert b._lazy is None              # bulking off
         onp.testing.assert_allclose(a.asnumpy(), [5.0, 5.0])
     finally:
-        _bulk._st.enabled = None            # restore env default
+        _bulk._enabled = None               # restore env default
+        _bulk._size = prev_size
 
 
 def test_bulk_stats_surface():
@@ -280,3 +282,88 @@ def test_scalar_type_distinguishes_cache_keys():
         or str(a.dtype).startswith('int')
     assert str(b.dtype).startswith('float'), \
         f'float-power result reused the int-power plan: {b.dtype}'
+
+
+def test_aliased_lineages_get_distinct_boundary_slots():
+    """x and x.detach()+attach_grad() share one raw buffer but carry
+    DISTINCT lineage (the TBPTT idiom). Bulked gradients must match
+    eager — r3 regression: boundary inputs deduped by id(raw) collapsed
+    both edges into the first-seen AGInfo, giving (8, 0) not (3, 5)."""
+    def run(bulked):
+        x = mx.np.array([2.0, 3.0])
+        x.attach_grad()
+        y = x.detach()
+        y.attach_grad()
+        ctx = engine.bulk(100) if bulked else engine.naive_engine()
+        with ctx:
+            with autograd.record():
+                z = (x * 3 + y * 5).sum()
+            z.backward()
+        return x.grad.asnumpy(), y.grad.asnumpy()
+
+    (gx_b, gy_b), (gx_e, gy_e) = run(True), run(False)
+    onp.testing.assert_allclose(gx_b, gx_e)   # 3
+    onp.testing.assert_allclose(gy_b, gy_e)   # 5
+
+
+def test_aliased_lineages_pending_value():
+    """Same aliasing but through a segment-produced value: attach_grad
+    on the detached alias is a sync point (grad buffer needs the dtype),
+    after which both aliases enter the next segment as boundary inputs
+    with distinct lineage."""
+    def run(bulked):
+        a = mx.np.array([2.0, 3.0])
+        a.attach_grad()
+        ctx = engine.bulk(100) if bulked else engine.naive_engine()
+        with ctx:
+            with autograd.record():
+                x = a * 1.0
+                y = x.detach()
+                y.attach_grad()
+                z = (x * 3 + y * 5).sum()
+            z.backward()
+        return a.grad.asnumpy(), y.grad.asnumpy()
+
+    (ga_b, gy_b), (ga_e, gy_e) = run(True), run(False)
+    onp.testing.assert_allclose(ga_b, ga_e)   # 3 (through x)
+    onp.testing.assert_allclose(gy_b, gy_e)   # 5
+
+
+def test_marked_pending_alias_dispatches_eagerly():
+    """mark_variables on a still-pending detached alias (no _data touch,
+    no flush) diverges from the segment's recorded lineage: the segment
+    must settle and dispatch that op eagerly rather than misroute the
+    cotangent to the recorded producer."""
+    from mxnet_tpu import _tape
+
+    def run(bulked):
+        a = mx.np.array([2.0, 3.0])
+        a.attach_grad()
+        ctx = engine.bulk(100) if bulked else engine.naive_engine()
+        with ctx:
+            with autograd.record():
+                x = a * 1.0
+                y = x.detach()
+                _tape.mark_variables([y], [mx.np.zeros((2,))])
+                z = (x * 3 + y * 5).sum()
+            z.backward()
+        return a.grad.asnumpy(), y.grad.asnumpy()
+
+    (ga_b, gy_b), (ga_e, gy_e) = run(True), run(False)
+    onp.testing.assert_allclose(ga_b, ga_e)   # 3 (through x)
+    onp.testing.assert_allclose(gy_b, gy_e)   # 5
+
+
+def test_hashable_slice_recurses():
+    """A slice carrying an unhashable member must raise _Unkeyable (so
+    dispatch falls back to eager) instead of TypeError at the trie
+    lookup; np-integer members tokenize under the scalar rules."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import registry
+
+    with pytest.raises(registry._Unkeyable):
+        registry._hashable(slice(jnp.ones((2,)), None, None))
+    t_np = registry._hashable(slice(onp.int32(2), None, None))
+    t_py = registry._hashable(slice(2, None, None))
+    assert t_np != t_py
+    assert t_py == ('__slice__', ('i', 2), None, None)

@@ -159,3 +159,20 @@ def test_shape_ops_roundtrip(tmp_path):
     x = mx.np.array(np.random.default_rng(5).uniform(
         0, 1, (3, 4)).astype('f'))
     _roundtrip(net, x, tmp_path, 'shape_ops', rtol=1e-4, atol=1e-4)
+
+
+def test_box_nms_pixel_coords_class_aware(tmp_path):
+    """Pixel-coordinate boxes (values well past 4096): the class-band
+    offset must be derived in-graph from the coordinate extent — a fixed
+    constant lets adjacent class bands overlap and wrongly suppress."""
+    r = np.random.default_rng(7)
+    lo = r.uniform(0, 5000, (1, 16, 2)).astype('f')
+    boxes = np.concatenate(
+        [lo, lo + r.uniform(20, 800, (1, 16, 2)).astype('f')], axis=-1)
+    scores = r.uniform(0, 1, (1, 16, 1)).astype('f')
+    ids = r.integers(0, 3, (1, 16, 1)).astype('f')
+    x = mx.np.array(np.concatenate([ids, scores, boxes], axis=-1))
+    net = _NMSHead(overlap_thresh=0.5, valid_thresh=0.05, coord_start=2,
+                   score_index=1, id_index=0)
+    net.initialize()
+    _roundtrip(net, x, tmp_path, 'nms_pixel')
