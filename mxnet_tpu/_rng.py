@@ -42,17 +42,25 @@ class _TraceKeyProvider:
         return jax.random.fold_in(self.base_key, self.count)
 
 
-_providers = []
+def _providers():
+    # THREAD-LOCAL: graph capture happens on whichever thread traces the
+    # block; a process-global stack would hand another thread's eager
+    # next_key() a traced provider (leaked tracers) whenever two threads
+    # share one hybridized block (multi-threaded inference).
+    ps = getattr(_state, 'providers', None)
+    if ps is None:
+        ps = _state.providers = []
+    return ps
 
 
 def push_trace_provider(base_key):
     prov = _TraceKeyProvider(base_key)
-    _providers.append(prov)
+    _providers().append(prov)
     return prov
 
 
 def pop_trace_provider():
-    return _providers.pop()
+    return _providers().pop()
 
 
 def next_key():
@@ -62,8 +70,9 @@ def next_key():
     outer trace (eval_shape / jit replaying a symbol) omnistaging would
     otherwise stage the split and store a *tracer* into the global state,
     poisoning every later eager op (leaked-tracer errors)."""
-    if _providers:
-        return _providers[-1].next_key()
+    ps = _providers()
+    if ps:
+        return ps[-1].next_key()
     try:
         clean = jax.core.trace_state_clean()
     except AttributeError:

@@ -287,6 +287,10 @@ class _CachedGraph:
         self._out_trees = {}       # per cache entry: output pytree structure
         self._param_order = None
         self._monitor_callbacks = []
+        # serializes tracing + recorded calls; see __call__ (reference:
+        # src/imperative/cached_op_threadsafe.cc thread-safe CachedOp)
+        self._lock = threading.RLock()
+        self._ready = set()        # keys whose first call fully completed
         # set when the graph has data-dependent shapes (boolean_mask,
         # np.unique, ...) that abstract jit tracing cannot express —
         # the block then runs eagerly, like the reference CachedOp with
@@ -295,9 +299,11 @@ class _CachedGraph:
         self._dynamic = False
 
     def clear(self):
-        self._compiled.clear()
-        self._out_trees.clear()
-        self._param_order = None
+        with self._lock:
+            self._compiled.clear()
+            self._out_trees.clear()
+            self._ready.clear()
+            self._param_order = None
 
     def _params(self):
         if self._param_order is None:
@@ -365,7 +371,6 @@ class _CachedGraph:
 
     def __call__(self, args):
         import jax
-        from ..ops.registry import Op, apply_op
 
         if self._dynamic:
             out = self.block.forward(*args)
@@ -387,14 +392,46 @@ class _CachedGraph:
         # must not share a compiled entry or its output pytree
         key = (tuple((x.shape, str(x.dtype)) for x in in_nds), train_mode,
                treedef)
-        if key not in self._compiled:
-            self._compiled[key] = self._build(key, train_mode,
-                                              len(in_nds), treedef)
-        jfn = self._compiled[key]
-        rng_key = _rng.next_key()
+        # Thread-safety contract (reference thread-safe CachedOp,
+        # src/imperative/cached_op_threadsafe.cc:1-316; docs/threading.md):
+        # compiled steady-state INFERENCE runs lock-free from N threads —
+        # the executable is pure over its fetched inputs and jax dispatch
+        # is thread-safe. The lock serializes (a) tracing, because
+        # jax.jit traces lazily on first execution and pure_fn swaps
+        # traced values into the SHARED Parameter payloads, and (b) any
+        # autograd-recorded call, whose jax.vjp re-traces the jitted
+        # function and re-enters that swap. Parameter snapshots on the
+        # lock-free path still acquire the lock briefly so they can
+        # never observe a mid-trace swap.
+        if key in self._ready and not _tape.is_recording():
+            with self._lock:
+                # re-check under the lock: a concurrent clear()
+                # (re-hybridize/cast while serving) may have emptied the
+                # cache since the unlocked _ready probe
+                jfn = self._compiled.get(key)
+                main_nds = [p.data() for p in main]
+                aux_raws = tuple(p.data()._data for p in aux)
+            if jfn is not None:
+                return self._execute(args, key, jfn, in_nds, main_nds,
+                                     aux_raws)
+        with self._lock:
+            if key not in self._compiled:
+                self._compiled[key] = self._build(key, train_mode,
+                                                  len(in_nds), treedef)
+            jfn = self._compiled[key]
+            main_nds = [p.data() for p in main]
+            aux_raws = tuple(p.data()._data for p in aux)
+            out = self._execute(args, key, jfn, in_nds, main_nds,
+                                aux_raws)
+            self._ready.add(key)
+            return out
 
-        main_nds = [p.data() for p in main]
-        aux_raws = tuple(p.data()._data for p in aux)
+    def _execute(self, args, key, jfn, in_nds, main_nds, aux_raws):
+        import jax
+        from ..ops.registry import Op, apply_op, DynamicShapeError
+
+        main, aux = self._params()
+        rng_key = _rng.next_key()
         n_in = len(in_nds)
         n_aux = len(aux)
 
@@ -403,8 +440,6 @@ class _CachedGraph:
             ps = raws[n_in:]
             outs, aux_out = jfn(rng_key, tuple(ins), tuple(ps), aux_raws)
             return tuple(outs) + tuple(aux_out)
-
-        from ..ops.registry import DynamicShapeError
 
         op = Op('_CachedOp', fn, differentiable=True)
         try:
@@ -418,8 +453,10 @@ class _CachedGraph:
             # The failed entry is dropped so a later clear()+
             # re-hybridize can retry compilation.
             self._dynamic = True
-            self._compiled.pop(key, None)
-            self._out_trees.pop(key, None)
+            with self._lock:
+                self._compiled.pop(key, None)
+                self._out_trees.pop(key, None)
+                self._ready.discard(key)
             warnings.warn(
                 f'{type(self.block).__name__}: graph has data-dependent '
                 'shapes; hybridize falls back to eager execution '
@@ -429,11 +466,15 @@ class _CachedGraph:
             res = (res,)
         out_vals = res[:len(res) - n_aux] if n_aux else res
         aux_vals = res[len(res) - n_aux:] if n_aux else ()
-        for p, v in zip(aux, aux_vals):
-            for c in list(p._data):
-                p._data[c]._rebind(v._data)
-            # aux outputs never need grad linkage
-            v._ag = None
+        if aux:
+            # BN-stat style rebinding mutates shared Parameters: keep it
+            # under the lock so a concurrent snapshot reads a coherent set
+            with self._lock:
+                for p, v in zip(aux, aux_vals):
+                    for c in list(p._data):
+                        p._data[c]._rebind(v._data)
+                    # aux outputs never need grad linkage
+                    v._ag = None
         out = jax.tree.unflatten(self._out_trees[key], list(out_vals))
         for cb in self._monitor_callbacks:
             cb(self.block, out)

@@ -25,6 +25,25 @@ secret it is only accepted from loopback peers.  The server binds the
 coordinator interface from ``MX_COORDINATOR`` rather than 0.0.0.0.
 Server address: rank 0's host from ``MX_COORDINATOR`` with port offset
 ``MXNET_KVSTORE_ASYNC_PORT`` (default coordinator port + 29).
+
+Capacity (reference ``kvstore_dist.h:621`` EncodeDefaultKey):
+
+* **Multi-server key sharding** — ``MXNET_KVSTORE_NUM_SERVERS=S``
+  starts one server thread on each of ranks 0..S-1 (server s at port
+  base+s); servers s>0 register their reachable address with server 0,
+  and every worker learns the table from there. Keys are routed by
+  CRC32(key) % S, so load and optimizer compute spread across servers.
+* **Big-array splitting** — arrays of at least
+  ``MXNET_KVSTORE_BIGARRAY_BOUND`` bytes (default 1 MB, the reference
+  default) with enough rows are split into S contiguous row ranges,
+  chunk k living on server k — one huge embedding table does not pin a
+  single server (reference bigarray_bound_ slicing).
+* **Failure detection** — every worker runs a heartbeat thread pinging
+  server 0 (``MXNET_KVSTORE_HEARTBEAT_S``, default 2s);
+  ``get_num_dead_node(timeout=t)`` reports workers whose last beat is
+  older than ``t`` plus any unreachable server — a real answer, not
+  the stub the reference's Postoffice heartbeat would give
+  (ps-lite Postoffice::GetDeadNodes).
 """
 
 import json
@@ -71,11 +90,14 @@ class _AsyncServer(threading.Thread):
     Every request handler applies immediately under the store lock —
     the async branch of DataHandleDefault."""
 
-    def __init__(self, port, bind_host='127.0.0.1'):
+    def __init__(self, port, bind_host='127.0.0.1', sid=0):
         super().__init__(daemon=True)
+        self._sid = sid
         self._store = {}
         self._updater = None
         self._lock = threading.Lock()
+        self._last_seen = {}        # worker rank -> monotonic last beat
+        self._server_table = {}     # sid -> 'host:port' (server 0 only)
         self._secret = os.environ.get('MXNET_KVSTORE_SECRET', '')
         # addresses that count as "same host" for the no-secret
         # set_optimizer gate: loopback plus the bind interface itself
@@ -97,8 +119,12 @@ class _AsyncServer(threading.Thread):
                         header, payload = _recv_msg(self.request)
                     except (ConnectionError, OSError, ValueError):
                         return
-                    reply, rpayload = outer._dispatch(
-                        header, payload, self.client_address[0])
+                    try:
+                        reply, rpayload = outer._dispatch(
+                            header, payload, self.client_address[0])
+                    except Exception as e:    # keep the connection alive
+                        reply, rpayload = {'ok': False,
+                                           'error': repr(e)}, b''
                     _send_msg(self.request, reply, rpayload)
 
         class Server(socketserver.ThreadingTCPServer):
@@ -122,7 +148,35 @@ class _AsyncServer(threading.Thread):
 
     # ----------------------------------------------------------- handlers
     def _dispatch(self, header, payload, peer='127.0.0.1'):
+        import time as _time
         cmd = header['cmd']
+        rank = header.get('rank')
+        if rank is not None:
+            with self._lock:
+                # every RPC doubles as a heartbeat (plus the dedicated
+                # ping thread on each worker)
+                self._last_seen[int(rank)] = _time.monotonic()
+        if cmd == 'ping':
+            return {'ok': True, 'sid': self._sid}, b''
+        if cmd == 'register_server':
+            with self._lock:
+                self._server_table[int(header['sid'])] = header['addr']
+            return {'ok': True}, b''
+        if cmd == 'server_table':
+            with self._lock:
+                return {'ok': True,
+                        'table': {str(k): v for k, v
+                                  in self._server_table.items()}}, b''
+        if cmd == 'dead_nodes':
+            cutoff = _time.monotonic() - float(header['timeout'])
+            with self._lock:
+                dead = sum(1 for t in self._last_seen.values()
+                           if t < cutoff)
+            return {'ok': True, 'dead': dead}, b''
+        if cmd == 'stats':
+            with self._lock:
+                return {'ok': True, 'sid': self._sid,
+                        'keys': sorted(map(str, self._store))}, b''
         if cmd == 'init':
             arr = _onp.frombuffer(payload, header['dtype']).reshape(
                 header['shape']).copy()
@@ -148,7 +202,13 @@ class _AsyncServer(threading.Thread):
             return {'ok': True}, b''
         if cmd == 'pull':
             with self._lock:
-                w = self._store[header['key']]
+                w = self._store.get(header['key'])
+                if w is None:
+                    # a clean error keeps the connection alive (a raise
+                    # would kill this handler thread and drop the socket)
+                    return {'ok': False,
+                            'error': f'no such key {header["key"]!r} on '
+                                     f'server {self._sid}'}, b''
                 data = _onp.ascontiguousarray(w)
             return {'ok': True, 'dtype': str(data.dtype),
                     'shape': data.shape}, data.tobytes()
@@ -213,59 +273,137 @@ class KVStoreDistAsync(KVStoreBase):
     def __init__(self):
         self._rank = int(os.environ.get('MX_PROC_ID', '0'))
         self._nproc = int(os.environ.get('MX_NPROC', '1'))
-        self._sock = None
+        self._socks = {}            # sid -> socket
+        self._sock_locks = {}       # sid -> Lock (heartbeat vs caller)
         self._server = None
         self._port = None
         self._host = ' '
+        self._nserv = min(max(1, int(os.environ.get(
+            'MXNET_KVSTORE_NUM_SERVERS', '1'))), self._nproc)
+        self._big = int(float(os.environ.get(
+            'MXNET_KVSTORE_BIGARRAY_BOUND', str(1 << 20))))
+        self._hb_thread = None
 
     # ------------------------------------------------------------ plumbing
+    def _dial(self, host, port):
+        last = None
+        for _ in range(100):
+            try:
+                s = socket.create_connection((host, port), timeout=5)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return s
+            except OSError as e:
+                last = e
+                import time
+                time.sleep(0.1)
+        raise ConnectionError(
+            f'cannot reach dist_async server at {host}:{port}: {last}')
+
     def _ensure_connected(self):
-        if self._sock is not None:
+        if self._socks:
             return
         coord = os.environ.get('MX_COORDINATOR', '127.0.0.1:49800')
         host, port = coord.rsplit(':', 1)
         self._port = int(os.environ.get('MXNET_KVSTORE_ASYNC_PORT',
                                         int(port) + 29))
         self._host = host
-        if self._rank == 0 and self._server is None:
-            # one server per process regardless of how many dist_async
-            # stores the worker creates (the reference's server process
-            # is likewise shared across kvstore handles)
-            self._server = _SERVERS.get(self._port)
+        local = host in ('127.0.0.1', 'localhost')
+        if self._rank < self._nserv and self._server is None:
+            # this rank hosts server `rank` (reference: the server node
+            # group; one server per process regardless of how many
+            # dist_async stores the worker creates)
+            my_port = self._port + self._rank
+            self._server = _SERVERS.get(my_port)
             if self._server is None:
-                bind = '127.0.0.1' if host in ('127.0.0.1',
-                                               'localhost') else host
-                self._server = _AsyncServer(self._port, bind_host=bind)
+                bind = '127.0.0.1' if local else host \
+                    if self._rank == 0 else ''
+                if not bind:
+                    bind = '0.0.0.0'      # servers >0: any interface
+                self._server = _AsyncServer(my_port, bind_host=bind,
+                                            sid=self._rank)
                 self._server.start()
-                _SERVERS[self._port] = self._server
+                _SERVERS[my_port] = self._server
         # every rank (rank 0 included) connects to the advertised
         # coordinator host: the server may be bound to that interface
         # only, so rank 0 dialing loopback would be refused
-        target = '127.0.0.1' if host in ('127.0.0.1', 'localhost') \
-            else host
-        last = None
-        for _ in range(100):
-            try:
-                self._sock = socket.create_connection(
-                    (target, self._port), timeout=5)
-                self._sock.setsockopt(socket.IPPROTO_TCP,
-                                      socket.TCP_NODELAY, 1)
-                return
-            except OSError as e:
-                last = e
-                import time
+        target = '127.0.0.1' if local else host
+        self._socks[0] = self._dial(target, self._port)
+        self._sock_locks[0] = threading.Lock()
+        if self._nserv > 1:
+            # server sid>0 advertises the interface it reaches server 0
+            # through (reachable by every peer on that network); the
+            # table rendezvous lives on server 0
+            if 0 < self._rank < self._nserv:
+                myaddr = (f'{self._socks[0].getsockname()[0]}:'
+                          f'{self._port + self._rank}')
+                self._rpc_to(0, {'cmd': 'register_server',
+                                 'sid': self._rank, 'addr': myaddr})
+            table = {}
+            import time
+            for _ in range(200):
+                reply, _p = self._rpc_to(0, {'cmd': 'server_table'})
+                table = reply['table']
+                if len(table) >= self._nserv - 1:
+                    break
                 time.sleep(0.1)
-        raise ConnectionError(
-            f'cannot reach dist_async server at {target}:{self._port}: '
-            f'{last}')
+            else:
+                raise ConnectionError(
+                    f'only {len(table) + 1}/{self._nserv} dist_async '
+                    'servers registered')
+            for sid_s, addr in table.items():
+                h, p = addr.rsplit(':', 1)
+                sid = int(sid_s)
+                self._socks[sid] = self._dial(h, int(p))
+                self._sock_locks[sid] = threading.Lock()
+        if self._hb_thread is None:
+            interval = float(os.environ.get('MXNET_KVSTORE_HEARTBEAT_S',
+                                            '2'))
 
-    def _rpc(self, header, payload=b''):
-        self._ensure_connected()
-        _send_msg(self._sock, header, payload)
-        reply, rpayload = _recv_msg(self._sock)
+            def beat():
+                import time
+                while True:
+                    time.sleep(interval)
+                    try:
+                        self._rpc_to(0, {'cmd': 'ping'})
+                    except Exception:
+                        return        # job shutting down
+
+            self._hb_thread = threading.Thread(target=beat, daemon=True)
+            self._hb_thread.start()
+
+    def _rpc_to(self, sid, header, payload=b''):
+        header['rank'] = self._rank
+        with self._sock_locks[sid]:
+            _send_msg(self._socks[sid], header, payload)
+            reply, rpayload = _recv_msg(self._socks[sid])
         if not reply.get('ok'):
             raise RuntimeError(reply.get('error', 'kvstore rpc failed'))
         return reply, rpayload
+
+    def _rpc(self, header, payload=b''):
+        self._ensure_connected()
+        return self._rpc_to(0, header, payload)
+
+    # ------------------------------------------------------------- routing
+    def _key_server(self, key):
+        import zlib
+        return zlib.crc32(str(key).encode()) % self._nserv
+
+    def _plan(self, key, shape, nbytes):
+        """Reference EncodeDefaultKey (kvstore_dist.h:621): small keys
+        hash to one server; arrays >= bigarray_bound with enough rows
+        split into contiguous row ranges, chunk k on server k. Every
+        worker computes the identical plan from (key, shape)."""
+        self._ensure_connected()
+        if self._nserv == 1:
+            return [(0, key, None)]
+        if nbytes >= self._big and len(shape) >= 1 \
+                and shape[0] >= self._nserv:
+            rows, S = shape[0], self._nserv
+            return [(k, f'{key}#c{k}',
+                     (rows * k // S, rows * (k + 1) // S))
+                    for k in range(S)]
+        return [(self._key_server(key), key, None)]
 
     @staticmethod
     def _to_host(v):
@@ -279,8 +417,11 @@ class KVStoreDistAsync(KVStoreBase):
         vals = value if isinstance(value, (list, tuple)) else [value]
         for k, v in zip(keys, vals):
             a = self._to_host(v)
-            self._rpc({'cmd': 'init', 'key': k, 'dtype': str(a.dtype),
-                       'shape': a.shape}, a.tobytes())
+            for sid, sub, rng in self._plan(k, a.shape, a.nbytes):
+                part = a if rng is None else a[rng[0]:rng[1]]
+                self._rpc_to(sid, {'cmd': 'init', 'key': sub,
+                                   'dtype': str(part.dtype),
+                                   'shape': part.shape}, part.tobytes())
 
     def push(self, key, value, priority=0):
         keys = key if isinstance(key, (list, tuple)) else [key]
@@ -292,8 +433,17 @@ class KVStoreDistAsync(KVStoreBase):
             a = self._to_host(v)
             # no merge buffer, no worker barrier: the server applies this
             # push before replying (async semantics)
-            self._rpc({'cmd': 'push', 'key': k, 'dtype': str(a.dtype),
-                       'shape': a.shape}, a.tobytes())
+            for sid, sub, rng in self._plan(k, a.shape, a.nbytes):
+                part = a if rng is None else \
+                    _onp.ascontiguousarray(a[rng[0]:rng[1]])
+                self._rpc_to(sid, {'cmd': 'push', 'key': sub,
+                                   'dtype': str(part.dtype),
+                                   'shape': part.shape}, part.tobytes())
+
+    def _pull_one(self, sid, sub):
+        reply, payload = self._rpc_to(sid, {'cmd': 'pull', 'key': sub})
+        return _onp.frombuffer(payload, reply['dtype']).reshape(
+            reply['shape'])
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = key if isinstance(key, (list, tuple)) else [key]
@@ -301,9 +451,32 @@ class KVStoreDistAsync(KVStoreBase):
         import jax.numpy as jnp
         results = []
         for k, o in zip(keys, outs):
-            reply, payload = self._rpc({'cmd': 'pull', 'key': k})
-            arr = _onp.frombuffer(payload, reply['dtype']).reshape(
-                reply['shape'])
+            tpl = o[0] if isinstance(o, (list, tuple)) else o
+            if tpl is not None:
+                # split routing is decided from the out template's shape
+                # (identical on every worker — same plan as init/push)
+                shape = tuple(tpl.shape)
+                nbytes = tpl.dtype.itemsize * max(
+                    1, int(_onp.prod(shape)))
+                plan = self._plan(k, shape, nbytes)
+            else:
+                plan = self._plan(k, (), 0)
+            if len(plan) == 1:
+                try:
+                    arr = self._pull_one(plan[0][0], plan[0][1])
+                except RuntimeError as e:
+                    # no out template and the key was init'd as a split
+                    # big array: the unsplit name doesn't exist — fetch
+                    # the chunks (chunk c lives on server c by plan)
+                    if 'no such key' not in str(e) or self._nserv == 1:
+                        raise
+                    arr = _onp.concatenate(
+                        [self._pull_one(c, f'{k}#c{c}')
+                         for c in range(self._nserv)], axis=0)
+            else:
+                arr = _onp.concatenate(
+                    [self._pull_one(sid, sub) for sid, sub, _ in plan],
+                    axis=0)
             raw = jnp.asarray(arr)
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
@@ -337,9 +510,13 @@ class KVStoreDistAsync(KVStoreBase):
         docstring)."""
         if self._rank != 0:
             return
-        self._rpc({'cmd': 'set_optimizer',
-                   'token': os.environ.get('MXNET_KVSTORE_SECRET', '')},
-                  pickle.dumps(optimizer))
+        self._ensure_connected()
+        blob = pickle.dumps(optimizer)
+        token = os.environ.get('MXNET_KVSTORE_SECRET', '')
+        for sid in sorted(self._socks):
+            # every server runs the updater for the keys/chunks it owns
+            self._rpc_to(sid, {'cmd': 'set_optimizer', 'token': token},
+                         blob)
 
     def set_updater(self, updater):
         raise NotImplementedError(
@@ -350,6 +527,16 @@ class KVStoreDistAsync(KVStoreBase):
         raise ValueError('gradient compression is not supported on '
                          'dist_async (reference supports it on the sync '
                          'PS path only)')
+
+    def server_stats(self):
+        """Per-server key inventory {sid: [keys]} — diagnostics/tests
+        for the sharded layout (split chunks appear as 'key#cN')."""
+        self._ensure_connected()
+        out = {}
+        for sid in sorted(self._socks):
+            reply, _ = self._rpc_to(sid, {'cmd': 'stats'})
+            out[sid] = reply['keys']
+        return out
 
     @property
     def rank(self):
@@ -365,7 +552,26 @@ class KVStoreDistAsync(KVStoreBase):
         self._rpc({'cmd': 'barrier', 'nproc': self._nproc})
 
     def get_num_dead_node(self, node_id=0, timeout=60):
-        return 0
+        """A real failure-detection answer (reference ps-lite
+        Postoffice::GetDeadNodes via scheduler heartbeats): unreachable
+        servers are pinged NOW; workers count as dead when their
+        heartbeat (piggybacked on every RPC + the dedicated ping
+        thread) is older than ``timeout`` seconds in server 0's
+        last-seen table."""
+        self._ensure_connected()
+        dead = 0
+        for sid in sorted(self._socks):
+            try:
+                self._rpc_to(sid, {'cmd': 'ping'})
+            except Exception:
+                dead += 1
+        try:
+            reply, _ = self._rpc_to(0, {'cmd': 'dead_nodes',
+                                        'timeout': timeout})
+            dead += int(reply['dead'])
+        except Exception:
+            pass
+        return dead
 
     @property
     def type(self):

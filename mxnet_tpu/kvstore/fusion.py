@@ -80,6 +80,104 @@ def _padded_len(n):
     return -(-n // _PAD_QUANTUM) * _PAD_QUANTUM
 
 
+def plan_buckets(arrs, order, limit):
+    """The store's bucket plan, shared by every fused transport: dtype-
+    grouped (a flat buffer holds one dtype), order-preserving (the
+    caller's priority order carries into dispatch order), greedy by
+    bytes up to ``limit``. Yields ``(sel, shapes, offsets, pad_to)`` per
+    bucket — exactly what _concat_flat/_split_flat consume."""
+    by_dtype = {}
+    for i in order:
+        by_dtype.setdefault(str(arrs[i].dtype), []).append(i)
+    for idxs in by_dtype.values():
+        itemsize = arrs[idxs[0]].dtype.itemsize
+        sizes = [int(_np.prod(arrs[i].shape)) or 1 for i in idxs]
+        for bucket in make_buckets([s * itemsize for s in sizes], limit):
+            sel = [idxs[j] for j in bucket]
+            szs = [sizes[idxs.index(i)] for i in sel]
+            shapes = tuple(tuple(int(d) for d in arrs[i].shape)
+                           for i in sel)
+            offs = tuple(int(o) for o in _np.cumsum([0] + szs[:-1]))
+            yield sel, shapes, offs, _padded_len(sum(szs))
+
+
+def zero1_layout(sizes, nproc, owner=None, order=None):
+    """The ZeRO-1 flat-tile layout, derived once for every consumer
+    (the eager store's _zero1_update, the pure in-axis form below, and
+    any caller sizing a sharded optimizer-state tile): per-key owners,
+    per-rank key segments (in ``order`` — the caller's priority order —
+    when given), the padded tile length, and the _pack_segments layout
+    tuple. Returns ``(owner, seg_keys, lmax, layout)``."""
+    owner = assign_owners(sizes, nproc) if owner is None else owner
+    order = range(len(sizes)) if order is None else order
+    seg_keys = [[i for i in order if owner[i] == r]
+                for r in range(nproc)]
+    seg_len = [sum(sizes[i] for i in s) for s in seg_keys]
+    lmax = _padded_len(max(seg_len + [1]))
+    layout = tuple((tuple(s), lmax - seg_len[r])
+                   for r, s in enumerate(seg_keys))
+    return owner, seg_keys, lmax, layout
+
+
+def zero1_update_in_axis(grads, weights, mom_tile, axis_name, nproc,
+                         update_fn, owner=None):
+    """Pure, named-axis form of the ZeRO-1 sharded update — the device
+    math of ``KVStoreTPUSync._zero1_update`` (the default Trainer path
+    with an updater and >1 process) for use INSIDE a shard_map'd
+    program: the same ``assign_owners``/``_pack_segments`` layout, ONE
+    ``psum_scatter`` delivering each owner its summed gradient tile,
+    the optimizer update on the owned tile only (state sharded N-ways),
+    and ONE ``all_gather`` returning fresh weights — 2(N-1)/N wire
+    bytes total, identical to allreduce, with optimizer compute 1/N.
+
+    ``update_fn(w_tile, g_tile, mom_tile) -> (new_w_tile, new_mom_tile)``
+    — elementwise optimizers (the sgd/adam families) are concatenation-
+    invariant, so the flat-tile update equals the eager store's per-key
+    update. Returns ``(new_weights_per_key, new_mom_tile)``.
+    tools/overlap/aot_overlap.py compiles this on a v5e topology: the
+    scheduled HLO shows optimizer compute between the two collectives.
+    """
+    sizes = [int(_np.prod(w.shape)) or 1 for w in weights]
+    owner, seg_keys, lmax, layout = zero1_layout(sizes, nproc, owner)
+    g_tile = jax.lax.psum_scatter(_pack_segments(list(grads), layout),
+                                  axis_name, tiled=True)
+    packed_w = _pack_segments(list(weights), layout)
+    r = jax.lax.axis_index(axis_name)
+    w_tile = jax.lax.dynamic_slice_in_dim(packed_w, r * lmax, lmax)
+    new_w, new_m = update_fn(w_tile, g_tile, mom_tile)
+    full = jax.lax.all_gather(new_w, axis_name, tiled=True)
+    outs = []
+    for i in range(len(weights)):
+        ro = owner[i]
+        off = ro * lmax + sum(sizes[j] for j in
+                              seg_keys[ro][:seg_keys[ro].index(i)])
+        outs.append(jax.lax.dynamic_slice_in_dim(
+            full, off, sizes[i]).reshape(weights[i].shape))
+    return outs, new_m
+
+
+def bucketed_allreduce_in_axis(raws, axis_name, limit=None, order=None):
+    """Pure, named-axis form of the fused-pushpull device math, for use
+    INSIDE a shard_map'd/pjit'd program: the same plan_buckets/
+    _concat_flat/_split_flat pipeline KVStoreTPUSync._bucketed_allreduce
+    dispatches per bucket (with CrossProcess.psum as the collective),
+    but with ``lax.psum(.., axis_name)`` so an entire train step —
+    forward, backward, bucketed gradient allreduce, optimizer update —
+    compiles as ONE program. tools/overlap/aot_overlap.py compiles this
+    exact function on a v5e topology and checks the scheduled HLO
+    interleaves the bucket collectives with backward compute."""
+    limit = fusion_buffer_bytes() if limit is None else limit
+    out = list(raws)
+    order = list(range(len(out))) if order is None else order
+    for sel, shapes, offs, pad_to in plan_buckets(out, order, limit):
+        flat = _concat_flat([out[i] for i in sel], pad_to)
+        summed = jax.lax.psum(flat, axis_name)
+        parts = _split_flat(summed, shapes, offs)
+        for i, p in zip(sel, parts):
+            out[i] = p
+    return out
+
+
 @jax.jit
 def _fused_replica_sum(raws_lists):
     """Sum each key's device replicas — all keys in ONE executable

@@ -163,7 +163,6 @@ class KVStoreTPUSync(KVStoreLocal):
                     'training with a consistent configuration.')
 
     def _bucketed_allreduce(self, keys, merged, order, gc):
-        import numpy as _onp
         from . import fusion
         cp = fusion.CrossProcess.get() if self._nproc > 1 else None
         limit = fusion.fusion_buffer_bytes()
@@ -199,26 +198,17 @@ class KVStoreTPUSync(KVStoreLocal):
                     out[i] = p if str(merged[i].dtype) == 'float32' \
                         else p.astype(merged[i].dtype)
             return out
-        by_dtype = {}
-        for i in order:
-            by_dtype.setdefault(str(out[i].dtype), []).append(i)
-        for dt, idxs in by_dtype.items():
-            itemsize = out[idxs[0]].dtype.itemsize
-            sizes = [int(_onp.prod(out[i].shape)) or 1 for i in idxs]
-            for bucket in fusion.make_buckets(
-                    [s * itemsize for s in sizes], limit):
-                sel = [idxs[j] for j in bucket]
-                szs = [sizes[idxs.index(i)] for i in sel]
-                shapes = tuple(tuple(int(d) for d in out[i].shape)
-                               for i in sel)
-                offs = tuple(int(o) for o in
-                             _onp.cumsum([0] + szs[:-1]))
-                pad_to = fusion._padded_len(sum(szs))
-                flat = fusion._concat_flat([out[i] for i in sel], pad_to)
-                summed = cp.psum(flat)
-                parts = fusion._split_flat(summed, shapes, offs)
-                for i, p in zip(sel, parts):
-                    out[i] = p
+        # shared bucket plan (fusion.plan_buckets): same pipeline as the
+        # pure in-axis form proven overlapped by tools/overlap —
+        # here each bucket's psum is its own async dispatch so priority
+        # order carries into the device stream
+        for sel, shapes, offs, pad_to in fusion.plan_buckets(
+                out, order, limit):
+            flat = fusion._concat_flat([out[i] for i in sel], pad_to)
+            summed = cp.psum(flat)
+            parts = fusion._split_flat(summed, shapes, offs)
+            for i, p in zip(sel, parts):
+                out[i] = p
         return out
 
     def _zero1_update(self, keys, merged, vals_lists, outs, order):
@@ -251,12 +241,8 @@ class KVStoreTPUSync(KVStoreLocal):
             self._z1_owner[keys[j]] = r
             self._z1_load[r] += sizes[j]
         owner = [self._z1_owner[k] for k in keys]
-        seg_keys = [[i for i in order if owner[i] == r]
-                    for r in range(nproc)]
-        seg_len = [sum(sizes[i] for i in s) for s in seg_keys]
-        lmax = fusion._padded_len(max(seg_len + [1]))
-        layout = tuple((tuple(s), lmax - seg_len[r])
-                       for r, s in enumerate(seg_keys))
+        _, seg_keys, lmax, layout = fusion.zero1_layout(
+            sizes, nproc, owner=owner, order=order)
         my_tile = cp.reduce_scatter(fusion._pack_segments(merged, layout))
         mine = seg_keys[me]
         if mine:

@@ -24,8 +24,11 @@ def save_ndarray_map(fname, data):
     arrays = {k: v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
               for k, v in data.items()}
     arrays[_MAGIC_KEY] = _np.array([2, 0])  # format version
-    _np.savez(fname if str(fname).endswith('.npz') or '.' in str(fname)
-              else fname, **arrays)
+    # write through a handle: bare np.savez APPENDS '.npz' to any path
+    # not already ending in it, silently saving to a different file
+    # than the caller named (reference NDArray::Save writes fname as-is)
+    with open(fname, 'wb') as f:
+        _np.savez(f, **arrays)
 
 
 def load_ndarray_map(fname, ctx=None):

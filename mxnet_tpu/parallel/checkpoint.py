@@ -185,7 +185,59 @@ class SharedCheckpointManager:
             return _localize(self._mgr.restore(
                 step, args=ocp.args.StandardRestore(
                     _globalize(_to_raw(template)))))
+        if jax.process_count() > 1:
+            # scale-change resume: restore against a template built from
+            # the checkpoint's METADATA with the LIVE world's replicated
+            # sharding, so a checkpoint written at a different world
+            # size reshards on load. (A plain restore would try to
+            # rebuild the writer's sharding, whose process set no
+            # longer exists.)
+            tmpl = self._replicated_template(step)
+            if tmpl is not None:
+                return _localize(self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(tmpl)))
         return _localize(self._mgr.restore(step))
+
+    def _replicated_template(self, step):
+        """ShapeDtypeStruct tree (from checkpoint metadata) carrying the
+        live world's fully-replicated sharding; None if the metadata
+        cannot express one (non-array leaves)."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        try:
+            # StandardCheckpointer.metadata on the step directory — the
+            # manager's item_metadata needs a handler registry primed by
+            # a prior save, which a freshly-restarted job doesn't have
+            with _ocp.StandardCheckpointer() as ck:
+                meta = ck.metadata(
+                    _os.path.join(self._dir, str(step), 'default'))
+            tree = meta.item_metadata.tree \
+                if hasattr(meta, 'item_metadata') else meta.tree
+        except Exception:                             # pragma: no cover
+            return None
+        if tree is None:
+            return None
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        mesh = Mesh(_np.array([per_proc[p] for p in sorted(per_proc)]),
+                    ('rep',))
+        sh = NamedSharding(mesh, P())
+        ok = True
+
+        def conv(m):
+            nonlocal ok
+            try:
+                return jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
+                                            sharding=sh)
+            except Exception:
+                ok = False
+                return None
+
+        try:
+            out = jax.tree.map(conv, tree)
+        except Exception:                             # pragma: no cover
+            return None
+        return out if ok else None
 
     def latest_step(self):
         return self._mgr.latest_step()
@@ -220,9 +272,17 @@ def load_params_sharded(directory, block, mesh=None, specs=None):
 
 def restore_or_init(manager, init_fn, template=None):
     """Elastic-restart entry point (SURVEY §5 failure recovery: the
-    reference has none beyond PS heartbeats; here a re-launched job resumes
-    from the newest checkpoint). Returns ``(tree, step)``: the restored
-    state and its step, or ``(init_fn(), -1)`` on a cold start.
+    reference has none beyond PS heartbeats — its model is "restart the
+    job"; here a re-launched job resumes from the newest checkpoint).
+    Returns ``(tree, step)``: the restored state and its step, or
+    ``(init_fn(), -1)`` on a cold start.
+
+    **Scale-change resume**: the restore template defaults to
+    ``init_fn()`` — shapes/dtypes/placements from the LIVE world — so a
+    checkpoint written by an N-rank job restores into an M-rank job
+    (orbax reshards on load against the template's sharding). Exceeds
+    the reference, whose kvstore can only report dead nodes
+    (include/mxnet/kvstore.h:408).
 
     Typical pod loop::
 

