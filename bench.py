@@ -165,6 +165,45 @@ def bench_matmul_peak(args, mx):
     }
 
 
+def bench_hbm(args, mx):
+    """Effective HBM bandwidth of THIS device: a pure-carry saxpy chain
+    (1 read + 1 write per iteration, nothing to fuse away). On the axon
+    tunnel this measures ~70-120 GB/s vs the 819 GB/s v5e spec — the
+    single number that explains the train-MFU ceiling (docs/
+    perf_resnet.md roofline analysis)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    N = (4 << 20) if args.cpu else (32 << 20)     # 16 MB / 128 MB f32
+    K = 30
+
+    def step(c, _):
+        return c * jnp.float32(0.999999) + jnp.float32(1e-9), ()
+
+    run = jax.jit(lambda c0: lax.scan(step, c0, None, length=K)[0].mean())
+    x = jnp.full((N,), 0.5, jnp.float32)
+    out = run(x)
+    float(out)
+    state = {'i': 0}
+
+    def once():
+        state['i'] += 1
+        float(run(x + jnp.float32(state['i'] * 1e-6)))
+
+    fast, all_t = _timed_reps(once, reps=3)
+    bw = 2 * 4 * N * K / min(all_t) / 1e9
+    print(f'effective HBM bandwidth: {bw:.1f} GB/s '
+          f'({bw / 819:.1%} of v5e spec 819)', file=sys.stderr)
+    return {
+        'metric': 'hbm_bandwidth_saxpy',
+        'value': round(bw, 1),
+        'unit': 'GB/s',
+        'vs_baseline': round(bw / 819, 3),
+        'timing_spread': _spread(fast),
+    }
+
+
 def bench_resnet(args, mx):
     from mxnet_tpu.gluon.model_zoo import vision
 
@@ -728,6 +767,7 @@ def bench_train_aba(args, mx):
     peaks + low MFU = framework gap; swinging peaks = the device or
     host contention owns it."""
     pk1 = bench_matmul_peak(args, mx)
+    hbm = bench_hbm(args, mx)
     result = bench_resnet_train(args, mx)
     pk2 = bench_matmul_peak(args, mx)
     samples = pk1['samples_tflops'] + pk2['samples_tflops']
@@ -740,10 +780,28 @@ def bench_train_aba(args, mx):
         (max(samples) - min(samples)) / min(samples), 3)
     result['mfu_vs_measured'] = round(
         result['value'] * 3 * RESNET50_FWD_FLOPS / (peak * 1e12), 3)
-    result['extras'] = {pk1['metric']: {
-        'value': peak, 'unit': 'TFLOP/s',
-        'vs_baseline': round(peak * 1e12 / V5E_BF16_FLOPS, 3),
-        'samples': samples}}
+    # roofline context (docs/perf_resnet.md): the tunnel device's HBM is
+    # ~10x below spec, so the train step is bandwidth-limited well below
+    # the matmul peak — these fields let the artifact carry the proof
+    achieved = result['value'] * 3 * RESNET50_FWD_FLOPS / 1e12
+    result['hbm_gb_s'] = hbm['value']
+    result['roofline'] = {
+        'achieved_tflops': round(achieved, 1),
+        'machine_balance_flop_per_byte': round(
+            peak * 1e12 / (hbm['value'] * 1e9), 0),
+        'hbm_frac_of_spec': hbm['vs_baseline'],
+        'note': 'see docs/perf_resnet.md: fused train-step arithmetic '
+                'intensity ~700 flop/B puts the HBM roofline at '
+                'hbm_gb_s*700 flops/s on this device',
+    }
+    result['extras'] = {
+        pk1['metric']: {
+            'value': peak, 'unit': 'TFLOP/s',
+            'vs_baseline': round(peak * 1e12 / V5E_BF16_FLOPS, 3),
+            'samples': samples},
+        hbm['metric']: {k: hbm[k] for k in
+                        ('value', 'unit', 'vs_baseline')},
+    }
     return result
 
 

@@ -407,13 +407,16 @@ class _CachedGraph:
             with self._lock:
                 # re-check under the lock: a concurrent clear()
                 # (re-hybridize/cast while serving) may have emptied the
-                # cache since the unlocked _ready probe
+                # cache since the unlocked _ready probe. out_tree is
+                # snapshotted here too — _execute must not re-read the
+                # dict after the lock drops.
                 jfn = self._compiled.get(key)
+                out_tree = self._out_trees.get(key)
                 main_nds = [p.data() for p in main]
                 aux_raws = tuple(p.data()._data for p in aux)
-            if jfn is not None:
+            if jfn is not None and out_tree is not None:
                 return self._execute(args, key, jfn, in_nds, main_nds,
-                                     aux_raws)
+                                     aux_raws, out_tree)
         with self._lock:
             if key not in self._compiled:
                 self._compiled[key] = self._build(key, train_mode,
@@ -422,11 +425,12 @@ class _CachedGraph:
             main_nds = [p.data() for p in main]
             aux_raws = tuple(p.data()._data for p in aux)
             out = self._execute(args, key, jfn, in_nds, main_nds,
-                                aux_raws)
+                                aux_raws, None)
             self._ready.add(key)
             return out
 
-    def _execute(self, args, key, jfn, in_nds, main_nds, aux_raws):
+    def _execute(self, args, key, jfn, in_nds, main_nds, aux_raws,
+                 out_tree):
         import jax
         from ..ops.registry import Op, apply_op, DynamicShapeError
 
@@ -475,7 +479,11 @@ class _CachedGraph:
                         p._data[c]._rebind(v._data)
                     # aux outputs never need grad linkage
                     v._ag = None
-        out = jax.tree.unflatten(self._out_trees[key], list(out_vals))
+        if out_tree is None:
+            # locked path: the tree was written during this call's trace
+            # and the caller still holds the graph lock
+            out_tree = self._out_trees[key]
+        out = jax.tree.unflatten(out_tree, list(out_vals))
         for cb in self._monitor_callbacks:
             cb(self.block, out)
         return out

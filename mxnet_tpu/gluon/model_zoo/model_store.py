@@ -1,0 +1,179 @@
+"""Local pretrained-weight store + universal checkpoint importer.
+
+Reference: ``python/mxnet/gluon/model_zoo/model_store.py:31`` — a
+sha1-pinned download zoo (``get_model_file`` fetches
+``<name>-<sha1[:8]>.params`` from the MXNet S3 bucket). This
+environment is zero-egress, so the store resolves LOCAL files instead,
+and goes further than the reference: any of four checkpoint formats
+imports into any zoo factory.
+
+``get_model(name, pretrained=...)`` accepts:
+
+* ``True`` — resolve ``$MXNET_HOME/models/<name>.<ext>`` (default root
+  ``~/.mxnet/models``, same layout as the reference's cache dir) over
+  the supported extensions;
+* a path string — import that file directly.
+
+Supported formats (sniffed by extension, then content):
+
+* native params map (``Block.save_parameters`` / ``mx.nd.save`` npz);
+* any raw numpy ``.npz`` archive;
+* ``.safetensors`` (HuggingFace-style tensor map);
+* torch ``.pt``/``.pth`` state_dict (torchvision weights) — loaded
+  with ``weights_only=True`` so no pickled code executes.
+
+Key matching, in order: exact structural names; suffix-normalized names
+(dots/double-underscores unified, common framework prefixes stripped);
+finally positional order with exact shape agreement — valid because an
+architecturally identical checkpoint enumerates parameters in
+construction order on both sides (torch state_dicts drop the
+``num_batches_tracked`` bookkeeping on read so the counts line up; the
+torch BN weight/bias at position k are gluon's gamma/beta at the same
+position). A mismatch raises with a summary of what matched instead of
+silently leaving random weights.
+"""
+
+import os as _os
+import re as _re
+
+import numpy as _onp
+
+_EXTS = ('.params.npz', '.params', '.npz', '.safetensors', '.pt', '.pth')
+
+
+def get_model_file(name, root=None):
+    """Resolve a local weights file for ``name`` (reference
+    model_store.get_model_file, minus the download)."""
+    root = _os.path.expanduser(root or _os.path.join(
+        _os.environ.get('MXNET_HOME', '~/.mxnet'), 'models'))
+    for ext in _EXTS:
+        path = _os.path.join(root, name + ext)
+        if _os.path.exists(path):
+            return path
+    raise FileNotFoundError(
+        f'no local pretrained weights for {name!r} under {root} '
+        f'(tried {", ".join(_EXTS)}); place a checkpoint there or pass '
+        f'pretrained=<path> (zero-egress: the reference would download '
+        f'from the model store here)')
+
+
+def read_checkpoint(path):
+    """Load any supported checkpoint into {name: numpy array}."""
+    low = str(path).lower()
+    if low.endswith('.safetensors'):
+        from safetensors.numpy import load_file
+        return dict(load_file(path))
+    if low.endswith(('.pt', '.pth')):
+        import torch
+        state = torch.load(path, map_location='cpu', weights_only=True)
+        if hasattr(state, 'state_dict'):
+            state = state.state_dict()
+        out = {}
+        for k, v in state.items():
+            if k.endswith('num_batches_tracked'):
+                # torch BatchNorm bookkeeping with no gluon counterpart;
+                # keeping it would break the position+shape fallback
+                continue
+            if hasattr(v, 'detach'):
+                t = v.detach().cpu()
+                if t.dtype == torch.bfloat16:
+                    t = t.float()       # numpy has no native bfloat16
+                out[k] = t.numpy()
+        return out
+    # npz family (native map or raw archive)
+    with _onp.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files if not k.startswith('__mx')}
+
+
+def _norm(name):
+    """Normalize a parameter name to a comparable suffix form."""
+    n = name.replace('__', '.').replace('_', '.')
+    n = _re.sub(r'^(module|model|net|features|backbone)\.', '', n)
+    return n
+
+
+def match_params(targets, source, allow_missing=False):
+    """Map checkpoint entries onto structural parameter names.
+
+    ``targets``: {structural_name: Parameter}; ``source``:
+    {name: ndarray}. Returns {structural_name: ndarray}.
+    """
+    out = {}
+    # pass 1: exact names
+    for name in targets:
+        if name in source:
+            out[name] = source[name]
+    if len(out) == len(targets):
+        return out
+    # pass 2: normalized-suffix match (unique suffixes only)
+    tnorm = {name: _norm(name) for name in targets if name not in out}
+    snorm = {}
+    for k in source:
+        snorm.setdefault(_norm(k), []).append(k)
+    for name, nn in tnorm.items():
+        cands = snorm.get(nn, [])
+        if len(cands) == 1:
+            out[name] = source[cands[0]]
+    if len(out) == len(targets):
+        return out
+    # pass 3: positional with exact shape agreement — valid when the
+    # architectures are identical and only naming schemes differ
+    remaining_t = [n for n in targets if n not in out]
+    used = {id(v) for v in out.values()}
+    remaining_s = [k for k in source if id(source[k]) not in used
+                   and k not in out]
+    if len(remaining_t) == len(remaining_s):
+        pairs = []
+        ok = True
+        for tn, sn in zip(remaining_t, remaining_s):
+            tshape = tuple(targets[tn].shape or ())
+            known = tshape and all(d for d in tshape)
+            if known and tuple(source[sn].shape) != tshape:
+                ok = False
+                break
+            pairs.append((tn, sn))
+        if ok:
+            for tn, sn in pairs:
+                out[tn] = source[sn]
+            return out
+    if allow_missing:
+        return out
+    missing = [n for n in targets if n not in out]
+    raise ValueError(
+        f'pretrained import matched {len(out)}/{len(targets)} '
+        f'parameters; unmatched: {missing[:5]}{"..." if len(missing) > 5 else ""} '
+        f'(checkpoint has {len(source)} entries). Pass a checkpoint '
+        'for this architecture, or allow_missing=True.')
+
+
+def apply_pretrained(net, pretrained, name, ctx=None, root=None):
+    """Load pretrained weights into a freshly-built zoo net.
+
+    ``pretrained``: True (resolve from the local store root) or a path.
+    Called by every vision factory; a no-op when ``pretrained`` is
+    falsy so factories can pass it straight through."""
+    if not pretrained:
+        return net
+    from ...ndarray.ndarray import NDArray
+    path = pretrained if isinstance(pretrained, (str, _os.PathLike)) \
+        else get_model_file(name, root)
+    source = read_checkpoint(path)
+    if not net._initialized_once():
+        net.initialize(ctx=ctx)
+    params = net.collect_params()
+    matched = match_params(params, source)
+    for pname, arr in matched.items():
+        p = params[pname]
+        if isinstance(arr, NDArray):
+            p.set_data(arr)
+        else:
+            a = _onp.asarray(arr)
+            want = tuple(p.shape or ())
+            # dims still 0 are deferred-unknown; set_data resolves them
+            if want and all(d for d in want) and tuple(a.shape) != want:
+                raise ValueError(
+                    f'{pname}: checkpoint shape {a.shape} != parameter '
+                    f'shape {want} ({path})')
+            from ...ndarray.ndarray import array
+            p.set_data(array(a))
+    return net

@@ -35,4 +35,6 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
-    return AlexNet(**kwargs)
+    from ..model_store import apply_pretrained
+    return apply_pretrained(AlexNet(**kwargs), pretrained, 'alexnet',
+                            ctx, root)

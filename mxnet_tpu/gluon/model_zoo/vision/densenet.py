@@ -82,10 +82,12 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  201: (64, 32, [6, 12, 48, 32])}
 
 
-def _get(num_layers, **kwargs):
-    kwargs.pop('pretrained', None)
+def _get(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
+    from ..model_store import apply_pretrained
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    return apply_pretrained(net, pretrained, f'densenet{num_layers}',
+                            ctx, root)
 
 
 def densenet121(**kw):

@@ -144,6 +144,7 @@ class Inception3(HybridBlock):
         return self.output(self.features(x))
 
 
-def inception_v3(**kwargs):
-    kwargs.pop('pretrained', None)
-    return Inception3(**kwargs)
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(Inception3(**kwargs), pretrained,
+                            'inceptionv3', ctx, root)

@@ -105,41 +105,49 @@ class MobileNetV2(HybridBlock):
         return self.output(self.features(x))
 
 
-def mobilenet1_0(**kw):
-    kw.pop('pretrained', None)
-    return MobileNet(1.0, **kw)
+def mobilenet1_0(pretrained=False, ctx=None, root=None, **kw):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(MobileNet(1.0, **kw), pretrained,
+                            'mobilenet1.0', ctx, root)
 
 
-def mobilenet0_75(**kw):
-    kw.pop('pretrained', None)
-    return MobileNet(0.75, **kw)
+def mobilenet0_75(pretrained=False, ctx=None, root=None, **kw):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(MobileNet(0.75, **kw), pretrained,
+                            'mobilenet0.75', ctx, root)
 
 
-def mobilenet0_5(**kw):
-    kw.pop('pretrained', None)
-    return MobileNet(0.5, **kw)
+def mobilenet0_5(pretrained=False, ctx=None, root=None, **kw):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(MobileNet(0.5, **kw), pretrained,
+                            'mobilenet0.5', ctx, root)
 
 
-def mobilenet0_25(**kw):
-    kw.pop('pretrained', None)
-    return MobileNet(0.25, **kw)
+def mobilenet0_25(pretrained=False, ctx=None, root=None, **kw):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(MobileNet(0.25, **kw), pretrained,
+                            'mobilenet0.25', ctx, root)
 
 
-def mobilenet_v2_1_0(**kw):
-    kw.pop('pretrained', None)
-    return MobileNetV2(1.0, **kw)
+def mobilenet_v2_1_0(pretrained=False, ctx=None, root=None, **kw):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(MobileNetV2(1.0, **kw), pretrained,
+                            'mobilenetv2_1.0', ctx, root)
 
 
-def mobilenet_v2_0_75(**kw):
-    kw.pop('pretrained', None)
-    return MobileNetV2(0.75, **kw)
+def mobilenet_v2_0_75(pretrained=False, ctx=None, root=None, **kw):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(MobileNetV2(0.75, **kw), pretrained,
+                            'mobilenetv2_0.75', ctx, root)
 
 
-def mobilenet_v2_0_5(**kw):
-    kw.pop('pretrained', None)
-    return MobileNetV2(0.5, **kw)
+def mobilenet_v2_0_5(pretrained=False, ctx=None, root=None, **kw):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(MobileNetV2(0.5, **kw), pretrained,
+                            'mobilenetv2_0.5', ctx, root)
 
 
-def mobilenet_v2_0_25(**kw):
-    kw.pop('pretrained', None)
-    return MobileNetV2(0.25, **kw)
+def mobilenet_v2_0_25(pretrained=False, ctx=None, root=None, **kw):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(MobileNetV2(0.25, **kw), pretrained,
+                            'mobilenetv2_0.25', ctx, root)

@@ -249,14 +249,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
-    if pretrained:
-        import os
-        from ....gluon.block import Block  # noqa
-        path = os.path.join(
-            root or os.path.expanduser('~/.mxnet/models'),
-            f'resnet{num_layers}_v{version}.params.npz')
-        net.load_parameters(path, ctx=ctx)
-    return net
+    from ..model_store import apply_pretrained
+    return apply_pretrained(net, pretrained,
+                            f'resnet{num_layers}_v{version}', ctx, root)
 
 
 def resnet18_v1(**kwargs):

@@ -75,11 +75,13 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def squeezenet1_0(**kwargs):
-    kwargs.pop('pretrained', None)
-    return SqueezeNet('1.0', **kwargs)
+def squeezenet1_0(pretrained=False, ctx=None, root=None, **kwargs):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(SqueezeNet('1.0', **kwargs), pretrained,
+                            'squeezenet1.0', ctx, root)
 
 
-def squeezenet1_1(**kwargs):
-    kwargs.pop('pretrained', None)
-    return SqueezeNet('1.1', **kwargs)
+def squeezenet1_1(pretrained=False, ctx=None, root=None, **kwargs):
+    from ..model_store import apply_pretrained
+    return apply_pretrained(SqueezeNet('1.1', **kwargs), pretrained,
+                            'squeezenet1.1', ctx, root)

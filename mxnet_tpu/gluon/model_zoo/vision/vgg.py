@@ -46,8 +46,11 @@ class VGG(HybridBlock):
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
+    from ..model_store import apply_pretrained
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    bn = '_bn' if kwargs.get('batch_norm') else ''
+    return apply_pretrained(VGG(layers, filters, **kwargs), pretrained,
+                            f'vgg{num_layers}{bn}', ctx, root)
 
 
 def vgg11(**kwargs):

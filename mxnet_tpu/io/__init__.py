@@ -284,21 +284,32 @@ class PrefetchingIter(DataIter):
         stop = threading.Event()
 
         def worker():
+            fail = None
             try:
                 while not stop.is_set():
                     try:
                         batches = [next(it) for it in self.iters]
                     except StopIteration:
                         break
-                    q.put(self._place(self._merge(batches)))
+                    except Exception as e:      # noqa: BLE001
+                        fail = e
+                        break
+                    try:
+                        q.put(self._place(self._merge(batches)))
+                    except Exception as e:      # placement (cast/device
+                        fail = e                # transfer) failed
+                        break
             finally:
+                # a worker failure must surface at the consumer's next(),
+                # not masquerade as a clean end-of-epoch
+                sentinel = fail if fail is not None else None
                 if stop.is_set():
                     try:                    # reset drains the old queue;
-                        q.put_nowait(None)  # never block the dying worker
+                        q.put_nowait(sentinel)  # never block a dying worker
                     except Exception:
                         pass
                 else:
-                    q.put(None)             # normal exhaustion: consumer
+                    q.put(sentinel)         # normal exhaustion: consumer
                                             # is still draining, put blocks
                                             # at most until the next get()
 
@@ -335,6 +346,9 @@ class PrefetchingIter(DataIter):
         if batch is None:
             self._done = True           # exhausted: further next() raises
             raise StopIteration
+        if isinstance(batch, Exception):
+            self._done = True           # worker died: re-raise here
+            raise batch
         return batch
 
     next = __next__

@@ -358,18 +358,51 @@ class KVStoreDistAsync(KVStoreBase):
         if self._hb_thread is None:
             interval = float(os.environ.get('MXNET_KVSTORE_HEARTBEAT_S',
                                             '2'))
+            self._hb_stop = threading.Event()
+            # weakref: a strong self in the closure would keep the store
+            # alive forever (thread references closure references store),
+            # so __del__->close could never run for abandoned stores
+            import weakref
+            wself = weakref.ref(self)
+            stop = self._hb_stop
 
             def beat():
-                import time
-                while True:
-                    time.sleep(interval)
+                while not stop.wait(interval):
+                    st = wself()
+                    if st is None:
+                        return        # store collected
                     try:
-                        self._rpc_to(0, {'cmd': 'ping'})
+                        st._rpc_to(0, {'cmd': 'ping'})
                     except Exception:
                         return        # job shutting down
+                    del st
 
             self._hb_thread = threading.Thread(target=beat, daemon=True)
             self._hb_thread.start()
+
+    def close(self):
+        """Stop the heartbeat thread and close this store's server
+        connections (the server threads themselves are shared per-port
+        and stay up for other stores in the process). Safe to call more
+        than once; also invoked by __del__ so an abandoned store does
+        not pin sockets and a pinger for the process lifetime."""
+        hb = getattr(self, '_hb_thread', None)
+        if hb is not None:
+            self._hb_stop.set()
+            self._hb_thread = None
+        for sid, sock in list(self._socks.items()):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._socks.clear()
+        self._sock_locks.clear()
+
+    def __del__(self):                  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _rpc_to(self, sid, header, payload=b''):
         header['rank'] = self._rank

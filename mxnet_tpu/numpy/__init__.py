@@ -161,5 +161,23 @@ def _build_sub_namespaces():
     random.seed = staticmethod(_seed)
     linalg.norm = staticmethod(_reg.make_frontend('linalg_norm'))
 
+    _sample_multinomial = _reg.make_frontend('random_multinomial')
+
+    def _np_multinomial(n, pvals, size=None):
+        """numpy-semantics multinomial (reference numpy/random.py:375):
+        counts of each of the p outcomes over ``n`` trials. The
+        index-sampling variant (reference npx
+        sample_multinomial_op.cc) remains ``npx.random.multinomial``/
+        ``sample_multinomial``."""
+        shp = () if size is None else (
+            (size,) if isinstance(size, int) else tuple(size))
+        p = array(pvals) if not isinstance(pvals, NDArray) else pvals
+        k = p.shape[-1]
+        idx = _sample_multinomial(p, shape=shp + (int(n),))
+        from .. import npx
+        return npx.one_hot(idx, k).sum(axis=-2).astype('int64')
+
+    random.multinomial = staticmethod(_np_multinomial)
+
 
 _build_sub_namespaces()

@@ -5,14 +5,22 @@ register:710) backed by ``src/operator/custom/custom-inl.h:52-136`` — user
 ops run on a dedicated async worker so arbitrary Python can't stall the
 engine.
 
-TPU re-design: a custom op executes eagerly in-process (JAX's async
-dispatch already keeps the device busy; there is no engine thread to
-stall). Autograd wires ``backward`` in as a custom VJP on the tape — the
-same mechanism as ``autograd.Function``. If the op body is jax-traceable
-it also works under ``hybridize()``; if it calls host code (numpy etc.) it
-stays an eager-only island, matching the reference where custom ops break
-graph fusion (custom-inl.h dedicated worker).
+TPU re-design: like the reference, the user's ``forward`` runs on a
+DEDICATED worker thread (custom-inl.h:52 ``CustomOperator`` keeps its
+own task queue precisely so arbitrary Python cannot stall the engine):
+``custom()`` enqueues the op and immediately returns *pending* NDArrays
+(shape/dtype from ``infer_shape``/``infer_type``); touching a result is
+a sync point that waits for the worker and re-raises any exception the
+user code threw — the engine's exception-at-sync-point contract
+(threaded_engine.h:365). Ops execute in push order (FIFO, one worker,
+matching the reference's per-op serial queue). Autograd wires
+``backward`` in as a custom VJP on the tape — the same mechanism as
+``autograd.Function``. If the op body calls host code (numpy etc.) it
+stays an eager-only island, matching the reference where custom ops
+break graph fusion.
 """
+
+import threading as _threading
 
 import numpy as _np
 
@@ -20,6 +28,63 @@ from . import _tape
 from .ndarray.ndarray import NDArray
 
 _REGISTRY = {}
+
+
+class _Worker:
+    """The dedicated custom-op worker thread (reference
+    CustomOperator::GetSharedRef()->Push, custom-inl.h:52-136)."""
+
+    _instance = None
+    _lock = _threading.Lock()
+
+    def __init__(self):
+        import queue
+        self._q = queue.Queue()
+        self._t = _threading.Thread(target=self._run, daemon=True,
+                                    name='mxnet-custom-op-worker')
+        self._t.start()
+
+    @classmethod
+    def get(cls):
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def _run(self):
+        while True:
+            task = self._q.get()
+            task()                  # task handles its own exceptions
+
+    def push(self, task):
+        self._q.put(task)
+
+
+class _PendingCustom:
+    """Duck-typed 'segment' for :class:`_bulk.LazyRef`: materializing a
+    custom op's output waits for the worker task and re-raises the user
+    exception at the sync point."""
+
+    def __init__(self, op_type):
+        self._done = _threading.Event()
+        self._exc = None
+        self._op_type = op_type
+        self.refs = []
+
+    def flush(self):
+        self._done.wait()
+        if self._exc is not None:
+            raise RuntimeError(
+                f'custom op {self._op_type!r} failed on the worker '
+                f'thread (reference: exception routed to the waiting '
+                f'sync point)') from self._exc
+
+    def complete(self, values, exc=None):
+        if exc is None:
+            for ref, v in zip(self.refs, values):
+                ref.value = v
+        self._exc = exc
+        self._done.set()
 
 
 class CustomOp:
@@ -110,6 +175,7 @@ def custom(*args, op_type=None, **kwargs):
     ctx = current_context()
     op = prop.create_operator(ctx, in_shapes, [str(t) for t in in_types])
 
+    import jax
     import jax.numpy as jnp
     out_data = [NDArray(jnp.zeros(tuple(s), dtype=_np.dtype(t)))
                 for s, t in zip(out_shapes, out_types)]
@@ -118,16 +184,42 @@ def custom(*args, op_type=None, **kwargs):
 
     recording = _tape.is_recording() and _tape._needs_grad(in_data)
     is_train = recording and _tape.is_training()
-    prev = _tape.set_recording(False)
-    try:
-        op.forward(is_train=is_train, req=['write'] * len(out_data),
-                   in_data=in_data, out_data=out_data, aux=aux)
-    finally:
-        _tape.set_recording(prev)
+
+    # Async dispatch (reference CustomOperator::Push): the user forward
+    # runs on the dedicated worker; the caller gets pending NDArrays
+    # whose materialization is the sync point.
+    from . import _bulk
+    out_avals = [jax.ShapeDtypeStruct(tuple(s), _np.dtype(t))
+                 for s, t in zip(out_shapes, out_types)]
+    pend = _PendingCustom(op_type)
+    results = []
+    for aval in out_avals:
+        ref = _bulk.LazyRef(pend, None, aval)
+        nd = NDArray(None, ctx=ctx)
+        nd._lazy = ref
+        pend.refs.append(ref)
+        results.append(nd)
+
+    # snapshot input VALUES on the calling thread — the reference engine
+    # gives the pushed op read-deps on its inputs; without this, an
+    # in-place write (x[:] = 0, a trainer step rebinding a weight) after
+    # custom() returns would race the worker's read
+    work_in = [NDArray(x._data, ctx=getattr(x, '_ctx', None))
+               for x in in_data]
+
+    def _task():
+        try:
+            # the worker thread's own tape state is thread-local and
+            # off by default — user forward code never re-records
+            op.forward(is_train=is_train, req=['write'] * len(out_data),
+                       in_data=work_in, out_data=out_data, aux=aux)
+            pend.complete([o._data for o in out_data])
+        except Exception as e:      # route to the caller's sync point
+            pend.complete(None, exc=e)
+
+    _Worker.get().push(_task)
 
     if recording:
-        import jax
-
         def _fn(*raws):
             return tuple(o._data for o in out_data)
 
@@ -135,29 +227,30 @@ def custom(*args, op_type=None, **kwargs):
             _fn, [x._data for x in in_data],
             [getattr(x, '_ag', None) for x in in_data],
             len(out_data), f'Custom[{op_type}]',
-            out_avals=[jax.typeof(o._data) for o in out_data],
+            out_avals=out_avals,
             multi=len(out_data) > 1)
 
         def _custom_vjp(cots):
             if not isinstance(cots, (tuple, list)):
                 cots = (cots,)
+            pend.flush()            # backward needs the forward's outputs
             in_grad = [NDArray(jnp.zeros(a.shape, dtype=a.dtype))
                        for a in in_data]
             prev = _tape.set_recording(False)
             try:
                 op.backward(req=['write'] * len(in_grad),
                             out_grad=[NDArray(c) for c in cots],
-                            in_data=in_data, out_data=out_data,
+                            in_data=work_in, out_data=out_data,
                             in_grad=in_grad, aux=aux)
             finally:
                 _tape.set_recording(prev)
             return tuple(g._data for g in in_grad)
 
         node.vjp_fn = _custom_vjp
-        for i, o in enumerate(out_data):
+        for i, o in enumerate(results):
             o._ag = _tape.AGInfo(node=node, index=i)
 
-    return out_data[0] if len(out_data) == 1 else tuple(out_data)
+    return results[0] if len(results) == 1 else tuple(results)
 
 
 Custom = custom

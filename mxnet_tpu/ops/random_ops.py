@@ -137,8 +137,11 @@ def logistic(loc=0.0, scale=1.0, size=None, dtype='float32', key=None):
 @register('random_pareto', stochastic=True, differentiable=False,
           aliases=('pareto',))
 def pareto(a, size=None, dtype='float32', key=None):
+    # numpy/reference semantics are Pareto II (Lomax): samples from the
+    # CLASSICAL Pareto minus 1 (numpy.random.pareto docstring; reference
+    # python/mxnet/numpy/random.py:665). jax.random.pareto is classical.
     shp = _shape(size, a)
-    return jax.random.pareto(key, a, shape=shp, dtype=dtype)
+    return jax.random.pareto(key, a, shape=shp, dtype=dtype) - 1.0
 
 
 @register('random_power', stochastic=True, differentiable=False,

@@ -78,3 +78,107 @@ def test_rtc_unknown_kernel():
     mod = mx.rtc.PallasModule('def k(a_ref, o_ref):\n    o_ref[...] = a_ref[...]\n')
     with pytest.raises(KeyError):
         mod.get_kernel('nope')
+
+
+def test_custom_op_runs_on_worker_async():
+    """Reference custom-inl.h:52: the user forward runs on a dedicated
+    worker; custom() returns immediately with pending outputs and
+    results materialize at the sync point."""
+    import threading
+    import time
+
+    started = threading.Event()
+    release = threading.Event()
+
+    @mx.operator.register('slow_scale')
+    class SlowScaleProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ['data']
+
+        def list_outputs(self):
+            return ['out']
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            outer_started, outer_release = started, release
+
+            class SlowScale(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    outer_started.set()
+                    assert outer_release.wait(timeout=30)
+                    self.assign(out_data[0], req[0], in_data[0] * 3.0)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 3.0)
+            return SlowScale()
+
+    x = mx.np.array([1.0, 2.0])
+    t0 = time.perf_counter()
+    y = mx.nd.Custom(x, op_type='slow_scale')
+    issued = time.perf_counter() - t0
+    # the call returned while the user forward is still blocked
+    assert started.wait(timeout=10)
+    assert issued < 5.0
+    assert y.shape == (2,)                  # shape known pre-sync
+    release.set()
+    onp.testing.assert_allclose(y.asnumpy(), [3.0, 6.0])
+
+
+def test_custom_op_exception_routed_to_sync_point():
+    """User-code exceptions surface when the result is awaited, not at
+    dispatch (threaded_engine.h:365 exception-at-sync-point)."""
+    @mx.operator.register('boom_op')
+    class BoomProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ['data']
+
+        def list_outputs(self):
+            return ['out']
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Boom(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    raise ValueError('user forward exploded')
+            return Boom()
+
+    y = mx.nd.Custom(mx.np.ones((2,)), op_type='boom_op')  # no raise here
+    with pytest.raises(RuntimeError, match='boom_op'):
+        y.asnumpy()
+
+
+def test_custom_op_fifo_chaining():
+    """Two custom ops where the second consumes the first's pending
+    output: FIFO worker order makes the chain correct without any
+    explicit wait."""
+    @mx.operator.register('plus_one')
+    class PlusOneProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ['data']
+
+        def list_outputs(self):
+            return ['out']
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class PlusOne(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] + 1.0)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+            return PlusOne()
+
+    x = mx.np.zeros((3,))
+    y = x
+    for _ in range(5):
+        y = mx.nd.Custom(y, op_type='plus_one')
+    onp.testing.assert_allclose(y.asnumpy(), [5.0, 5.0, 5.0])
