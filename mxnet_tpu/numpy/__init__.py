@@ -171,9 +171,19 @@ def _build_sub_namespaces():
         ``sample_multinomial``."""
         shp = () if size is None else (
             (size,) if isinstance(size, int) else tuple(size))
-        p = array(pvals) if not isinstance(pvals, NDArray) else pvals
+        # numpy's contract: the LAST category absorbs the remaining
+        # probability mass (sum(pvals[:-1]) must be <= 1) — no silent
+        # renormalization of short/unnormalized pvals
+        p = _onp.asarray(pvals.asnumpy() if isinstance(pvals, NDArray)
+                         else pvals, dtype='float64')
+        head = float(p[..., :-1].sum(-1).max()) if p.shape[-1] > 1 else 0.0
+        if head > 1.0 + 1e-12:
+            raise ValueError('sum(pvals[:-1]) > 1.0')
+        p = p.copy()
+        p[..., -1] = 1.0 - p[..., :-1].sum(-1)
         k = p.shape[-1]
-        idx = _sample_multinomial(p, shape=shp + (int(n),))
+        idx = _sample_multinomial(array(p.astype('float32')),
+                                  shape=shp + (int(n),))
         from .. import npx
         return npx.one_hot(idx, k).sum(axis=-2).astype('int64')
 

@@ -200,15 +200,33 @@ def custom(*args, op_type=None, **kwargs):
         pend.refs.append(ref)
         results.append(nd)
 
-    # snapshot input VALUES on the calling thread — the reference engine
-    # gives the pushed op read-deps on its inputs; without this, an
-    # in-place write (x[:] = 0, a trainer step rebinding a weight) after
-    # custom() returns would race the worker's read
-    work_in = [NDArray(x._data, ctx=getattr(x, '_ctx', None))
-               for x in in_data]
+    # Read-dependencies at dispatch time (reference engine read-deps on
+    # the pushed op): CONCRETE inputs are snapshotted by value NOW, so
+    # an in-place write (x[:] = 0, a trainer step rebinding a weight)
+    # after custom() returns cannot race the worker's read. PENDING
+    # inputs (another custom op's output, a bulked segment value) are
+    # snapshotted by their LazyRef — resolving them is deferred to the
+    # worker so chained custom() calls never block the dispatch thread;
+    # FIFO guarantees an earlier custom op's value is already set, and
+    # a bulk segment flush is thread-safe.
+    snaps = []
+    for x in in_data:
+        ref = x._lazy
+        cx = getattr(x, '_ctx', None)
+        if ref is not None and ref.value is None:
+            snaps.append((ref, None, cx))
+        else:
+            snaps.append((None, x._data, cx))
 
     def _task():
         try:
+            work_in = []
+            for ref, raw, cx in snaps:
+                if ref is not None:
+                    if ref.value is None and ref.seg is not None:
+                        ref.seg.flush()
+                    raw = ref.value
+                work_in.append(NDArray(raw, ctx=cx))
             # the worker thread's own tape state is thread-local and
             # off by default — user forward code never re-records
             op.forward(is_train=is_train, req=['write'] * len(out_data),
@@ -234,6 +252,8 @@ def custom(*args, op_type=None, **kwargs):
             if not isinstance(cots, (tuple, list)):
                 cots = (cots,)
             pend.flush()            # backward needs the forward's outputs
+            work_in = [NDArray(ref.value if ref is not None else raw,
+                               ctx=cx) for ref, raw, cx in snaps]
             in_grad = [NDArray(jnp.zeros(a.shape, dtype=a.dtype))
                        for a in in_data]
             prev = _tape.set_recording(False)

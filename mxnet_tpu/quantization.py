@@ -155,8 +155,15 @@ def calib_table(collectors, mode='entropy'):
 class _QuantizedLayer(HybridBlock):
     """Shared int8 state: quantized weight + scales + input calib range."""
 
-    def __init__(self, float_layer, in_min, in_max, **kwargs):
+    def __init__(self, float_layer, in_min, in_max,
+                 activation_dtype='bfloat16', **kwargs):
         super().__init__(**kwargs)
+        # inter-layer activations leave in this dtype: bf16 halves the
+        # HBM bytes between layers vs f32 — on a bandwidth-bound device
+        # an f32-activation int8 net is SLOWER than the bf16 float net
+        # (r4 roofline analysis, docs/perf_resnet.md); the int32->float
+        # rescale still happens in f32 before the downcast
+        self._act_dtype = jnp.dtype(activation_dtype)
         w = float_layer.weight.data()._data.astype(jnp.float32)
         amax = float(jnp.max(jnp.abs(w)))
         self._w_scale = float(range_to_scale(-amax, amax))
@@ -201,7 +208,7 @@ class QuantizedDense(_QuantizedLayer):
         out = acc.astype(jnp.float32) * (self._x_scale * self._w_scale)
         if self._has_bias:
             out = out + self.bias.data()._data
-        out = NDArray(out)
+        out = NDArray(out.astype(self._act_dtype))
         if self.act is not None:
             out = self.act(out)
         return out
@@ -239,7 +246,7 @@ class QuantizedConv2D(_QuantizedLayer):
             bshape = [1] * out.ndim
             bshape[self._layout.index('C')] = -1
             out = out + self.bias.data()._data.reshape(bshape)
-        out = NDArray(out)
+        out = NDArray(out.astype(self._act_dtype))
         if self.act is not None:
             out = self.act(out)
         return out
@@ -265,7 +272,8 @@ def _walk(block, prefix=''):
 
 def quantize_net(net, calib_data=None, calib_mode='entropy',
                  quantized_dtype='int8', exclude_layers=None,
-                 num_calib_batches=None, logger=None):
+                 num_calib_batches=None, logger=None,
+                 activation_dtype='bfloat16'):
     """Quantize a trained network for int8 inference.
 
     The reference flow (quantize_graph_pass.cc + calibrate.cc): insert
@@ -337,7 +345,8 @@ def quantize_net(net, calib_data=None, calib_mode='entropy',
                                'kept in float', path)
             continue
         lo, hi = table[path]
-        qlayer = _quantizable(child)(child, lo, hi)
+        qlayer = _quantizable(child)(child, lo, hi,
+                                     activation_dtype=activation_dtype)
         if parent is None:
             result = qlayer  # root swap happens via the return value
             continue

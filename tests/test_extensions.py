@@ -153,9 +153,11 @@ def test_custom_op_exception_routed_to_sync_point():
 
 
 def test_custom_op_fifo_chaining():
-    """Two custom ops where the second consumes the first's pending
-    output: FIFO worker order makes the chain correct without any
-    explicit wait."""
+    """Chained custom ops: the second consumes the first's PENDING
+    output. Dispatch must not block (the pending input is snapshotted
+    by LazyRef and resolved on the worker, where FIFO order guarantees
+    the earlier op's value is already set)."""
+    import time
     @mx.operator.register('plus_one')
     class PlusOneProp(mx.operator.CustomOpProp):
         def list_arguments(self):
@@ -170,6 +172,7 @@ def test_custom_op_fifo_chaining():
         def create_operator(self, ctx, in_shapes, in_dtypes):
             class PlusOne(mx.operator.CustomOp):
                 def forward(self, is_train, req, in_data, out_data, aux):
+                    time.sleep(0.1)
                     self.assign(out_data[0], req[0], in_data[0] + 1.0)
 
                 def backward(self, req, out_grad, in_data, out_data,
@@ -179,6 +182,11 @@ def test_custom_op_fifo_chaining():
 
     x = mx.np.zeros((3,))
     y = x
+    t0 = time.perf_counter()
     for _ in range(5):
         y = mx.nd.Custom(y, op_type='plus_one')
+    issued = time.perf_counter() - t0
+    # 5 chained dispatches of a 0.1s op: dispatching must not serialize
+    # on the worker (a blocking snapshot would take >= 0.4s here)
+    assert issued < 0.3, f'chained dispatch blocked: {issued:.2f}s'
     onp.testing.assert_allclose(y.asnumpy(), [5.0, 5.0, 5.0])
