@@ -92,6 +92,14 @@ def _timed_reps(run_once, reps=3, max_reps=8, spread_target=0.15):
     ``(times_fast3, all_times)`` — report min(all) as the value and the
     fast-cluster spread as timing_spread.
     """
+    # Contention adaptation (VERDICT r4 weak #2): spread-triggered
+    # retries LENGTHEN the run exactly when the host is slowest. The
+    # suite parent caps retries for its children via this env var when
+    # loadavg/ncpu is high at suite start.
+    try:
+        max_reps = min(max_reps, int(os.environ['MXNET_BENCH_MAX_REPS']))
+    except (KeyError, ValueError):
+        pass
     times = []
     while True:
         t0 = time.perf_counter()
@@ -434,17 +442,22 @@ def bench_resnet_train(args, mx):
     # different segment plans than an M-step call — a short warmup left
     # multi-second compiles inside the "timed" window (r4 probe: 18.5 s
     # in one step), reporting the compiler instead of the engine
-    imp_iters = max(min(args.iters // 2, 10), 3)
+    skim = getattr(args, 'skim', False)
+    imp_iters = 6 if skim else max(min(args.iters // 2, 10), 3)
     train_steps(imp_iters, 0, dev_get)
     t0 = time.perf_counter()
     train_steps(imp_iters, 100, dev_get)
     imp_ips = B * imp_iters / (time.perf_counter() - t0)
 
-    hf_iters = max(imp_iters // 2, 6)
-    train_steps(hf_iters, 200, inline_get)
-    t0 = time.perf_counter()
-    train_steps(hf_iters, 300, inline_get)
-    imp_nopipe_ips = B * hf_iters / (time.perf_counter() - t0)
+    hf_iters = 4 if skim else max(imp_iters // 2, 6)
+    imp_nopipe_ips = None
+    if not skim:
+        # the r3 un-pipelined regime is a methodology comparison, not a
+        # headline number — skipped in suite mode (budget, VERDICT r4 #1)
+        train_steps(hf_iters, 200, inline_get)
+        t0 = time.perf_counter()
+        train_steps(hf_iters, 300, inline_get)
+        imp_nopipe_ips = B * hf_iters / (time.perf_counter() - t0)
 
     # host-feed through the framework's data path (PrefetchingIter,
     # ≙ reference iter_prefetcher.h): the dataset is stored in the
@@ -471,7 +484,7 @@ def bench_resnet_train(args, mx):
     imp_hf_ips = B * hf_iters / (time.perf_counter() - t0)
     pref.close()
 
-    return {
+    res = {
         'metric': f'resnet50_train_{args.dtype}_batch{B}',
         'value': round(ips, 2),
         'unit': 'img/s',
@@ -480,8 +493,10 @@ def bench_resnet_train(args, mx):
         'timing_spread': _spread(times),
         'imperative_img_s': round(imp_ips, 2),
         'imperative_hostfeed_img_s': round(imp_hf_ips, 2),
-        'imperative_hostfeed_nopipe_img_s': round(imp_nopipe_ips, 2),
     }
+    if imp_nopipe_ips is not None:
+        res['imperative_hostfeed_nopipe_img_s'] = round(imp_nopipe_ips, 2)
+    return res
 
 
 def bench_bert(args, mx):
@@ -820,25 +835,56 @@ def bench_train_aba(args, mx):
 
 def bench_suite(args):
     """Default driver entry: ResNet-50 TRAIN primary (A/B/A peak
-    protocol) + kvstore / inference / BERT / INT8 extras in one JSON
-    line. Every sub-bench runs in its OWN subprocess, sequentially —
+    protocol) + BERT / kvstore / inference / INT8 / llama extras.
+    Every sub-bench runs in its OWN subprocess, sequentially —
     round 3 ran them all in one process and the accumulated HBM killed
     the BERT and INT8 extras with RESOURCE_EXHAUSTED (VERDICT r3 weak
     #2); a fresh process starts from an empty device, and sequential
     children never contend for the single axon tunnel grant. This
     parent therefore must never import jax/mxnet_tpu itself: the grant
-    belongs to whichever child is running."""
+    belongs to whichever child is running.
+
+    Survivability contract (VERDICT r4 — round 4's artifact was
+    rc=124/parsed=null and every number died):
+      * STREAMING: the primary result line is printed to stdout the
+        moment train_aba returns, and the enriched line is re-printed
+        after EVERY extra. The driver parses the LAST parseable line,
+        so any kill point preserves everything already measured.
+      * BUDGET: default MXNET_BENCH_BUDGET_S=1140s, >=30% under the
+        ~25 min observed driver kill window (BENCH_r04 tail:
+        ~21:00->~21:22 of visible output before SIGKILL). The primary
+        gets frac=0.45, its retry frac=0.25, so even the worst case
+        (primary burns its slice then retries) leaves an extras window
+        inside the budget.
+      * CONTENTION: when loadavg/ncpu > 0.8 at suite start the iter
+        counts are halved and children's spread-triggered retries are
+        capped (MXNET_BENCH_MAX_REPS=4) — r4 ran the FULL protocol at
+        load 0.98 including retries that lengthen the run exactly when
+        the host is slowest. Each extra row carries its child's own
+        host_load + wall_s so cross-round comparisons are attributable.
+    """
     import subprocess
     t_start = time.perf_counter()
     try:
-        budget = float(os.environ.get('MXNET_BENCH_BUDGET_S', '2400'))
+        budget = float(os.environ.get('MXNET_BENCH_BUDGET_S', '1140'))
     except ValueError:
-        print('bad MXNET_BENCH_BUDGET_S; using 2400s', file=sys.stderr)
-        budget = 2400.0
+        print('bad MXNET_BENCH_BUDGET_S; using 1140s', file=sys.stderr)
+        budget = 1140.0
+
+    load = _warn_contention()
+    adapted = load is not None and load > 0.8
+    iters = args.iters
+    if adapted:
+        iters = max(iters // 2, 16)
+        os.environ['MXNET_BENCH_MAX_REPS'] = '4'
+        print(f'contention adaptation: iters {args.iters} -> {iters}, '
+              f'spread retries capped at 4 reps', file=sys.stderr)
+
+    def remaining():
+        return budget - (time.perf_counter() - t_start)
 
     def child(model, *extra_args, frac=1.0):
-        remaining = budget - (time.perf_counter() - t_start)
-        timeout_s = min(remaining, budget * frac)
+        timeout_s = min(remaining() - 20, budget * frac)
         if timeout_s < 60:
             raise RuntimeError('bench budget exhausted')
         cmd = [sys.executable, os.path.abspath(__file__),
@@ -847,47 +893,76 @@ def bench_suite(args):
                '--warmup', str(args.warmup)] + list(extra_args)
         if args.cpu:
             cmd.append('--cpu')
+        t0 = time.perf_counter()
         p = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout_s)
         sys.stderr.write(p.stderr)
         if p.returncode != 0:
             tail = ' | '.join((p.stderr or '').strip().splitlines()[-2:])
             raise RuntimeError(f'exit {p.returncode}: {tail}')
-        return json.loads(p.stdout.strip().splitlines()[-1])
+        r = json.loads(p.stdout.strip().splitlines()[-1])
+        r['wall_s'] = round(time.perf_counter() - t0, 1)
+        return r
 
-    # primary: A/B/A peak/train/peak — may use up to 60% of the budget,
-    # leaving a window for the extras even if it runs long
+    # primary: A/B/A peak/train/peak, slimmed (--skim drops the
+    # methodology-only imperative variants)
     try:
-        result = child('train_aba', '--iters', str(args.iters), frac=0.6)
+        result = child('train_aba', '--iters', str(iters), '--skim',
+                       frac=0.45)
     except Exception as e:
         print(f'primary train_aba child failed ({e!r}); retrying plain '
               f'train', file=sys.stderr)
-        result = child('resnet50_train', '--iters', str(args.iters),
-                       frac=0.5)
+        try:
+            result = child('resnet50_train', '--iters',
+                           str(max(iters // 2, 10)), '--skim', frac=0.25)
+        except Exception as e2:
+            print(f'train retry failed too ({e2!r}); falling back to '
+                  f'matmul peak so the artifact is non-empty',
+                  file=sys.stderr)
+            result = child('matmul_peak', '--iters', '10', frac=0.15)
     extras = result.pop('extras', {})
+    if load is not None:
+        result['host_load'] = load
+    if adapted:
+        result['contention_adapted'] = True
+    result['extras'] = extras
+    print(json.dumps(result), flush=True)      # stream: primary survives
 
-    def sub(name, model, *extra_args):
+    def sub(name, model, *extra_args, min_window=90):
+        if remaining() < min_window:
+            print(f'extra bench {name} skipped: {remaining():.0f}s left '
+                  f'< {min_window}s window', file=sys.stderr)
+            return
         try:
             r = child(model, *extra_args)
-            row = {k: r[k] for k in ('value', 'unit', 'vs_baseline')
-                   if k in r}
-            if 'timing_spread' in r:
-                row['timing_spread'] = r['timing_spread']
+            row = {k: r[k] for k in ('value', 'unit', 'vs_baseline',
+                                     'timing_spread', 'host_load',
+                                     'wall_s') if k in r}
             extras[r['metric']] = row
         except Exception as e:  # a broken extra must not kill the bench
             print(f'extra bench {name} failed: {e!r}', file=sys.stderr)
+            return
+        print(json.dumps(result), flush=True)  # stream after each extra
 
+    # BERT first: north-star metric with no parsed artifact since r2
+    # (VERDICT r4 missing #2) — a late kill must not take it again
+    sub('bert', 'bert_base', '--iters', str(max(iters // 5, 5)),
+        min_window=240)
     sub('kvstore', 'kvstore', '--iters', '10')
-    sub('resnet_infer', 'resnet50_v1', '--iters', str(args.iters))
-    sub('bert', 'bert_base', '--iters', str(max(args.iters // 5, 5)))
-    sub('int8', 'resnet50_int8',
-        '--iters', str(max(args.iters // 2, 10)))
+    sub('resnet_infer', 'resnet50_v1', '--iters', str(iters))
+    sub('int8', 'resnet50_int8', '--iters', str(max(iters // 2, 10)))
     ik = f'resnet50_int8_inference_batch{args.batch}'
     bk = f'resnet50_v1_inference_{args.dtype}_batch{args.batch}'
     if ik in extras and bk in extras:
         extras[ik]['vs_bf16'] = round(
             extras[ik]['value'] / extras[bk]['value'], 3)
-    result['extras'] = extras
+        print(json.dumps(result), flush=True)
+    # stretch rows (VERDICT r4 missing #5) — only with real window left
+    sub('llama', 'llama_decode', '--iters', '32', min_window=240)
+    if not adapted:
+        sub('yolo', 'yolo3', '--iters', str(max(iters // 2, 10)),
+            min_window=180)
+    result['suite_wall_s'] = round(time.perf_counter() - t_start, 1)
     return result
 
 
@@ -900,16 +975,16 @@ def main():
     parser.add_argument('--iters', type=int, default=50)
     parser.add_argument('--warmup', type=int, default=5)
     parser.add_argument('--cpu', action='store_true')
+    parser.add_argument('--skim', action='store_true',
+                        help='suite mode: skip methodology-only '
+                             'imperative variants in the train bench')
     args = parser.parse_args()
 
     if args.model == 'suite':
         # orchestrator only — must not touch jax (the children own the
-        # device grant); see bench_suite
-        load = _warn_contention()
-        result = bench_suite(args)
-        if load is not None:
-            result['host_load'] = load
-        print(json.dumps(result))
+        # device grant); see bench_suite. bench_suite streams partial
+        # result lines itself; this is the final, fullest line.
+        print(json.dumps(bench_suite(args)))
         return
 
     if args.cpu:

@@ -124,16 +124,20 @@ class TapeNode:
     """One recorded op: pure fn, captured input values, parent links."""
 
     __slots__ = ('fn', 'in_vals', 'parents', 'n_out', 'name', 'vjp_fn',
-                 'out_avals', 'multi')
+                 'out_avals', 'multi', 'vjp_lock')
 
     def __init__(self, fn, in_vals, parents, n_out, name, vjp_fn=None,
-                 out_avals=None, multi=None):
+                 out_avals=None, multi=None, vjp_lock=None):
         self.fn = fn
         self.in_vals = in_vals      # raw jax arrays at record time
         self.parents = parents      # list of AGInfo or None per input
         self.n_out = n_out
         self.name = name
         self.vjp_fn = vjp_fn        # set when recorded in train mode
+        # lock to hold while a deferred jax.vjp re-traces fn (a
+        # _CachedOp re-trace swaps shared Parameter payloads and must
+        # serialize with the graph lock — ADVICE r4)
+        self.vjp_lock = vjp_lock
         self.out_avals = out_avals
         # whether fn returns a tuple (vjp cotangent must match structure)
         self.multi = n_out > 1 if multi is None else multi
@@ -290,6 +294,12 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
                     for i in range(node.n_out)]
                 if node.vjp_fn is not None:
                     vjp_fn = node.vjp_fn
+                elif node.vjp_lock is not None:
+                    # predict-record deferral: the re-trace re-enters
+                    # _CachedOp's pure_fn Parameter-payload swap, which
+                    # must not race lock-free inference snapshots
+                    with node.vjp_lock:
+                        _, vjp_fn = jax.vjp(node.fn, *node.in_vals)
                 else:
                     _, vjp_fn = jax.vjp(node.fn, *node.in_vals)
                 in_cots = vjp_fn(tuple(out_cots) if node.multi

@@ -446,6 +446,10 @@ class _CachedGraph:
             return tuple(outs) + tuple(aux_out)
 
         op = Op('_CachedOp', fn, differentiable=True)
+        # predict-record mode defers jax.vjp to backward() time
+        # (_tape.py); that re-trace re-enters pure_fn's shared-Parameter
+        # payload swap and must hold this graph's lock (ADVICE r4)
+        op.vjp_lock = self._lock
         try:
             res = apply_op(op, in_nds + main_nds, fn, name='_CachedOp')
         except DynamicShapeError:

@@ -13,7 +13,8 @@ code run unchanged.
 
 from .base import KVStoreBase
 from .kvstore import KVStore, KVStoreLocal
-from .tpu import KVStoreTPUSync, Horovod, BytePS
+from .tpu import KVStoreTPUSync
+from .plugins import Horovod, BytePS
 from .dist_async import KVStoreDistAsync
 
 

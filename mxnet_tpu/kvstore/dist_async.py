@@ -21,8 +21,10 @@ Wire format: JSON (cmd, key, dtype, shape) header + raw bytes — JSON,
 not pickle, so a reachable port cannot execute code via a crafted
 header.  The one pickled payload (``set_optimizer``) is gated behind a
 shared-secret token (``MXNET_KVSTORE_SECRET``); without a configured
-secret it is only accepted from loopback peers.  The server binds the
-coordinator interface from ``MX_COORDINATOR`` rather than 0.0.0.0.
+secret it is only accepted from loopback peers.  Server 0 binds the
+coordinator interface from ``MX_COORDINATOR`` rather than 0.0.0.0;
+servers sid>0 bind the interface their host reaches server 0 through
+(the same address they advertise) — no server listens on every NIC.
 Server address: rank 0's host from ``MX_COORDINATOR`` with port offset
 ``MXNET_KVSTORE_ASYNC_PORT`` (default coordinator port + 29).
 
@@ -167,6 +169,13 @@ class _AsyncServer(threading.Thread):
                 return {'ok': True,
                         'table': {str(k): v for k, v
                                   in self._server_table.items()}}, b''
+        if cmd == 'bye':
+            # clean departure: drop the rank from the last-seen table so
+            # get_num_dead_node does not report a finished worker as
+            # dead forever (ADVICE r4)
+            with self._lock:
+                self._last_seen.pop(int(rank), None)
+            return {'ok': True}, b''
         if cmd == 'dead_nodes':
             cutoff = _time.monotonic() - float(header['timeout'])
             with self._lock:
@@ -308,21 +317,18 @@ class KVStoreDistAsync(KVStoreBase):
                                         int(port) + 29))
         self._host = host
         local = host in ('127.0.0.1', 'localhost')
-        if self._rank < self._nserv and self._server is None:
-            # this rank hosts server `rank` (reference: the server node
-            # group; one server per process regardless of how many
-            # dist_async stores the worker creates)
-            my_port = self._port + self._rank
-            self._server = _SERVERS.get(my_port)
+        if self._rank == 0 and self._server is None:
+            # rank 0 hosts server 0 (reference: the server node group;
+            # one server per process regardless of how many dist_async
+            # stores the worker creates) and must start it before
+            # dialing itself below
+            self._server = _SERVERS.get(self._port)
             if self._server is None:
-                bind = '127.0.0.1' if local else host \
-                    if self._rank == 0 else ''
-                if not bind:
-                    bind = '0.0.0.0'      # servers >0: any interface
-                self._server = _AsyncServer(my_port, bind_host=bind,
-                                            sid=self._rank)
+                bind = '127.0.0.1' if local else host
+                self._server = _AsyncServer(self._port, bind_host=bind,
+                                            sid=0)
                 self._server.start()
-                _SERVERS[my_port] = self._server
+                _SERVERS[self._port] = self._server
         # every rank (rank 0 included) connects to the advertised
         # coordinator host: the server may be bound to that interface
         # only, so rank 0 dialing loopback would be refused
@@ -330,12 +336,22 @@ class KVStoreDistAsync(KVStoreBase):
         self._socks[0] = self._dial(target, self._port)
         self._sock_locks[0] = threading.Lock()
         if self._nserv > 1:
-            # server sid>0 advertises the interface it reaches server 0
-            # through (reachable by every peer on that network); the
-            # table rendezvous lives on server 0
+            # server sid>0 starts only AFTER dialing server 0 and binds
+            # the exact interface that dial used (getsockname) — the
+            # same address it advertises in register_server. Binding
+            # 0.0.0.0 here would expose the unauthenticated
+            # init/push/pull data plane on every NIC (ADVICE r4).
             if 0 < self._rank < self._nserv:
-                myaddr = (f'{self._socks[0].getsockname()[0]}:'
-                          f'{self._port + self._rank}')
+                my_port = self._port + self._rank
+                myif = self._socks[0].getsockname()[0]
+                self._server = _SERVERS.get(my_port)
+                if self._server is None:
+                    self._server = _AsyncServer(
+                        my_port, bind_host='127.0.0.1' if local else myif,
+                        sid=self._rank)
+                    self._server.start()
+                    _SERVERS[my_port] = self._server
+                myaddr = f'{myif}:{my_port}'
                 self._rpc_to(0, {'cmd': 'register_server',
                                  'sid': self._rank, 'addr': myaddr})
             table = {}
@@ -389,7 +405,18 @@ class KVStoreDistAsync(KVStoreBase):
         hb = getattr(self, '_hb_thread', None)
         if hb is not None:
             self._hb_stop.set()
+            # join BEFORE the bye RPC: an in-flight ping landing after
+            # the bye would re-add this rank to the server's last-seen
+            # table and resurrect the dead-forever accounting bug
+            hb.join(timeout=10)
             self._hb_thread = None
+        if 0 in self._socks:
+            try:
+                # clean departure: deregister from the heartbeat table so
+                # this rank is not counted dead forever (ADVICE r4)
+                self._rpc_to(0, {'cmd': 'bye'})
+            except Exception:
+                pass              # server already gone: nothing to tell
         for sid, sock in list(self._socks.items()):
             try:
                 sock.close()
@@ -507,9 +534,19 @@ class KVStoreDistAsync(KVStoreBase):
                         [self._pull_one(c, f'{k}#c{c}')
                          for c in range(self._nserv)], axis=0)
             else:
-                arr = _onp.concatenate(
-                    [self._pull_one(sid, sub) for sid, sub, _ in plan],
-                    axis=0)
+                try:
+                    arr = _onp.concatenate(
+                        [self._pull_one(sid, sub)
+                         for sid, sub, _ in plan], axis=0)
+                except RuntimeError as e:
+                    # the out template's shape/dtype planned a split the
+                    # pushed array never had (e.g. a wider template
+                    # dtype crossing bigarray_bound): fall back to the
+                    # unsplit key on its hash server, mirroring the
+                    # single-plan fallback above (ADVICE r4)
+                    if 'no such key' not in str(e):
+                        raise
+                    arr = self._pull_one(self._key_server(k), k)
             raw = jnp.asarray(arr)
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:

@@ -1,0 +1,224 @@
+"""Horovod / BytePS kvstore plugins — the reference's delegation
+structure over an injectable backend.
+
+Reference: ``python/mxnet/kvstore/horovod.py:25-160`` (broadcast →
+``hvd.broadcast``, pushpull → ``hvd.allreduce``/``allreduce_``,
+rank/local_rank/size from the hvd module) and
+``python/mxnet/kvstore/byteps.py:26-224`` (byteps_declare_tensor +
+byteps_push_pull; broadcast = zero-on-non-root then push_pull).
+
+This zero-egress image cannot link the real horovod/byteps wheels, so
+the backend is DUCK-TYPED: anything exposing the hvd (or bps) call
+surface can be injected with ``Horovod.set_backend(module)`` /
+``BytePS.set_backend(module)`` — tests drive the full delegation path
+with a mock backed by a real XLA psum over the local device mesh. When
+no backend is injected and the real package is not importable, both
+classes keep their documented COMPAT-ALIAS behavior: the same
+allreduce semantics the plugin would provide, executed as XLA
+collectives by :class:`KVStoreTPUSync` (scripts written against the
+plugin surface run unchanged).
+"""
+
+from .base import register
+from .tpu import KVStoreTPUSync
+
+
+def _reduce_replicas(vals):
+    """Sum a list of local device replicas into one tensor (the base
+    store's pre-allreduce local reduction) so a single collective
+    carries the whole contribution."""
+    if len(vals) == 1:
+        return vals[0]
+    acc = vals[0].copy()
+    for v in vals[1:]:
+        acc[:] = acc + v
+    return acc
+
+
+def _resolve_backend(injected, module_name):
+    if injected is not None:
+        return injected
+    try:
+        import importlib
+        return importlib.import_module(module_name)
+    except ImportError:
+        return None
+
+
+@register
+class Horovod(KVStoreTPUSync):
+    """COMPAT ALIAS + delegation shell for the Horovod plugin.
+
+    With a backend (injected via :meth:`set_backend`, or a real
+    ``horovod.mxnet`` if one is installed) every collective delegates
+    exactly like the reference ``KVStoreHorovod``; without one the
+    class is a documented COMPAT ALIAS executing the same allreduce
+    topology over XLA collectives. No hvd transport exists in this
+    zero-egress image, so CI exercises the delegation with a mock hvd
+    whose allreduce is a real psum over the local mesh
+    (tests/test_kvstore.py)."""
+
+    NAME = 'horovod'
+    _backend = None                  # class-level injection point
+
+    @classmethod
+    def set_backend(cls, hvd):
+        """Inject an hvd-like module (``init/rank/local_rank/size/
+        broadcast/allreduce/allreduce_``). ``None`` restores the
+        XLA-collective alias behavior."""
+        cls._backend = hvd
+
+    def __init__(self):
+        super().__init__()
+        self._hvd = _resolve_backend(type(self)._backend, 'horovod.mxnet')
+        if self._hvd is not None:
+            self._hvd.init()         # reference horovod.py:30
+
+    # ------------------------------------------------------- delegation
+    def broadcast(self, key, value, out, priority=0):
+        """Reference horovod.py:42: rank-0's value to every rank's out
+        via ``hvd.broadcast``."""
+        if self._hvd is None:
+            return super().broadcast(key, value, out, priority)
+        if isinstance(value, (list, tuple)):
+            value = _reduce_replicas(value)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        res = self._hvd.broadcast(tensor=value, root_rank=0,
+                                  name=str(key), priority=priority)
+        for o in outs:
+            o[:] = res
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Reference horovod.py:78: allreduce_ in place when no out,
+        else allreduce into out (sum, never average). Replica lists
+        (one value per local device, the base-store surface) are summed
+        locally first so one allreduce carries the full contribution
+        and EVERY out target receives the result."""
+        if self._hvd is None:
+            return super().pushpull(key, value, out, priority)
+        if out is None:
+            vals = value if isinstance(value, (list, tuple)) else [value]
+            for v in vals:
+                self._hvd.allreduce_(v, average=False, name=str(key),
+                                     priority=priority)
+        else:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            v = _reduce_replicas(value) \
+                if isinstance(value, (list, tuple)) else value
+            res = self._hvd.allreduce(v, average=False, name=str(key),
+                                      priority=priority)
+            for o in outs:
+                o[:] = res
+
+    def set_optimizer(self, optimizer):
+        """Reference horovod.py:135: the plugin never runs the optimizer
+        on a server — Trainer keeps updates local."""
+        if self._hvd is None:
+            return super().set_optimizer(optimizer)
+
+    @property
+    def rank(self):
+        return self._hvd.rank() if self._hvd is not None else super().rank
+
+    @property
+    def local_rank(self):
+        if self._hvd is not None:
+            return self._hvd.local_rank()
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return self._hvd.size() if self._hvd is not None \
+            else super().num_workers
+
+    @property
+    def type(self):
+        return 'horovod' if self._hvd is not None else super().type
+
+
+@register
+class BytePS(KVStoreTPUSync):
+    """COMPAT ALIAS + delegation shell for the BytePS plugin (reference
+    ``python/mxnet/kvstore/byteps.py:26``) — see Horovod note above.
+
+    Delegation mirrors the reference call structure: every tensor is
+    announced with ``byteps_declare_tensor`` and summed in place with
+    ``byteps_push_pull``; broadcast zeroes the value on non-root ranks
+    first, so the push_pull sum equals rank-0's value."""
+
+    NAME = 'byteps'
+    _backend = None
+
+    @classmethod
+    def set_backend(cls, bps):
+        cls._backend = bps
+
+    def __init__(self):
+        super().__init__()
+        self._bps = _resolve_backend(type(self)._backend, 'byteps.mxnet')
+        if self._bps is not None:
+            self._bps.init()         # reference byteps.py:43
+
+    def _push_pull_inplace(self, key, tensor, priority):
+        self._bps.byteps_declare_tensor(str(key))
+        self._bps.byteps_push_pull(tensor, version=0, priority=priority,
+                                   name=str(key), is_average=False)
+
+    def broadcast(self, key, value, out, priority=0):
+        """Reference byteps.py:46-102: non-root ranks zero their copy,
+        then the push_pull sum carries rank-0's value to everyone."""
+        if self._bps is None:
+            return super().broadcast(key, value, out, priority)
+        value = value[0] if isinstance(value, (list, tuple)) \
+            and len(value) == 1 else value
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        inplace = len(outs) == 1 and value is outs[0]
+        bval = value if inplace else value.copy()
+        if self.rank != 0:
+            bval[:] = bval * 0       # reference: __imul__(0) on non-root
+        self._push_pull_inplace(key, bval, priority)
+        bval.wait_to_read()          # reference: sync before training
+        for o in outs:
+            if o is not bval:
+                o[:] = bval
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Reference byteps.py:105-160: declare + push_pull, in place
+        when no out, else through a scratch copy into out. Replica
+        lists are summed locally first (the base store's pre-allreduce
+        reduction) so no device's gradient is dropped."""
+        if self._bps is None:
+            return super().pushpull(key, value, out, priority)
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        if out is None:
+            for v in vals:
+                self._push_pull_inplace(key, v, priority)
+            return
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        scratch = _reduce_replicas(vals)
+        if scratch is vals[0]:
+            scratch = vals[0].copy()
+        self._push_pull_inplace(key, scratch, priority)
+        for o in outs:
+            o[:] = scratch
+
+    @property
+    def rank(self):
+        return self._bps.rank() if self._bps is not None else super().rank
+
+    @property
+    def local_rank(self):
+        if self._bps is not None:
+            return self._bps.local_rank()
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return self._bps.size() if self._bps is not None \
+            else super().num_workers
+
+    @property
+    def type(self):
+        return 'byteps' if self._bps is not None else super().type

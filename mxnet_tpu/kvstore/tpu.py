@@ -338,25 +338,5 @@ class KVStoreTPUSync(KVStoreLocal):
         return 'dist_tpu_sync'
 
 
-@register
-class Horovod(KVStoreTPUSync):
-    """COMPAT ALIAS, not a Horovod binding: scripts written against the
-    reference's Horovod plugin surface (python/mxnet/kvstore/horovod.py:25
-    — broadcast/pushpull/local_rank) run unchanged, backed by the same
-    allreduce topology Horovod would execute, but over XLA collectives.
-    No hvd transport exists in this zero-egress image; a real binding
-    would register here via KVStoreBase.register."""
-
-    NAME = 'horovod'
-
-    @property
-    def local_rank(self):
-        return jax.process_index()
-
-
-@register
-class BytePS(KVStoreTPUSync):
-    """COMPAT ALIAS for the BytePS plugin surface (reference
-    python/mxnet/kvstore/byteps.py:45) — see Horovod note above."""
-
-    NAME = 'byteps'
+# The Horovod / BytePS plugin classes (delegation shells with
+# COMPAT-ALIAS fallback over this store) live in plugins.py.

@@ -41,13 +41,18 @@ class Op:
 
     __slots__ = ('name', 'fn', 'differentiable', 'stochastic', 'namespaces',
                  'aliases', 'wrap', 'n_out', 'static_argnums',
-                 'static_argnames', 'dynamic_shape')
+                 'static_argnames', 'dynamic_shape', 'vjp_lock')
 
     def __init__(self, name, fn, differentiable=True, stochastic=False,
                  namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1,
                  static_argnums=(), static_argnames=(), dynamic_shape=False):
         self.name = name
         self.fn = fn
+        # held while a DEFERRED jax.vjp re-traces fn at backward() time
+        # (predict-record mode): _CachedOp's re-trace swaps shared
+        # Parameter payloads and must serialize with the graph lock
+        # exactly like record-time tracing does (docs/threading.md)
+        self.vjp_lock = None
         self.differentiable = differentiable
         self.stochastic = stochastic
         self.namespaces = namespaces
@@ -212,7 +217,8 @@ def apply_op(op, arrays, fn, n_out=None, name=None, _from_invoke=False,
         node = _tape.TapeNode(
             fn, raws, [getattr(a, '_ag', None) for a in arrays],
             len(out_list), name or op.name, vjp_fn=vjp_fn,
-            out_avals=[jax.typeof(o) for o in out_list], multi=multi)
+            out_avals=[jax.typeof(o) for o in out_list], multi=multi,
+            vjp_lock=op.vjp_lock)
         for i, w in enumerate(wrapped):
             w._ag = _tape.AGInfo(node=node, index=i)
     if not _from_invoke and _dc.is_deferred_compute():

@@ -395,3 +395,259 @@ def test_zero1_update_in_axis_matches_replicated_sgd():
                                  lr=0.1, momentum=0.9)
         onp.testing.assert_allclose(onp.asarray(nw), onp.asarray(want),
                                     rtol=1e-5)
+
+
+# ------------------------------------------------------- dist_async units
+
+def test_dist_async_server_bye_removes_rank_from_heartbeats():
+    """A worker that close()s cleanly sends 'bye'; the server must drop
+    it from the last-seen table so get_num_dead_node does not report a
+    finished worker as dead forever (ADVICE r4)."""
+    from mxnet_tpu.kvstore.dist_async import _AsyncServer
+    srv = _AsyncServer(0, bind_host='127.0.0.1', sid=0)  # never start()ed
+    try:
+        srv._dispatch({'cmd': 'ping', 'rank': 5}, b'')
+        reply, _ = srv._dispatch({'cmd': 'dead_nodes', 'timeout': -1.0},
+                                 b'')
+        assert reply['dead'] == 1      # beat is "older" than a future cutoff
+        reply, _ = srv._dispatch({'cmd': 'bye', 'rank': 5}, b'')
+        assert reply['ok']
+        reply, _ = srv._dispatch({'cmd': 'dead_nodes', 'timeout': -1.0},
+                                 b'')
+        assert reply['dead'] == 0
+    finally:
+        srv._server.server_close()
+
+
+def test_dist_async_pull_split_plan_falls_back_to_unsplit_key(monkeypatch):
+    """pull() plans split routing from the caller's OUT template; when
+    the template implies a split the pushed array never had (e.g. a
+    wider template dtype crossing bigarray_bound), the multi-chunk
+    branch must fall back to the unsplit key on its hash server instead
+    of raising (ADVICE r4)."""
+    from mxnet_tpu.kvstore.dist_async import KVStoreDistAsync
+    kv = KVStoreDistAsync.__new__(KVStoreDistAsync)
+    kv._rank, kv._nproc = 0, 4
+    kv._nserv = 2
+    kv._big = 8                       # tiny bound: (4,2) f32 = 32 B splits
+    monkeypatch.setattr(kv, '_ensure_connected', lambda: None)
+    stored = np.arange(8, dtype='f').reshape(4, 2)
+    pulls = []
+
+    def fake_pull_one(sid, sub):
+        pulls.append((sid, sub))
+        if '#c' in str(sub):
+            raise RuntimeError(f'no such key {sub!r} on server {sid}')
+        return stored
+
+    monkeypatch.setattr(kv, '_pull_one', fake_pull_one)
+    out = mx.np.zeros((4, 2))
+    got = kv.pull('w', out=out)
+    np.testing.assert_allclose(got.asnumpy(), stored)
+    np.testing.assert_allclose(out.asnumpy(), stored)
+    assert any('#c' in str(s) for _, s in pulls)   # split plan was tried
+    assert pulls[-1][1] == 'w'                     # ...then the fallback
+
+
+# ------------------------------------------- horovod/byteps delegation
+
+def _mesh_psum(nd, n):
+    """A REAL XLA collective standing in for the plugin transport:
+    replicate across n virtual CPU devices, psum over the mesh axis —
+    the value a size-n world of identical ranks would allreduce."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ('w',))
+    f = jax.shard_map(lambda x: jax.lax.psum(x, 'w'), mesh=mesh,
+                      in_specs=P(), out_specs=P())
+    return mx.np.array(np.asarray(f(nd.asnumpy())))
+
+
+class _MockHvd:
+    """Duck-typed horovod.mxnet surface (reference horovod.py:25)."""
+
+    def __init__(self, size=4):
+        self._size = size
+        self.calls = []
+
+    def init(self):
+        self.calls.append(('init',))
+
+    def rank(self):
+        return 0
+
+    def local_rank(self):
+        return 0
+
+    def size(self):
+        return self._size
+
+    def broadcast(self, tensor, root_rank=0, name=None, priority=0):
+        self.calls.append(('broadcast', name, root_rank, priority))
+        return tensor        # rank 0 in the mock world: value wins
+
+    def allreduce(self, tensor, average=False, name=None, priority=0):
+        self.calls.append(('allreduce', name, average, priority))
+        return _mesh_psum(tensor, self._size)
+
+    def allreduce_(self, tensor, average=False, name=None, priority=0):
+        self.calls.append(('allreduce_', name, average, priority))
+        tensor[:] = _mesh_psum(tensor, self._size)
+        return tensor
+
+
+class _MockBps:
+    """Duck-typed byteps.mxnet surface (reference byteps.py:26)."""
+
+    def __init__(self, size=4, rank=0):
+        self._size, self._rank = size, rank
+        self.calls = []
+
+    def init(self):
+        self.calls.append(('init',))
+
+    def rank(self):
+        return self._rank
+
+    def local_rank(self):
+        return self._rank
+
+    def size(self):
+        return self._size
+
+    def byteps_declare_tensor(self, name):
+        self.calls.append(('declare', name))
+
+    def byteps_push_pull(self, tensor, version=0, priority=0, name=None,
+                         is_average=False):
+        self.calls.append(('push_pull', name, version, is_average))
+        tensor[:] = _mesh_psum(tensor, self._size)
+
+
+def test_horovod_delegation_pushpull_broadcast():
+    """The delegation path (VERDICT r4 item 4): pushpull →
+    hvd.allreduce/allreduce_, broadcast → hvd.broadcast, rank/size from
+    the module — reference horovod.py:25-160 structure against an
+    injected backend."""
+    from mxnet_tpu.kvstore.plugins import Horovod
+    hvd = _MockHvd(size=4)
+    Horovod.set_backend(hvd)
+    try:
+        kv = kvstore.create('horovod')
+        assert kv.type == 'horovod'
+        assert kv.num_workers == 4 and kv.rank == 0 and kv.local_rank == 0
+        assert ('init',) in hvd.calls
+        # in-place pushpull (no out): reference allreduce_ branch
+        a = mx.np.ones((2, 3))
+        kv.pushpull('g0', a)
+        np.testing.assert_allclose(a.asnumpy(), 4.0)
+        assert ('allreduce_', 'g0', False, 0) in hvd.calls
+        # out= form: reference allreduce branch
+        v, o = mx.np.ones((3,)) * 2, mx.np.zeros((3,))
+        kv.pushpull('g1', v, out=o)
+        np.testing.assert_allclose(o.asnumpy(), 8.0)
+        np.testing.assert_allclose(v.asnumpy(), 2.0)   # input untouched
+        assert ('allreduce', 'g1', False, 0) in hvd.calls
+        # broadcast: root value lands in out
+        w, bo = mx.np.arange(4), mx.np.zeros((4,))
+        kv.broadcast('p0', w, out=bo)
+        np.testing.assert_allclose(bo.asnumpy(), w.asnumpy())
+        assert ('broadcast', 'p0', 0, 0) in hvd.calls
+        kv.set_optimizer(mx.optimizer.SGD())   # no-op, must not raise
+    finally:
+        Horovod.set_backend(None)
+    # alias behavior restored without a backend
+    kv = kvstore.create('horovod')
+    assert kv.num_workers == 1 and kv.type == 'dist_tpu_sync'
+
+
+def test_byteps_delegation_pushpull_broadcast():
+    """BytePS delegation: byteps_declare_tensor + byteps_push_pull per
+    tensor; broadcast zeroes non-root then push_pulls (reference
+    byteps.py:46-160)."""
+    from mxnet_tpu.kvstore.plugins import BytePS
+    bps = _MockBps(size=4)
+    BytePS.set_backend(bps)
+    try:
+        kv = kvstore.create('byteps')
+        assert kv.type == 'byteps'
+        assert kv.num_workers == 4 and kv.rank == 0
+        a = mx.np.ones((5,))
+        kv.pushpull('k0', a)                   # in place
+        np.testing.assert_allclose(a.asnumpy(), 4.0)
+        assert ('declare', 'k0') in bps.calls
+        assert ('push_pull', 'k0', 0, False) in bps.calls
+        v, o = mx.np.ones((2,)), mx.np.zeros((2,))
+        kv.pushpull('k1', v, out=o)
+        np.testing.assert_allclose(o.asnumpy(), 4.0)
+        np.testing.assert_allclose(v.asnumpy(), 1.0)
+        # broadcast on root: value survives the push_pull sum / size
+        # identity only on rank 0 in the mock (others would zero first)
+        w, bo = mx.np.ones((3,)) * 0.25, mx.np.zeros((3,))
+        kv.broadcast('p1', w, out=bo)
+        np.testing.assert_allclose(bo.asnumpy(), 1.0)  # 0.25 summed x4
+    finally:
+        BytePS.set_backend(None)
+    kv = kvstore.create('byteps')
+    assert kv.num_workers == 1
+
+
+def test_byteps_broadcast_nonroot_zeroes_contribution():
+    """Non-root ranks must contribute zeros so the summed push_pull
+    equals rank-0's tensor (the reference's broadcast-by-pushpull
+    trick, byteps.py:89-95)."""
+    from mxnet_tpu.kvstore.plugins import BytePS
+    bps = _MockBps(size=4, rank=2)
+    BytePS.set_backend(bps)
+    try:
+        kv = kvstore.create('byteps')
+        w, bo = mx.np.ones((3,)) * 7, mx.np.zeros((3,))
+        kv.broadcast('p2', w, out=bo)
+        # the mock world sums 4 copies of the LOCAL (zeroed) tensor
+        np.testing.assert_allclose(bo.asnumpy(), 0.0)
+        np.testing.assert_allclose(w.asnumpy(), 7.0)   # input preserved
+    finally:
+        BytePS.set_backend(None)
+
+
+def test_delegation_replica_lists_sum_before_collective():
+    """Replica-list call shapes (one value per local device — the base
+    store surface): the delegation must sum replicas locally, run ONE
+    collective, and write EVERY out target (code-review r5: vals[1:]
+    were dropped / outs[1:] left stale)."""
+    from mxnet_tpu.kvstore.plugins import BytePS, Horovod
+    hvd = _MockHvd(size=2)
+    Horovod.set_backend(hvd)
+    try:
+        kv = kvstore.create('horovod')
+        v0, v1 = mx.np.ones((3,)), mx.np.ones((3,)) * 10
+        o0, o1 = mx.np.zeros((3,)), mx.np.zeros((3,))
+        kv.pushpull('rl', [v0, v1], out=[o0, o1])
+        # (1 + 10) summed locally, then x2 across the mock world
+        np.testing.assert_allclose(o0.asnumpy(), 22.0)
+        np.testing.assert_allclose(o1.asnumpy(), 22.0)
+        assert sum(1 for c in hvd.calls if c[0] == 'allreduce') == 1
+        # single value, many outs: every out must be written
+        v, oa, ob = mx.np.ones((2,)), mx.np.zeros((2,)), mx.np.zeros((2,))
+        kv.pushpull('rs', v, out=[oa, ob])
+        np.testing.assert_allclose(oa.asnumpy(), 2.0)
+        np.testing.assert_allclose(ob.asnumpy(), 2.0)
+        # list-shaped broadcast value must be unwrapped, not passed raw
+        w, bo = mx.np.arange(3), mx.np.zeros((3,))
+        kv.broadcast('rb', [w], out=[bo])
+        np.testing.assert_allclose(bo.asnumpy(), w.asnumpy())
+    finally:
+        Horovod.set_backend(None)
+    bps = _MockBps(size=2)
+    BytePS.set_backend(bps)
+    try:
+        kv = kvstore.create('byteps')
+        v0, v1 = mx.np.ones((3,)), mx.np.ones((3,)) * 10
+        o0, o1 = mx.np.zeros((3,)), mx.np.zeros((3,))
+        kv.pushpull('bl', [v0, v1], out=[o0, o1])
+        np.testing.assert_allclose(o0.asnumpy(), 22.0)
+        np.testing.assert_allclose(o1.asnumpy(), 22.0)
+        np.testing.assert_allclose(v0.asnumpy(), 1.0)  # inputs untouched
+        assert sum(1 for c in bps.calls if c[0] == 'push_pull') == 1
+    finally:
+        BytePS.set_backend(None)

@@ -205,3 +205,55 @@ def test_recorded_call_serializes_with_inference_threads():
         assert not t.is_alive(), 'inference thread hung'
     if errors:
         raise errors[0]
+
+
+def test_deferred_vjp_backward_holds_graph_lock():
+    """Predict-record mode (record(train_mode=False)) defers jax.vjp to
+    backward() time (_tape.py); the deferred re-trace re-enters
+    pure_fn's shared-Parameter payload swap and must hold the graph
+    lock (ADVICE r4). Asserts (a) the tape node actually carries the
+    graph's lock and (b) backward under concurrent inference threads
+    stays correct and deadlock-free."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation='relu'), gluon.nn.Dense(4))
+    net.initialize()
+    net(mx.np.ones((2, 8)))
+    net.hybridize(static_alloc=True)
+    net(mx.np.ones((2, 8)))
+
+    x_tr = mx.np.array(onp.ones((2, 8), 'f') * 0.5)
+    with autograd.record(train_mode=False):
+        y = net(x_tr)
+        loss = (y ** 2).sum()
+    node = y._ag.node
+    assert node.vjp_fn is None, 'predict-record must defer jax.vjp'
+    assert node.vjp_lock is net._cached_graph._lock
+
+    x_inf = mx.np.array(onp.ones((2, 8), 'f') * 0.3)
+    with autograd.predict_mode():
+        want_inf = net(x_inf).asnumpy()
+    stop = threading.Event()
+    errors = []
+
+    def infer():
+        try:
+            while not stop.is_set():
+                onp.testing.assert_allclose(net(x_inf).asnumpy(),
+                                            want_inf, rtol=1e-5)
+        except Exception as e:       # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=infer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        loss.backward()              # deferred vjp re-trace under lock
+    finally:
+        stop.set()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), 'inference thread hung'
+    if errors:
+        raise errors[0]
+    g = list(net.collect_params().values())[0].grad()
+    assert onp.isfinite(g.asnumpy()).all()
