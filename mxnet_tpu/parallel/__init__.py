@@ -18,7 +18,10 @@ from . import moe
 from . import checkpoint
 from .checkpoint import (save_sharded, restore_sharded,
                          SharedCheckpointManager, restore_or_init)
-from .pipeline import pipeline_apply, stack_stage_params
+from .pipeline import (pipeline_apply, pipeline_train_1f1b,
+                       stack_stage_params)
+from . import gluon_pipeline
+from .gluon_pipeline import PipelineTrainer, split_sequential
 from .moe import moe_ffn
 
 

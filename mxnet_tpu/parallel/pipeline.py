@@ -71,10 +71,19 @@ def _feed_step(xs_local, feed_c, t, axis_size, n_loc, idx, actf):
 
 
 def _pipeline_shard_map(kernel, stage_params, mesh, axis_name, n_micro,
-                        extra_in_specs=(), out_specs=None):
+                        extra_in_specs=(), out_specs=None,
+                        param_specs=None, data_spec=None):
     """Shared wrapper: divisibility check, stage-axis specs, shard_map
     construction (used by both pipeline_apply and
-    pipeline_train_1f1b)."""
+    pipeline_train_1f1b).
+
+    ``param_specs``: optional pytree of PartitionSpecs (matching
+    ``stage_params``) whose FIRST axis must be ``axis_name`` — lets a
+    stage combine pp with tensor/expert sharding on the other axes
+    (e.g. ``P('pp', None, 'tp')`` Megatron kernels). Default: stage
+    axis only. ``data_spec``: spec for the microbatch feed (default
+    ``P(axis_name)``: interleaved microbatch shards; pass e.g.
+    ``P('pp', None, 'sp', None)`` to keep sequence sharded too)."""
     from .mesh import _shard_map
 
     axis_size = mesh.shape[axis_name]
@@ -82,12 +91,23 @@ def _pipeline_shard_map(kernel, stage_params, mesh, axis_name, n_micro,
         raise ValueError(
             f'n_micro ({n_micro}) must be divisible by the stage count '
             f'({axis_size})')
-    pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    if param_specs is None:
+        pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    else:
+        pspec = param_specs
+        for s in jax.tree.leaves(pspec, is_leaf=lambda x:
+                                 isinstance(x, P)):
+            if not s or s[0] != axis_name:
+                raise ValueError(
+                    f'param_specs leaves must lead with {axis_name!r} '
+                    f'(the stacked stage axis); got {s}')
     fn = _shard_map()(
         kernel, mesh=mesh,
-        in_specs=(pspec, P(axis_name)) + tuple(extra_in_specs),
+        in_specs=(pspec,
+                  P(axis_name) if data_spec is None else data_spec)
+        + tuple(extra_in_specs),
         out_specs=P(axis_name) if out_specs is None else out_specs(pspec))
-    return fn, axis_size
+    return fn, axis_size, pspec
 
 
 def _shift(x, axis_name, axis_size, toward_zero):
@@ -189,7 +209,8 @@ def _deinterleave(ys, n_stages):
         0, 1).reshape(ys.shape)
 
 
-def pipeline_apply(stage_fn, stage_params, xs, mesh, axis_name='pp'):
+def pipeline_apply(stage_fn, stage_params, xs, mesh, axis_name='pp',
+                   param_specs=None, data_spec=None):
     """Run ``n_stages`` copies of ``stage_fn`` as a GPipe pipeline.
 
     ``stage_fn(params, x) -> y`` — one stage, shape-preserving.
@@ -206,11 +227,14 @@ def pipeline_apply(stage_fn, stage_params, xs, mesh, axis_name='pp'):
     """
     n_micro = xs.shape[0]
     axis_size = mesh.shape[axis_name]
-    fn, axis_size = _pipeline_shard_map(
+    fn, axis_size, _pspec = _pipeline_shard_map(
         functools.partial(pipeline_kernel, stage_fn,
                           axis_name=axis_name, axis_size=axis_size,
                           n_micro=n_micro),
-        stage_params, mesh, axis_name, n_micro)
+        stage_params, mesh, axis_name, n_micro,
+        param_specs=param_specs, data_spec=data_spec,
+        out_specs=(None if data_spec is None
+                   else (lambda _p: data_spec)))
     ys = fn(stage_params, _interleave(xs, axis_size))
     return _deinterleave(ys, axis_size)
 
@@ -237,7 +261,8 @@ def onef1b_stats(n_micro, n_stages):
 
 
 def onef1b_train_kernel(stage_fn, loss_grad_fn, params, xs_local, ys,
-                        axis_name, axis_size, n_micro):
+                        axis_name, axis_size, n_micro, loss_axes=None,
+                        grad_axes=None):
     """Per-device 1F1B training schedule — call inside shard_map.
 
     One ``lax.scan`` tick = one FORWARD slot + one BACKWARD slot per
@@ -312,15 +337,29 @@ def onef1b_train_kernel(stage_fn, loss_grad_fn, params, xs_local, ys,
     (_, _, _, _, gacc, loss), _ = lax.scan(
         tick, (z, z, resid0, z, gacc0, jnp.float32(0.0)),
         jnp.arange(ticks))
-    # total loss lives on the last stage; share it
-    loss = lax.psum(jnp.where(idx == last, loss, 0.0), axis_name)
+    # total loss lives on the last stage; share it (plus any extra data
+    # axes the loss is sharded over, e.g. 'sp' sequence shards)
+    loss = lax.psum(jnp.where(idx == last, loss, 0.0),
+                    loss_axes or axis_name)
+    if grad_axes is not None:
+        # data sharded over extra axes (e.g. 'sp') contributes PARTIAL
+        # per-device grads to any param leaf replicated over those axes
+        # — sum them, per leaf, over exactly the axes the leaf's spec
+        # does not already shard (code-review r5: without this the
+        # caller silently gets sp-shard-0's partial gradients)
+        leaves, tdef = jax.tree.flatten(gacc)
+        leaves = [lax.psum(g, ax) if ax else g
+                  for g, ax in zip(leaves, grad_axes)]
+        gacc = jax.tree.unflatten(tdef, leaves)
     # re-grow the size-1 stage axis so out_specs=P('pp') reassembles the
     # global (n_stages, ...) grads matching stage_params' layout
     return jax.tree.map(lambda g: g[None], gacc), loss
 
 
 def pipeline_train_1f1b(stage_fn, loss_grad_fn, stage_params, xs, ys,
-                        mesh, axis_name='pp'):
+                        mesh, axis_name='pp', param_specs=None,
+                        data_spec=None, target_spec=None,
+                        loss_axes=None):
     """1F1B pipelined training step (VERDICT r3 weak #8).
 
     ``stage_fn(params, x) -> y`` shape-preserving stage;
@@ -339,12 +378,35 @@ def pipeline_train_1f1b(stage_fn, loss_grad_fn, stage_params, xs, ys,
             f'ys has {ys.shape[0]} microbatch targets but xs has '
             f'{n_micro} microbatches')
     axis_size = mesh.shape[axis_name]
-    fn, axis_size = _pipeline_shard_map(
+    # per-leaf gradient reduction plan: every loss axis beyond the
+    # stage axis whose shards hold DIFFERENT data (sp/dp data sharding)
+    # must be psummed into any param leaf not itself sharded over it
+    extra = tuple(a for a in (loss_axes or ()) if a != axis_name)
+    grad_axes = None
+    if extra:
+        if param_specs is None:
+            specs = [P(axis_name)] * len(jax.tree.leaves(stage_params))
+        else:
+            specs = jax.tree.leaves(param_specs, is_leaf=lambda x:
+                                    isinstance(x, P))
+
+        def _unsharded(spec):
+            used = set()
+            for s in spec or ():
+                if s is None:
+                    continue
+                used.update(s if isinstance(s, (tuple, list)) else (s,))
+            return tuple(a for a in extra if a not in used)
+
+        grad_axes = tuple(_unsharded(s) for s in specs)
+    fn, axis_size, _pspec = _pipeline_shard_map(
         functools.partial(onef1b_train_kernel, stage_fn, loss_grad_fn,
                           axis_name=axis_name, axis_size=axis_size,
-                          n_micro=n_micro),
+                          n_micro=n_micro, loss_axes=loss_axes,
+                          grad_axes=grad_axes),
         stage_params, mesh, axis_name, n_micro,
-        extra_in_specs=(P(),),
-        out_specs=lambda pspec: (pspec, P()))
+        extra_in_specs=(P() if target_spec is None else target_spec,),
+        out_specs=lambda pspec: (pspec, P()),
+        param_specs=param_specs, data_spec=data_spec)
     grads, loss = fn(stage_params, _interleave(xs, axis_size), ys)
     return grads, loss

