@@ -2,10 +2,20 @@
 
 Ported first per SURVEY §7 P0 — all suite tests depend on it:
 ``default_context`` (:57), ``assert_almost_equal`` with dtype-aware
-tolerances (:650), ``check_numeric_gradient`` (finite differences vs
-autograd, :1040), ``rand_ndarray`` (:391).
+tolerances via ``get_tols`` (:650, :74-168), ``check_numeric_gradient``
+(finite differences vs autograd with per-dtype eps, :1040,
+``default_numeric_eps`` :100), ``rand_ndarray``/``rand_sparse_ndarray``
+with the density/stype/distribution matrix (:391-520).
+
+TPU twist on the reference: ``bfloat16`` is a first-class tolerance
+class (the MXU's native dtype — 8 mantissa bits, LOOSER than fp16's
+10), and ``effective_dtype`` maps f32 data to the bf16 tolerance class
+when ``MXNET_TPU_F32_VIA_MXU=1`` declares that the values flowed
+through bf16-input matmul/conv (the TPU analog of the reference's
+TF32-on-arch-80 demotion, test_utils.py:108-132).
 """
 
+import functools
 import os
 
 import numpy as _np
@@ -15,20 +25,99 @@ from .ndarray.ndarray import NDArray, array
 
 _DEFAULT_CTX = None
 
-_DEFAULT_RTOL = {
-    _np.dtype(_np.float16): 1e-2,
-    _np.dtype(_np.float32): 1e-4,
-    _np.dtype(_np.float64): 1e-5,
-    _np.dtype(_np.int32): 0,
-    _np.dtype(_np.int64): 0,
-}
-_DEFAULT_ATOL = {
-    _np.dtype(_np.float16): 1e-3,
-    _np.dtype(_np.float32): 1e-5,
-    _np.dtype(_np.float64): 1e-8,
-    _np.dtype(_np.int32): 0,
-    _np.dtype(_np.int64): 0,
-}
+
+def _bf16_dtype():
+    import ml_dtypes
+    return _np.dtype(ml_dtypes.bfloat16)
+
+
+_INT_EXACT = (bool, _np.int8, _np.uint8, _np.int16, _np.uint16,
+              _np.int32, _np.uint32, _np.int64, _np.uint64)
+
+
+@functools.lru_cache(maxsize=1)
+def default_rtols():
+    """Per-dtype relative tolerances (reference test_utils.py:74),
+    extended with bfloat16 (8 mantissa bits -> ulp 2^-8 at 1.0).
+    Cached: assert_almost_equal sits on hot comparison paths. Treat the
+    returned dict as read-only."""
+    tols = {_np.dtype(_np.float16): 1e-2,
+            _np.dtype(_np.float32): 1e-4,
+            _np.dtype(_np.float64): 1e-5,
+            _bf16_dtype(): 2e-2}
+    tols.update({_np.dtype(t): 0 for t in _INT_EXACT})
+    return tols
+
+
+@functools.lru_cache(maxsize=1)
+def default_atols():
+    """Per-dtype absolute tolerances (reference test_utils.py:87)."""
+    tols = {_np.dtype(_np.float16): 1e-3,
+            _np.dtype(_np.float32): 1e-5,
+            _np.dtype(_np.float64): 1e-8,
+            _bf16_dtype(): 1e-2}
+    tols.update({_np.dtype(t): 0 for t in _INT_EXACT})
+    return tols
+
+
+@functools.lru_cache(maxsize=1)
+def default_numeric_eps():
+    """Finite-difference eps per dtype (reference test_utils.py:100 —
+    powers of two so the input delta drops no mantissa bits)."""
+    return {_np.dtype(_np.float16): 1.0 / 2 ** 6,
+            _bf16_dtype(): 1.0 / 2 ** 5,
+            _np.dtype(_np.float32): 1.0 / 2 ** 9,
+            _np.dtype(_np.float64): 1.0 / 2 ** 14}
+
+
+def effective_dtype(dat):
+    """The dtype whose tolerance class governs comparisons of ``dat``
+    (reference test_utils.py:108). On TPU the MXU computes f32-io
+    matmuls/convs from bf16 inputs unless the op requested higher
+    precision; set ``MXNET_TPU_F32_VIA_MXU=1`` in tests whose f32
+    outputs flowed through such ops to compare at bf16 precision."""
+    dtype = _np.dtype(dat.dtype) if hasattr(dat, 'dtype') \
+        else _np.dtype(type(dat))
+    if dtype == _np.dtype(_np.float32) \
+            and os.environ.get('MXNET_TPU_F32_VIA_MXU') == '1':
+        return _bf16_dtype()
+    return dtype
+
+
+def get_tolerance(dat, tol, default_tols, fallback=1e-4):
+    """Reference test_utils.py:135 — explicit tol wins; else the
+    default for dat's effective dtype."""
+    if tol is not None:
+        return tol
+    return default_tols.get(effective_dtype(dat), fallback)
+
+
+def get_tols(x, y, rtol=None, atol=None):
+    """Tolerances for comparing two datasets: the LOOSEST of the two
+    operands' per-dtype defaults (reference test_utils.py:154)."""
+    if not hasattr(x, 'dtype'):
+        x = _np.asarray(x)
+    if not hasattr(y, 'dtype'):
+        y = _np.asarray(y)
+    rtol = max(get_tolerance(x, rtol, default_rtols()),
+               get_tolerance(y, rtol, default_rtols()))
+    atol = max(get_tolerance(x, atol, default_atols(), fallback=1e-5),
+               get_tolerance(y, atol, default_atols(), fallback=1e-5))
+    return rtol, atol
+
+
+def get_rtol(rtol=None, dtype=None):
+    """Reference test_utils.py:175."""
+    if rtol is not None:
+        return rtol
+    return default_rtols()[_np.dtype(dtype or _np.float64)]
+
+
+def get_atol(atol=None, dtype=None):
+    """Reference test_utils.py:171."""
+    if atol is not None:
+        return atol
+    return default_atols()[_np.dtype(dtype or _np.float64)]
 
 
 def default_context():
@@ -50,50 +139,229 @@ def default_dtype():
     return _np.float32
 
 
-def _tols(a, b, rtol, atol):
-    dt = _np.result_type(a.dtype, b.dtype)
-    if rtol is None:
-        rtol = _DEFAULT_RTOL.get(_np.dtype(dt), 1e-4)
-    if atol is None:
-        atol = _DEFAULT_ATOL.get(_np.dtype(dt), 1e-5)
-    return rtol, atol
-
-
 def _as_np(x):
     if isinstance(x, NDArray):
         return x.asnumpy()
     return _np.asarray(x)
 
 
+def find_max_violation(a, b, rtol, atol):
+    """Location + size of the worst tolerance violation (reference
+    test_utils.py:578 _find_max_violation)."""
+    absdiff = _np.where(_np.equal(a, b), 0, _np.abs(a - b))
+    tol = atol + rtol * _np.abs(b)
+    violation = absdiff / (tol + 1e-20)
+    loc = _np.argmax(violation)
+    idx = _np.unravel_index(loc, violation.shape) if violation.shape \
+        else ()
+    return idx, float(_np.max(violation))
+
+
 def assert_almost_equal(a, b, rtol=None, atol=None, names=('a', 'b'),
                         equal_nan=False, use_broadcast=True):
-    """Reference test_utils.py:650."""
+    """Reference test_utils.py:650 — tolerances from get_tols (the
+    loosest of both operands' dtype classes), max-violation location in
+    the failure message."""
+    a_nd, b_nd = a, b
     a, b = _as_np(a), _as_np(b)
-    rtol, atol = _tols(a, b, rtol, atol)
+    rtol, atol = get_tols(a_nd if hasattr(a_nd, 'dtype') else a,
+                          b_nd if hasattr(b_nd, 'dtype') else b,
+                          rtol, atol)
     if not use_broadcast:
         assert a.shape == b.shape, f'shape mismatch {a.shape} vs {b.shape}'
-    _np.testing.assert_allclose(a.astype(_np.float64) if a.dtype != bool else a,
-                                b.astype(_np.float64) if b.dtype != bool else b,
-                                rtol=rtol, atol=atol, equal_nan=equal_nan,
-                                err_msg=f'{names[0]} != {names[1]}')
+    if a.dtype == bool and b.dtype == bool:
+        _np.testing.assert_equal(a, b)
+        return
+    af = a.astype(_np.float64) if a.dtype != bool else a
+    bf = b.astype(_np.float64) if b.dtype != bool else b
+    if _np.allclose(af, bf, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    ab, bb = _np.broadcast_arrays(af, bf)
+    idx, viol = find_max_violation(ab, bb, rtol, atol)
+    _np.testing.assert_allclose(
+        af, bf, rtol=rtol, atol=atol, equal_nan=equal_nan,
+        err_msg=(f'{names[0]} != {names[1]} (rtol={rtol}, atol={atol}): '
+                 f'worst violation {viol:.2f}x tolerance at {idx}: '
+                 f'{names[0]}={ab[idx]!r} {names[1]}={bb[idx]!r}'))
 
 
-def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False,
+                 use_broadcast=True):
+    a_nd, b_nd = a, b
     a, b = _as_np(a), _as_np(b)
-    rtol, atol = _tols(a, b, rtol, atol)
-    return _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    if not use_broadcast and a.shape != b.shape:
+        return False
+    rtol, atol = get_tols(a_nd if hasattr(a_nd, 'dtype') else a,
+                          b_nd if hasattr(b_nd, 'dtype') else b,
+                          rtol, atol)
+    return _np.allclose(a.astype(_np.float64), b.astype(_np.float64),
+                        rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
 def same(a, b):
     return _np.array_equal(_as_np(a), _as_np(b))
 
 
+def assign_each(the_input, function):
+    """Element-wise value rewrite (reference test_utils.py:66)."""
+    if function is None:
+        return the_input
+    return _np.vectorize(function)(the_input).astype(the_input.dtype)
+
+
+def _get_uniform_dataset_csr(num_rows, num_cols, density, dtype,
+                             data_init=None, shuffle_csr_indices=False):
+    """Uniformly-distributed CSR (reference test_utils.py:262): every
+    element independently present with probability ``density``."""
+    mask = _np.random.rand(num_rows, num_cols) < density
+    dense = _np.where(mask, _np.random.rand(num_rows, num_cols), 0.0)
+    if data_init is not None:
+        dense = _np.where(mask, data_init, 0.0)
+    dense = dense.astype(dtype)
+    from .ndarray import sparse as _sp
+    csr = _sp.csr_matrix(array(dense))
+    if shuffle_csr_indices:
+        # permute the within-row order of (indices, data) pairs: the
+        # reference uses this to prove kernels do not assume sorted
+        # column indices within a row
+        indptr = csr.indptr.asnumpy()
+        indices = csr.indices.asnumpy().copy()
+        data = csr.data.asnumpy().copy()
+        for r in range(num_rows):
+            s, e = int(indptr[r]), int(indptr[r + 1])
+            perm = _np.random.permutation(e - s)
+            indices[s:e] = indices[s:e][perm]
+            data[s:e] = data[s:e][perm]
+        csr = _sp.CSRNDArray(array(data), array(indptr),
+                             array(indices), (num_rows, num_cols))
+    return csr
+
+
+def _get_powerlaw_dataset_csr(num_rows, num_cols, density, dtype):
+    """Power-law CSR (reference test_utils.py:300): row n+1 holds twice
+    row n's nnz until the density budget is spent — the classic
+    recommender-workload shape."""
+    total_nnz = int(num_rows * num_cols * density)
+    unused = total_nnz
+    dense = _np.zeros((num_rows, num_cols), dtype=dtype)
+    col_max = 2
+    for r in range(num_rows):
+        if unused <= 0:
+            break
+        n = min(col_max, num_cols, unused)
+        cols = _np.random.choice(num_cols, size=n, replace=False)
+        dense[r, cols] = _np.random.rand(n)
+        unused -= n
+        col_max *= 2
+    from .ndarray import sparse as _sp
+    return _sp.csr_matrix(array(dense))
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        distribution=None, data_init=None,
+                        rsp_indices=None, modifier_func=None,
+                        shuffle_csr_indices=False, ctx=None):
+    """Random sparse ndarray + its host-side pieces (reference
+    test_utils.py:391-479): ``row_sparse`` samples present rows with
+    probability ``density`` (or takes explicit ``rsp_indices``); CSR
+    supports the uniform and powerlaw distributions. Returns
+    ``(ndarray, (values, indices))`` for row_sparse and
+    ``(ndarray, (indptr, indices, data))`` for csr. ``ctx`` is
+    accepted for reference-signature parity; arrays land on the
+    default context (single-process placement is a jit concern on this
+    backend, not an allocation-time one)."""
+    from .ndarray import sparse as _sp
+
+    density = _np.random.rand() if density is None else density
+    dtype = _np.dtype(dtype or default_dtype())
+    distribution = distribution or 'uniform'
+    if stype == 'row_sparse':
+        assert distribution == 'uniform', \
+            f'distribution {distribution} not supported for row_sparse'
+        if rsp_indices is not None:
+            indices = _np.asarray(rsp_indices)
+            assert len(indices) <= shape[0]
+            indices = _np.sort(indices)
+        else:
+            indices = _np.argwhere(
+                _np.random.rand(shape[0]) < density).flatten()
+        if indices.shape[0] == 0:
+            result = _sp.zeros('row_sparse', shape, dtype=str(dtype))
+            return result, (_np.zeros((0,) + tuple(shape[1:]), dtype),
+                            _np.array([], dtype=_np.int64))
+        val = _np.random.rand(indices.shape[0], *shape[1:]).astype(dtype)
+        if data_init is not None:
+            val.fill(data_init)
+        if modifier_func is not None:
+            val = assign_each(val, modifier_func)
+        arr = _sp.row_sparse_array(
+            (array(val), array(indices.astype(_np.int64))), shape=shape)
+        return arr, (val, indices)
+    if stype == 'csr':
+        assert len(shape) == 2
+        if distribution == 'uniform':
+            csr = _get_uniform_dataset_csr(
+                shape[0], shape[1], density, dtype, data_init=data_init,
+                shuffle_csr_indices=shuffle_csr_indices)
+        elif distribution == 'powerlaw':
+            csr = _get_powerlaw_dataset_csr(shape[0], shape[1], density,
+                                            dtype)
+        else:
+            raise ValueError(f'distribution not supported: {distribution}')
+        if modifier_func is not None:
+            # rewrite the stored nonzeros only (the reference applies
+            # modifier_func through create_sparse_array the same way)
+            data = assign_each(csr.data.asnumpy(), modifier_func)
+            csr = _sp.CSRNDArray(array(data), csr.indptr, csr.indices,
+                                 tuple(shape))
+        return csr, (csr.indptr, csr.indices, csr.data)
+    raise ValueError(f'unknown storage type {stype!r}')
+
+
+def create_sparse_array(shape, stype, data_init=None, rsp_indices=None,
+                        dtype=None, modifier_func=None, density=.5,
+                        shuffle_csr_indices=False):
+    """Reference test_utils.py:498 — canonical-format sparse array."""
+    arr, _ = rand_sparse_ndarray(
+        shape, stype, density=density, dtype=dtype, data_init=data_init,
+        rsp_indices=rsp_indices, modifier_func=modifier_func,
+        shuffle_csr_indices=shuffle_csr_indices)
+    return arr
+
+
+def create_sparse_array_zd(shape, stype, density, data_init=None,
+                           rsp_indices=None, dtype=None,
+                           modifier_func=None, shuffle_csr_indices=False):
+    """Reference test_utils.py:523 — rsp density comes only from the
+    explicit index list."""
+    if stype == 'row_sparse':
+        density = 0.0
+        if rsp_indices is not None:
+            assert len(rsp_indices) <= shape[0]
+    return create_sparse_array(shape, stype, data_init=data_init,
+                               rsp_indices=rsp_indices, dtype=dtype,
+                               modifier_func=modifier_func,
+                               density=density,
+                               shuffle_csr_indices=shuffle_csr_indices)
+
+
 def rand_ndarray(shape, stype='default', density=None, dtype='float32',
-                 ctx=None, scale=1.0):
-    """Reference test_utils.py:391 (dense; sparse stypes arrive with the
-    sparse module)."""
+                 ctx=None, scale=1.0, modifier_func=None,
+                 shuffle_csr_indices=False, distribution=None):
+    """Reference test_utils.py:482: dense, or any sparse stype via
+    rand_sparse_ndarray's density/distribution matrix. ``scale``
+    multiplies the sparse values too (base generation is [0, 1))."""
     if stype != 'default':
-        raise NotImplementedError('sparse rand_ndarray later')
+        if scale != 1.0:
+            base = modifier_func
+            modifier_func = (lambda v: v * scale) if base is None \
+                else (lambda v: base(v) * scale)
+        arr, _ = rand_sparse_ndarray(
+            shape, stype, density=density, dtype=dtype,
+            modifier_func=modifier_func,
+            shuffle_csr_indices=shuffle_csr_indices,
+            distribution=distribution, ctx=ctx)
+        return arr
     dtype = _np.dtype(dtype)
     if dtype.kind == 'f':
         data = _np.random.uniform(-scale, scale, shape).astype(dtype)
@@ -123,15 +391,23 @@ def random_arrays(*shapes):
     return arrays
 
 
-def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+def check_numeric_gradient(fn, inputs, eps=None, rtol=1e-2, atol=1e-3):
     """Finite differences vs autograd (reference test_utils.py:1040).
 
     ``fn`` maps a list of NDArrays to a scalar-reducible NDArray. Checks
-    d(sum(fn))/d(input) against central differences.
+    d(sum(fn))/d(input) against central differences. ``eps`` defaults
+    per input dtype from :func:`default_numeric_eps` (power-of-two
+    deltas drop no mantissa bits — reference :100).
     """
     from . import autograd
 
     inputs = [x if isinstance(x, NDArray) else array(x) for x in inputs]
+    if eps is None:
+        eps = max(default_numeric_eps().get(
+            _np.dtype(x.dtype), 1.0 / 2 ** 9) for x in inputs)
+        # the central-difference probe itself runs in float32 below, so
+        # never probe finer than the f32-appropriate delta
+        eps = float(max(eps, 1.0 / 2 ** 9))
     for x in inputs:
         x.attach_grad()
     with autograd.record():

@@ -1,0 +1,107 @@
+"""dist_async dead-server drill (VERDICT r4 item 10).
+
+Reference contract: ``include/mxnet/kvstore.h:408`` — after a node
+stops heartbeating, ``get_num_dead_node`` must report it; surviving
+workers touching the dead server must get a CLEAN error, never a hang.
+
+Launched as::
+
+    MXNET_KVSTORE_NUM_SERVERS=2 python tools/launch.py -n 4 \
+        --launcher local python tests/nightly/dist_async_dead_server.py
+
+Script: 4 workers / 2 servers (server s on rank s). Everyone trains a
+few pushes; then rank 1 — which HOSTS server 1 — dies abruptly
+(os._exit, no close(), so no 'bye' deregistration either). Survivors
+assert:
+
+* ``get_num_dead_node`` counts the lost rank (stale heartbeat) plus
+  the unreachable server;
+* a push/pull routed to server 1's keys raises within the dial
+  timeout — a clean ConnectionError/RuntimeError, not a hang;
+* server 0's keys keep working: the PS degrades per-shard, matching
+  the reference's per-server failure domain.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import _cpu_guard  # noqa: E402
+_cpu_guard.force_cpu()
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import kvstore  # noqa: E402
+
+
+def main():
+    kv = kvstore.create('dist_async')
+    rank, size = kv.rank, kv.num_workers
+    assert kv._nserv == 2
+
+    # place one key on each server, verifiably
+    kv.init('a', mx.np.zeros((4,)))
+    kv.barrier()
+    stats = kv.server_stats()
+    by_server = {sid: list(keys) for sid, keys in stats.items()}
+    assert 'a' in by_server[kv._key_server('a')]
+    # find key names hashing to each server so the test is deterministic
+    k0 = next(f'k{i}' for i in range(100) if kv._key_server(f'k{i}') == 0)
+    k1 = next(f'k{i}' for i in range(100) if kv._key_server(f'k{i}') == 1)
+    for k in (k0, k1):
+        kv.init(k, mx.np.zeros((4,)))
+    kv.barrier()
+    for k in (k0, k1):
+        kv.push(k, mx.np.ones((4,)))
+    kv.barrier()
+    want = float(size)
+    for k in (k0, k1):
+        onp.testing.assert_allclose(kv.pull(k).asnumpy(),
+                                    onp.full((4,), want), rtol=1e-6)
+    kv.barrier()
+
+    if rank == 1:
+        # the rank hosting server 1 dies NOW — no close(), no bye, the
+        # socket just goes away (a real crash, not a clean departure)
+        print(f'worker {rank}/{size}: dying with server 1', flush=True)
+        sys.stdout.flush()
+        os._exit(0)
+
+    # survivors: wait out the heartbeat staleness window
+    deadline = time.monotonic() + 30
+    dead = 0
+    while time.monotonic() < deadline:
+        time.sleep(1.0)
+        try:
+            dead = kv.get_num_dead_node(timeout=3)
+        except Exception:
+            dead = -1     # server 0 must stay answerable
+        if dead >= 1:
+            break
+    assert dead >= 1, f'rank {rank}: dead={dead}, lost rank not detected'
+
+    # touching the dead server must FAIL CLEANLY within the dial window
+    t0 = time.monotonic()
+    try:
+        kv.push(k1, mx.np.ones((4,)))
+        raised = False
+    except (ConnectionError, RuntimeError, OSError):
+        raised = True
+    elapsed = time.monotonic() - t0
+    assert raised, f'rank {rank}: push to dead server did not error'
+    assert elapsed < 60, f'rank {rank}: dead-server error took {elapsed}s'
+
+    # server 0's shard keeps serving
+    kv.push(k0, mx.np.ones((4,)))
+    got = kv.pull(k0).asnumpy()
+    assert got[0] >= want + 1.0, got
+
+    print(f'worker {rank}/{size}: dead-server drill passed '
+          f'(dead={dead}, error after {elapsed:.1f}s)', flush=True)
+
+
+if __name__ == '__main__':
+    main()
