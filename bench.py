@@ -983,6 +983,10 @@ def bench_suite(args):
         min_window=240)
     sub('kvstore', 'kvstore', '--iters', '10')
     sub('resnet_infer', 'resnet50_v1', '--iters', str(iters))
+    # llama (stretch row, VERDICT r4 missing #5) BEFORE int8: the 170m
+    # decode child is ~165s while int8 is ~300s — in this order both
+    # fit the budget; reversed, llama's window check fails every run
+    sub('llama', 'llama_decode', '--iters', '32', min_window=200)
     sub('int8', 'resnet50_int8', '--iters', str(max(iters // 2, 10)),
         min_window=220)
     ik = f'resnet50_int8_inference_batch{args.batch}'
@@ -991,8 +995,6 @@ def bench_suite(args):
         extras[ik]['vs_bf16'] = round(
             extras[ik]['value'] / extras[bk]['value'], 3)
         print(json.dumps(result), flush=True)
-    # stretch rows (VERDICT r4 missing #5) — only with real window left
-    sub('llama', 'llama_decode', '--iters', '32', min_window=240)
     if not adapted:
         sub('yolo', 'yolo3', '--iters', str(max(iters // 2, 10)),
             min_window=180)

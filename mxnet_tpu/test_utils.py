@@ -174,9 +174,18 @@ def assert_almost_equal(a, b, rtol=None, atol=None, names=('a', 'b'),
         return
     af = a.astype(_np.float64) if a.dtype != bool else a
     bf = b.astype(_np.float64) if b.dtype != bool else b
-    if _np.allclose(af, bf, rtol=rtol, atol=atol, equal_nan=equal_nan):
-        return
-    ab, bb = _np.broadcast_arrays(af, bf)
+    try:
+        if _np.allclose(af, bf, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan):
+            return
+        ab, bb = _np.broadcast_arrays(af, bf)
+    except ValueError:
+        # non-broadcastable shapes are a comparison FAILURE, not a
+        # harness error: keep raising AssertionError like the
+        # pre-fast-path implementation did
+        raise AssertionError(
+            f'{names[0]} != {names[1]}: shapes {a.shape} and {b.shape} '
+            f'cannot be broadcast together') from None
     idx, viol = find_max_violation(ab, bb, rtol, atol)
     _np.testing.assert_allclose(
         af, bf, rtol=rtol, atol=atol, equal_nan=equal_nan,

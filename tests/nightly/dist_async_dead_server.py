@@ -70,7 +70,11 @@ def main():
         sys.stdout.flush()
         os._exit(0)
 
-    # survivors: wait out the heartbeat staleness window
+    # survivors: wait out the heartbeat staleness window. dead >= 2
+    # requires BOTH detection paths: the unreachable-server ping (counts
+    # immediately) AND server 0's stale-heartbeat accounting for the
+    # lost rank (include/mxnet/kvstore.h:408) — dead == 1 would mean
+    # the heartbeat table is broken
     deadline = time.monotonic() + 30
     dead = 0
     while time.monotonic() < deadline:
@@ -79,9 +83,10 @@ def main():
             dead = kv.get_num_dead_node(timeout=3)
         except Exception:
             dead = -1     # server 0 must stay answerable
-        if dead >= 1:
+        if dead >= 2:
             break
-    assert dead >= 1, f'rank {rank}: dead={dead}, lost rank not detected'
+    assert dead >= 2, f'rank {rank}: dead={dead} — expected the lost ' \
+        f'worker heartbeat AND the unreachable server to be counted'
 
     # touching the dead server must FAIL CLEANLY within the dial window
     t0 = time.monotonic()

@@ -167,9 +167,12 @@ def test_numeric(name, fn, want):
     from mxnet_tpu.test_utils import assert_almost_equal
     got = fn()
     got = got.asnumpy() if hasattr(got, 'asnumpy') else onp.asarray(got)
-    # shared dtype-aware tolerances (test_utils.get_tols): f32 cases
-    # compare at the f32 class, int/bool exactly
-    assert_almost_equal(got, onp.asarray(want), names=(name, 'ref'))
+    # shared harness with this sweep's historical tolerances pinned
+    # explicitly — the f32-class defaults (1e-4/1e-5) would LOOSEN the
+    # sweep 5-10x (bool compares stay exact; int off-by-ones still trip
+    # the 2e-5 rtol at any magnitude these cases use)
+    assert_almost_equal(got, onp.asarray(want), rtol=2e-5, atol=1e-6,
+                        names=(name, 'ref'))
 
 
 # ---- checker-style cases (distributions, decompositions, samplers)
