@@ -45,14 +45,14 @@ def _np(x):
 def test_continuous_log_prob_cdf_vs_scipy(case):
     name, make, ref, x = case
     d = make()
-    got = float(_np(d.log_prob(mx.np.array([x]))))
+    got = float(_np(d.log_prob(mx.np.array([x]))).item())
     onp.testing.assert_allclose(got, ref.logpdf(x), rtol=2e-5, atol=2e-6)
     try:
-        got_cdf = float(_np(d.cdf(mx.np.array([x]))))
+        got_cdf = float(_np(d.cdf(mx.np.array([x]))).item())
         onp.testing.assert_allclose(got_cdf, ref.cdf(x), rtol=2e-5,
                                     atol=2e-6)
         p = 0.3
-        got_icdf = float(_np(d.icdf(mx.np.array([p]))))
+        got_icdf = float(_np(d.icdf(mx.np.array([p]))).item())
         onp.testing.assert_allclose(got_icdf, ref.ppf(p), rtol=2e-5,
                                     atol=2e-5)
     except NotImplementedError:
@@ -73,7 +73,7 @@ def test_continuous_log_prob_cdf_vs_scipy(case):
 def test_discrete_log_prob_vs_scipy(case):
     name, make, ref, x = case
     d = make()
-    got = float(_np(d.log_prob(mx.np.array([x]))))
+    got = float(_np(d.log_prob(mx.np.array([x]))).item())
     onp.testing.assert_allclose(got, ref.logpmf(x), rtol=2e-5, atol=2e-6)
 
 
@@ -214,7 +214,7 @@ def test_register_kl_custom():
     def _kl(p, q):
         return mx.np.array([42.0])
 
-    assert float(_np(mgp.kl_divergence(MyDist(0, 1), MyDist(0, 1)))) == 42
+    assert float(_np(mgp.kl_divergence(MyDist(0, 1), MyDist(0, 1))).item()) == 42
 
 
 # ----------------------------------------------- grad through samples
@@ -277,7 +277,7 @@ def test_transformed_distribution_lognormal():
     d = mgp.TransformedDistribution(base, mgp.ExpTransform())
     x = 1.7
     onp.testing.assert_allclose(
-        float(_np(d.log_prob(mx.np.array([x])))),
+        float(_np(d.log_prob(mx.np.array([x]))).item()),
         ss.lognorm(sigma, scale=onp.exp(mu)).logpdf(x), rtol=1e-5)
     s = d.sample((5000,))
     assert (_np(s) > 0).all()
@@ -293,7 +293,7 @@ def test_compose_and_affine_transform():
     # y = exp(1 + 2x): logpdf(y) = normal.logpdf((log y - 1)/2) - log(2y)
     y = 3.0
     want = ss.norm(0, 1).logpdf((onp.log(y) - 1) / 2) - onp.log(2 * y)
-    onp.testing.assert_allclose(float(_np(d.log_prob(mx.np.array([y])))),
+    onp.testing.assert_allclose(float(_np(d.log_prob(mx.np.array([y]))).item()),
                                 want, rtol=1e-5)
     # inverse round trip
     x = mx.np.array([0.3])
@@ -377,7 +377,7 @@ def test_stochastic_sequential():
     net.add(AddLoss(1.0), AddLoss(2.0))
     out = net(mx.np.zeros((1,)))
     onp.testing.assert_allclose(_np(out), [2.0])
-    vals = [float(_np(l[0])) for l in net.losses]
+    vals = [float(_np(l[0]).item()) for l in net.losses]
     assert vals == [1.0, 2.0]
     assert len(net) == 2
 
