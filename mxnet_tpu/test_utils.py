@@ -445,16 +445,63 @@ def check_numeric_gradient(fn, inputs, eps=None, rtol=1e-2, atol=1e-3):
                                     err_msg=f'gradient mismatch for input {i}')
 
 
-def check_consistency(fn, inputs, ctx_list=None, rtol=None, atol=None):
-    """Same computation across contexts/dtypes (reference
-    test_utils.py:check_consistency)."""
+def check_consistency(fn, inputs, ctx_list=None, *, dtype_list=None,
+                      rtol=None, atol=None):
+    """Same computation across contexts AND dtypes (reference
+    test_utils.py check_consistency: each spec in ctx_list carried its
+    own type_dict; every run is compared against the highest-precision
+    run at the LOOSER operand's tolerance class).
+
+    ``fn`` maps NDArrays to an NDArray (or tuple). ``dtype_list``
+    defaults to ``['float32']``; pass e.g. ``['float16', 'bfloat16',
+    'float32']`` to sweep the matrix — the float32 run is the
+    reference, and each lower-precision run must agree within ITS
+    dtype-class tolerance (get_tols). Returns the per-(ctx, dtype)
+    outputs keyed ``(ctx, dtype)`` for further assertions."""
     ctx_list = ctx_list or [cpu(), default_context()]
-    outs = []
+    uniq, seen = [], set()
+    for c in ctx_list:                 # cpu CI: default ctx == cpu(0)
+        if str(c) not in seen:
+            seen.add(str(c))
+            uniq.append(c)
+    ctx_list = uniq
+    dtype_list = list(dtype_list or ['float32'])
+    # highest-precision dtype is the reference run. bf16 ranks BELOW
+    # fp16: 8 mantissa bits vs 10 (same ordering as the tolerance
+    # classes above). Normalize via np.dtype(...).name so scalar types
+    # (np.float16) and strings rank identically.
+    order = {'float64': 3, 'float32': 2, 'float16': 1, 'bfloat16': 0}
+
+    def _name(d):
+        return _np.dtype(d).name
+
+    def _floatish(dtype):
+        return _np.dtype(dtype).kind == 'f' or \
+            _np.dtype(dtype) == _bf16_dtype()
+
+    ref_dt = max(dtype_list, key=lambda d: order.get(_name(d), 2))
+    results = {}
     for ctx in ctx_list:
-        xs = [x.as_in_context(ctx) for x in inputs]
-        outs.append(_as_np(fn(*xs)))
-    for o in outs[1:]:
-        assert_almost_equal(outs[0], o, rtol=rtol, atol=atol)
+        for dt in dtype_list:
+            xs = [x.as_in_context(ctx).astype(dt)
+                  if _floatish(x.dtype) else x.as_in_context(ctx)
+                  for x in inputs]
+            out = fn(*xs)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            results[(str(ctx), _name(dt))] = [_as_np(o) for o in outs]
+    ref_key = (str(ctx_list[0]), _name(ref_dt))
+    ref = results[ref_key]
+    for key, outs in results.items():
+        if key == ref_key:
+            continue
+        assert len(outs) == len(ref), (
+            f'{key} returned {len(outs)} outputs but the reference '
+            f'{ref_key} returned {len(ref)}')
+        for i, (got, want) in enumerate(zip(outs, ref)):
+            assert_almost_equal(
+                got, want, rtol=rtol, atol=atol,
+                names=(f'{key}[{i}]', f'{ref_key}[{i}]'))
+    return results
 
 
 def simple_forward(fn, *inputs):

@@ -170,3 +170,32 @@ def test_check_numeric_gradient_dtype_eps():
     # default eps resolves per-dtype and the check still passes
     tu.check_numeric_gradient(lambda x: (x ** 2).sum(),
                               [np.array([0.5, -1.5], 'float32')])
+
+
+def test_check_consistency_dtype_matrix():
+    """check_consistency sweeps the dtype matrix: every lower-precision
+    run is compared to the highest-precision reference at the looser
+    class tolerance (reference test_utils.py check_consistency)."""
+    import mxnet_tpu as mx
+
+    def fn(a, b):
+        return mx.np.tanh(a) + b * 0.5
+
+    inputs = [mx.np.array(np.linspace(-2, 2, 12, dtype='float32')
+                          .reshape(3, 4)),
+              mx.np.ones((3, 4))]
+    res = tu.check_consistency(fn, inputs,
+                               dtype_list=['float16', 'bfloat16',
+                                           'float32'])
+    n_ctx = len({str(c) for c in (tu.cpu(), tu.default_context())})
+    assert len(res) == 3 * n_ctx
+    # and a genuinely inconsistent fn fails
+    state = {'n': 0}
+
+    def bad(a, b):
+        state['n'] += 1
+        return a + (10.0 if state['n'] > 1 else 0.0)
+
+    with pytest.raises(AssertionError):
+        tu.check_consistency(bad, inputs,
+                             dtype_list=['float16', 'float32'])
