@@ -17,6 +17,7 @@ Output: one JSON line per op with fwd/bwd latency (ms).
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -328,11 +329,12 @@ def main():
     ap.add_argument('--cpu', action='store_true')
     args = ap.parse_args()
 
+    # repo root on sys.path regardless of device: `python
+    # benchmark/opperf.py` puts only benchmark/ there, so the TPU-mode
+    # import of mxnet_tpu died with ModuleNotFoundError (r5 smoke)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     if args.cpu:
-        import os
-        import sys as _s
-        _s.path.insert(0, os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
         import _cpu_guard
         _cpu_guard.force_cpu()
 
