@@ -13,6 +13,18 @@ Usage:
     python benchmark/opperf.py --all              # everything with a rule
     python benchmark/opperf.py --cpu --runs 20
 Output: one JSON line per op with fwd/bwd latency (ms).
+
+KVStore soak mode (`--kvstore-soak N`): N push/pull rounds on an
+in-process ``dist_async`` store under a fixed fault spec
+(``--fault-spec``, default a deterministic periodic connection reset),
+verifying exactly-once delivery against the server's apply counters and
+printing one JSON line with retry/injection/apply counts — regressions
+in the recovery path show up in the bench trajectory. Exit status is
+non-zero when verification fails.
+
+    python benchmark/opperf.py --cpu --kvstore-soak 50
+    python benchmark/opperf.py --cpu --kvstore-soak 200 \
+        --fault-spec 'reset_every:push:5;drop:push:0.2:seed=3'
 """
 
 import argparse
@@ -317,6 +329,56 @@ def bench_op(mx, name, runs=10, warmup=3, backward=True):
             'fwd_bwd_ms': round(bwd_ms, 4) if bwd_ms is not None else None}
 
 
+def kvstore_soak(rounds, fault_spec, size=1024, keys=2, port=None):
+    """N rounds of push/pull per key on an in-process ``dist_async``
+    store with a fault plan armed; returns the result record. The
+    invariant proved: after N pushes of ones — across every injected
+    reset/drop and the retries they trigger — each key holds exactly N
+    and the server applied exactly ``rounds * keys`` pushes (the
+    exactly-once seq-dedup contract, docs/fault-tolerance.md)."""
+    import time
+    if port is None:
+        port = 49821
+    os.environ.setdefault('MX_COORDINATOR', f'127.0.0.1:{port}')
+    os.environ.setdefault('MXNET_KVSTORE_ASYNC_PORT', str(port + 30))
+    os.environ.setdefault('MXNET_KVSTORE_HEARTBEAT_S', '3600')
+    os.environ.setdefault('MXNET_KVSTORE_RPC_BACKOFF_S', '0.005')
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore
+    from mxnet_tpu.kvstore import faults
+
+    faults.clear()
+    if fault_spec:
+        faults.configure(fault_spec)
+    kv = kvstore.create('dist_async')
+    names = [f'soak{k}' for k in range(keys)]
+    for n in names:
+        kv.init(n, mx.np.zeros((size,)))
+    one = mx.np.ones((size,))
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for n in names:
+            kv.push(n, one)
+            kv.pull(n)
+    elapsed = time.perf_counter() - t0
+    ok = all(np.allclose(kv.pull(n).asnumpy(), float(rounds))
+             for n in names)
+    counters = kv.server_health()[0]['counters']
+    ok = ok and counters['push_applied'] == rounds * keys
+    result = {
+        'mode': 'kvstore-soak', 'rounds': rounds, 'keys': keys,
+        'fault_spec': fault_spec, 'elapsed_s': round(elapsed, 3),
+        'transport': kv.transport_stats(),
+        'faults': faults.injected(),
+        'server_counters': counters,
+        'verified_exactly_once': ok,
+    }
+    faults.clear()
+    kv.close()
+    return result
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--ops', default=None,
@@ -327,6 +389,14 @@ def main():
     ap.add_argument('--warmup', type=int, default=3)
     ap.add_argument('--no-backward', action='store_true')
     ap.add_argument('--cpu', action='store_true')
+    ap.add_argument('--kvstore-soak', type=int, default=None,
+                    metavar='N',
+                    help='run N dist_async push/pull rounds under '
+                         '--fault-spec instead of op benchmarks')
+    ap.add_argument('--fault-spec',
+                    default='reset_every:push:7;delay:push:1ms',
+                    help='MXNET_KVSTORE_FAULT_SPEC grammar for the '
+                         'soak (empty string = fault-free)')
     args = ap.parse_args()
 
     # repo root on sys.path regardless of device: `python
@@ -337,6 +407,11 @@ def main():
     if args.cpu:
         import _cpu_guard
         _cpu_guard.force_cpu()
+
+    if args.kvstore_soak is not None:
+        res = kvstore_soak(args.kvstore_soak, args.fault_spec)
+        print(json.dumps(res), flush=True)
+        sys.exit(0 if res['verified_exactly_once'] else 1)
 
     import numpy as np
     import mxnet_tpu as mx

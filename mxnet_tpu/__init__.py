@@ -14,6 +14,41 @@ device mesh. No libmxnet.so, no ctypes — the "C API layer" of the reference
 collapses into in-process Python→XLA dispatch.
 """
 
+# jax version compat: newer jax exposes ``jax.typeof``; the 0.4.x line
+# some images pin does not. The tape records out_avals via jax.typeof at
+# every differentiable call site (ops/registry, _tape, autograd), so
+# backfill it from shaped_abstractify — the same ShapedArray answer for
+# the concrete arrays those sites pass.
+import jax as _jax
+
+if not hasattr(_jax, 'typeof'):
+    from jax.api_util import shaped_abstractify as _shaped_abstractify
+    _jax.typeof = _shaped_abstractify
+
+# Same drift for ``jax.shard_map`` (promoted out of jax.experimental and
+# renamed check_rep→check_vma): backfill a keyword-compatible wrapper so
+# version-agnostic callers (tools/overlap/aot_overlap.py) work on 0.4.x.
+if not hasattr(_jax, 'shard_map'):
+    from jax.experimental.shard_map import shard_map as _xp_shard_map
+
+    def _shard_map_compat(f=None, **kw):
+        if 'check_vma' in kw:
+            kw['check_rep'] = kw.pop('check_vma')
+        if f is None:
+            return lambda g: _xp_shard_map(g, **kw)
+        return _xp_shard_map(f, **kw)
+
+    _jax.shard_map = _shard_map_compat
+
+# ``jax.lax.axis_size`` (newer jax) — on 0.4.x ``psum(1, axis)`` is the
+# documented equivalent and constant-folds to a static Python int.
+if not hasattr(_jax.lax, 'axis_size'):
+    def _axis_size(axis_name, _psum=_jax.lax.psum):
+        return _psum(1, axis_name)
+
+    _jax.lax.axis_size = _axis_size
+del _jax
+
 from .libinfo import __version__
 
 from .base import MXNetError

@@ -46,8 +46,23 @@ Capacity (reference ``kvstore_dist.h:621`` EncodeDefaultKey):
   older than ``t`` plus any unreachable server — a real answer, not
   the stub the reference's Postoffice heartbeat would give
   (ps-lite Postoffice::GetDeadNodes).
+* **Fault tolerance** (docs/fault-tolerance.md) — every RPC retries
+  with exponential backoff + jitter under a per-call deadline and
+  redials broken sockets (``MXNET_KVSTORE_RPC_RETRIES`` /
+  ``MXNET_KVSTORE_RPC_DEADLINE_S`` / ``MXNET_KVSTORE_RPC_BACKOFF_S``),
+  so a server restart or TCP reset is absorbed, not fatal (≙ ps-lite
+  Resender). Mutating RPCs carry a per-store ``(client, seq)`` identity
+  deduped in a server-side replay window
+  (``MXNET_KVSTORE_DEDUP_WINDOW``): a retried already-applied push is
+  answered from cache — exactly-once gradients under retry. Ranks that
+  send ``bye`` are tombstoned so a delayed in-flight ping cannot
+  resurrect them in the dead-node accounting. Every recovery path is
+  testable in-process through the deterministic fault-injection hooks
+  in ``mxnet_tpu/kvstore/faults.py``
+  (``MXNET_KVSTORE_FAULT_SPEC``).
 """
 
+import collections
 import json
 import os
 import pickle
@@ -59,7 +74,15 @@ import threading
 import numpy as _onp
 
 from ..ndarray.ndarray import NDArray
+from . import faults
 from .base import KVStoreBase, register
+
+# RPCs that change server state: they carry a per-store (client, seq)
+# identity so a retry of an applied-but-reply-lost request is answered
+# from the server's dedup window instead of re-applied (pull/ping/stats
+# are idempotent and need no window)
+_MUTATING_CMDS = frozenset(
+    {'init', 'push', 'set_optimizer', 'register_server', 'barrier'})
 
 
 def _recv_exact(sock, n):
@@ -73,6 +96,7 @@ def _recv_exact(sock, n):
 
 
 def _send_msg(sock, header, payload=b''):
+    faults.on_send(header)          # no-op unless a fault plan is armed
     head = json.dumps(header).encode('utf-8')
     sock.sendall(struct.pack('!II', len(head), len(payload)))
     sock.sendall(head)
@@ -81,6 +105,7 @@ def _send_msg(sock, header, payload=b''):
 
 
 def _recv_msg(sock):
+    faults.on_recv(sock)            # no-op unless a fault plan is armed
     hlen, plen = struct.unpack('!II', _recv_exact(sock, 8))
     header = json.loads(_recv_exact(sock, hlen).decode('utf-8'))
     payload = _recv_exact(sock, plen) if plen else b''
@@ -100,6 +125,21 @@ class _AsyncServer(threading.Thread):
         self._lock = threading.Lock()
         self._last_seen = {}        # worker rank -> monotonic last beat
         self._server_table = {}     # sid -> 'host:port' (server 0 only)
+        # ranks that sent 'bye': a delayed in-flight ping from a
+        # departed worker must not re-enter it into _last_seen (the
+        # ADVICE r5 heartbeat race) — only a real data RPC (a new store
+        # incarnation of the same rank) lifts the tombstone
+        self._tombstones = set()
+        # (client, seq) -> (reply, rpayload) replay window for retried
+        # mutating RPCs whose reply was lost after the server applied
+        # them: exactly-once pushes under retry (≙ ps-lite's resender
+        # dedup by message timestamp)
+        self._dedup = {}
+        self._dedup_order = collections.deque()
+        self._dedup_window = int(os.environ.get(
+            'MXNET_KVSTORE_DEDUP_WINDOW', '512'))
+        self._counters = {'init_applied': 0, 'push_applied': 0,
+                          'dedup_replays': 0}
         self._secret = os.environ.get('MXNET_KVSTORE_SECRET', '')
         # addresses that count as "same host" for the no-secret
         # set_optimizer gate: loopback plus the bind interface itself
@@ -111,6 +151,7 @@ class _AsyncServer(threading.Thread):
             pass
         self._barrier_count = 0
         self._barrier_gen = 0
+        self._barrier_arrivals = set()   # (client, seq) this generation
         self._barrier_cv = threading.Condition()
         outer = self
 
@@ -127,7 +168,15 @@ class _AsyncServer(threading.Thread):
                     except Exception as e:    # keep the connection alive
                         reply, rpayload = {'ok': False,
                                            'error': repr(e)}, b''
-                    _send_msg(self.request, reply, rpayload)
+                    try:
+                        _send_msg(self.request, reply, rpayload)
+                    except (ConnectionError, OSError):
+                        # the peer reset/closed mid-reply (e.g. its
+                        # retrying RPC layer already gave up on this
+                        # socket): it will resend on a fresh
+                        # connection and the dedup window answers —
+                        # nothing to report, no traceback spew
+                        return
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -149,15 +198,53 @@ class _AsyncServer(threading.Thread):
         self._server.shutdown()
 
     # ----------------------------------------------------------- handlers
+    # data-plane commands prove a live store: they lift a tombstone (a
+    # NEW store of a departed rank revives it); ping/bye/queries do not
+    _REVIVING_CMDS = frozenset(
+        {'init', 'push', 'pull', 'barrier', 'set_optimizer'})
+
     def _dispatch(self, header, payload, peer='127.0.0.1'):
+        """Bookkeeping envelope around :meth:`_handle`: heartbeat
+        refresh (tombstone-gated), then the (client, seq) dedup window
+        — a retried mutating RPC the server already applied gets its
+        cached reply replayed instead of a second apply."""
         import time as _time
         cmd = header['cmd']
         rank = header.get('rank')
-        if rank is not None:
+        client, seq = header.get('client'), header.get('seq')
+        with self._lock:
+            if rank is not None:
+                r = int(rank)
+                if r not in self._tombstones:
+                    # every RPC doubles as a heartbeat (plus the
+                    # dedicated ping thread on each worker)
+                    self._last_seen[r] = _time.monotonic()
+                elif cmd in self._REVIVING_CMDS:
+                    self._tombstones.discard(r)
+                    self._last_seen[r] = _time.monotonic()
+            if client is not None and seq is not None:
+                cached = self._dedup.get((client, int(seq)))
+                if cached is not None:
+                    self._counters['dedup_replays'] += 1
+                    return cached
+        reply, rpayload = self._handle(header, payload, peer)
+        if client is not None and seq is not None and reply.get('ok'):
+            # only successful applies enter the window: a failed
+            # attempt must re-execute, not replay its error
             with self._lock:
-                # every RPC doubles as a heartbeat (plus the dedicated
-                # ping thread on each worker)
-                self._last_seen[int(rank)] = _time.monotonic()
+                key = (client, int(seq))
+                if key not in self._dedup:
+                    self._dedup[key] = (reply, rpayload)
+                    self._dedup_order.append(key)
+                    while len(self._dedup_order) > self._dedup_window:
+                        self._dedup.pop(self._dedup_order.popleft(),
+                                        None)
+        return reply, rpayload
+
+    def _handle(self, header, payload, peer='127.0.0.1'):
+        import time as _time
+        cmd = header['cmd']
+        rank = header.get('rank')
         if cmd == 'ping':
             return {'ok': True, 'sid': self._sid}, b''
         if cmd == 'register_server':
@@ -172,26 +259,35 @@ class _AsyncServer(threading.Thread):
         if cmd == 'bye':
             # clean departure: drop the rank from the last-seen table so
             # get_num_dead_node does not report a finished worker as
-            # dead forever (ADVICE r4)
+            # dead forever (ADVICE r4), and tombstone it so a delayed
+            # in-flight ping cannot re-add it afterwards (ADVICE r5)
             with self._lock:
                 self._last_seen.pop(int(rank), None)
+                self._tombstones.add(int(rank))
             return {'ok': True}, b''
         if cmd == 'dead_nodes':
             cutoff = _time.monotonic() - float(header['timeout'])
             with self._lock:
                 dead = sum(1 for t in self._last_seen.values()
                            if t < cutoff)
-            return {'ok': True, 'dead': dead}, b''
+                departed = len(self._tombstones)
+            # tombstoned ranks left CLEANLY: reported separately, never
+            # counted dead
+            return {'ok': True, 'dead': dead, 'departed': departed}, b''
         if cmd == 'stats':
             with self._lock:
                 return {'ok': True, 'sid': self._sid,
-                        'keys': sorted(map(str, self._store))}, b''
+                        'keys': sorted(map(str, self._store)),
+                        'counters': dict(self._counters),
+                        'tombstones': sorted(self._tombstones),
+                        'faults': faults.injected()}, b''
         if cmd == 'init':
             arr = _onp.frombuffer(payload, header['dtype']).reshape(
                 header['shape']).copy()
             with self._lock:
                 # first init wins (reference: rank 0 authoritative)
                 self._store.setdefault(header['key'], arr)
+                self._counters['init_applied'] += 1
             return {'ok': True}, b''
         if cmd == 'push':
             grad = _onp.frombuffer(payload, header['dtype']).reshape(
@@ -208,6 +304,7 @@ class _AsyncServer(threading.Thread):
                         wn.asnumpy())
                 else:
                     self._store[header['key']] = w + grad
+                self._counters['push_applied'] += 1
             return {'ok': True}, b''
         if cmd == 'pull':
             with self._lock:
@@ -247,11 +344,20 @@ class _AsyncServer(threading.Thread):
             return {'ok': True}, b''
         if cmd == 'barrier':
             n = header['nproc']
+            # retry identity: a worker whose connection died while its
+            # original barrier handler is still blocked in wait_for
+            # re-sends the SAME (client, seq) on a fresh socket — that
+            # duplicate must wait for the release, not arrive twice
+            ident = (header.get('client'), header.get('seq'))
             with self._barrier_cv:
                 gen = self._barrier_gen
-                self._barrier_count += 1
+                if ident == (None, None) \
+                        or ident not in self._barrier_arrivals:
+                    self._barrier_arrivals.add(ident)
+                    self._barrier_count += 1
                 if self._barrier_count >= n:
                     self._barrier_count = 0
+                    self._barrier_arrivals = set()
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                 else:
@@ -263,6 +369,7 @@ class _AsyncServer(threading.Thread):
                         # failure to the caller instead of silently
                         # proceeding unsynchronized
                         self._barrier_count -= 1
+                        self._barrier_arrivals.discard(ident)
                         return {'ok': False,
                                 'error': 'barrier timeout after 120s: '
                                          'not all workers arrived'}, b''
@@ -282,8 +389,9 @@ class KVStoreDistAsync(KVStoreBase):
     def __init__(self):
         self._rank = int(os.environ.get('MX_PROC_ID', '0'))
         self._nproc = int(os.environ.get('MX_NPROC', '1'))
-        self._socks = {}            # sid -> socket
+        self._socks = {}            # sid -> socket (None == needs redial)
         self._sock_locks = {}       # sid -> Lock (heartbeat vs caller)
+        self._addrs = {}            # sid -> (host, port) for reconnects
         self._server = None
         self._port = None
         self._host = ' '
@@ -292,18 +400,47 @@ class KVStoreDistAsync(KVStoreBase):
         self._big = int(float(os.environ.get(
             'MXNET_KVSTORE_BIGARRAY_BOUND', str(1 << 20))))
         self._hb_thread = None
+        # resilient-transport knobs: a transient server restart or TCP
+        # reset is absorbed by redial + retry instead of killing the
+        # job (≙ ps-lite Resender/PS_RESEND, Van reconnect)
+        self._rpc_retries = int(os.environ.get(
+            'MXNET_KVSTORE_RPC_RETRIES', '4'))
+        self._rpc_deadline = float(os.environ.get(
+            'MXNET_KVSTORE_RPC_DEADLINE_S', '60'))
+        self._rpc_backoff = float(os.environ.get(
+            'MXNET_KVSTORE_RPC_BACKOFF_S', '0.05'))
+        # per-store identity + monotonic sequence for mutating RPCs:
+        # the server's dedup window keys on (client, seq) so a retried
+        # already-applied push replays its reply (exactly-once). The
+        # client id disambiguates several stores of the same rank in
+        # one process (each runs its own seq counter from 0).
+        import uuid
+        self._client = uuid.uuid4().hex
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._transport_stats = {'retries': 0, 'redials': 0,
+                                 'giveups': 0}
 
     # ------------------------------------------------------------ plumbing
-    def _dial(self, host, port):
+    def _dial(self, host, port, deadline=None):
+        """Connect with bounded patience: the startup path keeps the
+        historical ~10s budget; reconnects inside a retrying RPC pass
+        the caller's remaining ``deadline`` (monotonic timestamp)."""
+        import time
         last = None
         for _ in range(100):
+            if deadline is not None and time.monotonic() >= deadline:
+                break
             try:
                 s = socket.create_connection((host, port), timeout=5)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # per-call timeouts are managed by _rpc_to from its
+                # deadline; an unset timeout here would otherwise cap
+                # every recv (barriers included) at connect's 5s
+                s.settimeout(None)
                 return s
             except OSError as e:
                 last = e
-                import time
                 time.sleep(0.1)
         raise ConnectionError(
             f'cannot reach dist_async server at {host}:{port}: {last}')
@@ -333,6 +470,7 @@ class KVStoreDistAsync(KVStoreBase):
         # coordinator host: the server may be bound to that interface
         # only, so rank 0 dialing loopback would be refused
         target = '127.0.0.1' if local else host
+        self._addrs[0] = (target, self._port)
         self._socks[0] = self._dial(target, self._port)
         self._sock_locks[0] = threading.Lock()
         if self._nserv > 1:
@@ -369,6 +507,7 @@ class KVStoreDistAsync(KVStoreBase):
             for sid_s, addr in table.items():
                 h, p = addr.rsplit(':', 1)
                 sid = int(sid_s)
+                self._addrs[sid] = (h, int(p))
                 self._socks[sid] = self._dial(h, int(p))
                 self._sock_locks[sid] = threading.Lock()
         if self._hb_thread is None:
@@ -388,7 +527,12 @@ class KVStoreDistAsync(KVStoreBase):
                     if st is None:
                         return        # store collected
                     try:
-                        st._rpc_to(0, {'cmd': 'ping'})
+                        # single attempt, short deadline: a lost beat
+                        # is harmless (the next one retries, and every
+                        # real RPC piggybacks a heartbeat) — retrying
+                        # here would pin the socket lock for seconds
+                        st._rpc_to(0, {'cmd': 'ping'}, attempts=1,
+                                   deadline_s=5)
                     except Exception:
                         return        # job shutting down
                     del st
@@ -413,17 +557,22 @@ class KVStoreDistAsync(KVStoreBase):
         if 0 in self._socks:
             try:
                 # clean departure: deregister from the heartbeat table so
-                # this rank is not counted dead forever (ADVICE r4)
-                self._rpc_to(0, {'cmd': 'bye'})
+                # this rank is not counted dead forever (ADVICE r4);
+                # single short attempt — shutdown must not hang on a
+                # server that is already gone
+                self._rpc_to(0, {'cmd': 'bye'}, attempts=1, deadline_s=5)
             except Exception:
                 pass              # server already gone: nothing to tell
         for sid, sock in list(self._socks.items()):
+            if sock is None:        # dropped by a failed RPC, no redial
+                continue
             try:
                 sock.close()
             except OSError:
                 pass
         self._socks.clear()
         self._sock_locks.clear()
+        self._addrs.clear()
 
     def __del__(self):                  # pragma: no cover - GC timing
         try:
@@ -431,11 +580,75 @@ class KVStoreDistAsync(KVStoreBase):
         except Exception:
             pass
 
-    def _rpc_to(self, sid, header, payload=b''):
+    def _rpc_to(self, sid, header, payload=b'', attempts=None,
+                deadline_s=None):
+        """One RPC with retry/backoff + reconnect.
+
+        Transport failures (``ConnectionError``/``OSError``/socket
+        timeout — including fault-injected ones) close and re-dial the
+        server socket, then resend with exponential backoff + jitter
+        until ``MXNET_KVSTORE_RPC_RETRIES`` attempts or the
+        ``MXNET_KVSTORE_RPC_DEADLINE_S`` per-call deadline run out.
+        Mutating RPCs carry (client, seq) so the server's dedup window
+        makes the resend exactly-once; a half-written request or
+        half-read reply can never desync the stream because the socket
+        is dropped on EVERY failure. Application-level errors
+        (``ok: False`` replies) are NOT retried — they surface as
+        ``RuntimeError`` exactly as before."""
+        import random
+        import time
         header['rank'] = self._rank
+        if header['cmd'] in _MUTATING_CMDS and 'seq' not in header:
+            with self._seq_lock:
+                self._seq += 1
+                header['seq'] = self._seq
+            header['client'] = self._client
+        deadline = time.monotonic() + (
+            self._rpc_deadline if deadline_s is None else deadline_s)
+        if attempts is None:
+            attempts = max(1, self._rpc_retries + 1)
+        last = None
         with self._sock_locks[sid]:
-            _send_msg(self._socks[sid], header, payload)
-            reply, rpayload = _recv_msg(self._socks[sid])
+            for attempt in range(attempts):
+                try:
+                    sock = self._socks.get(sid)
+                    if sock is None:
+                        host, port = self._addrs[sid]
+                        sock = self._dial(host, port, deadline=deadline)
+                        self._socks[sid] = sock
+                        self._transport_stats['redials'] += 1
+                    sock.settimeout(
+                        max(0.05, deadline - time.monotonic()))
+                    _send_msg(sock, header, payload)
+                    reply, rpayload = _recv_msg(sock)
+                    sock.settimeout(None)
+                    break
+                except (ConnectionError, TimeoutError, OSError) as e:
+                    last = e
+                    sock = self._socks.get(sid)
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                    self._socks[sid] = None
+                    now = time.monotonic()
+                    if attempt + 1 >= attempts or now >= deadline:
+                        self._transport_stats['giveups'] += 1
+                        host, port = self._addrs.get(
+                            sid, (self._host, self._port))
+                        raise ConnectionError(
+                            f'dist_async rpc {header["cmd"]!r} to '
+                            f'server {sid} at {host}:{port} failed '
+                            f'after {attempt + 1} attempt(s) '
+                            f'({type(e).__name__}: {e}); raise '
+                            'MXNET_KVSTORE_RPC_RETRIES / '
+                            'MXNET_KVSTORE_RPC_DEADLINE_S to wait '
+                            'longer') from e
+                    self._transport_stats['retries'] += 1
+                    step = self._rpc_backoff * (2 ** attempt)
+                    step *= 0.5 + random.random() / 2   # jitter
+                    time.sleep(min(step, max(0.0, deadline - now)))
         if not reply.get('ok'):
             raise RuntimeError(reply.get('error', 'kvstore rpc failed'))
         return reply, rpayload
@@ -607,6 +820,25 @@ class KVStoreDistAsync(KVStoreBase):
             reply, _ = self._rpc_to(sid, {'cmd': 'stats'})
             out[sid] = reply['keys']
         return out
+
+    def server_health(self):
+        """Full per-server ``stats`` reply {sid: {...}}: key inventory,
+        apply/dedup counters, tombstoned ranks, and (when a fault plan
+        is armed in the server's process) ``faults.injected()``
+        counters — the assertion surface for the resilience tests and
+        the ``--kvstore-soak`` bench mode."""
+        self._ensure_connected()
+        out = {}
+        for sid in sorted(self._socks):
+            reply, _ = self._rpc_to(sid, {'cmd': 'stats'})
+            out[sid] = {k: v for k, v in reply.items() if k != 'ok'}
+        return out
+
+    def transport_stats(self):
+        """Worker-side resilience counters: ``retries`` (resends after
+        a transport failure), ``redials`` (socket reconnects),
+        ``giveups`` (RPCs that exhausted retries/deadline)."""
+        return dict(self._transport_stats)
 
     @property
     def rank(self):

@@ -1,0 +1,236 @@
+"""Deterministic fault injection for the ``dist_async`` transport.
+
+The resilient RPC layer (retry/backoff + reconnect in
+``dist_async._rpc_to``, server-side seq dedup) is only trustworthy if
+every recovery path can be driven on demand — real network chaos is
+neither deterministic nor CI-friendly. This module hooks the two wire
+functions (``_send_msg``/``_recv_msg``) and injects faults according to
+a spec, so a connection reset mid-push or a lossy link is an ordinary
+in-process test case (the reference stack gets the same effect from
+ps-lite's ``PS_DROP_MSG`` resender knob; here the injection is exact
+and counted).
+
+Spec grammar — ``MXNET_KVSTORE_FAULT_SPEC`` or
+:func:`configure`, semicolon-separated rules::
+
+    drop:CMD:P[:seed=N]     with probability P (seeded RNG, default
+                            seed 0 — deterministic sequence), fail a
+                            matching request send with
+                            ConnectionResetError BEFORE any byte
+                            leaves: the message is lost pre-delivery,
+                            so a retry re-executes it.
+    delay:CMD:DUR           sleep DUR (``50ms``, ``0.2s``, or bare
+                            seconds) before a matching send.
+    reset_after[:CMD]:N     the N-th matching request is DELIVERED and
+                            applied, then the connection is reset
+                            before its reply is read — the
+                            lost-reply-after-apply case that the
+                            (rank, client, seq) dedup window must
+                            absorb. Fires once.
+    reset_every[:CMD]:N     same, but every N-th matching request
+                            (soak mode).
+
+``CMD`` filters on the wire command (``push``, ``pull``, ``init``,
+``ping``, ``barrier``, ...); ``*`` matches any worker request. Server
+replies carry no ``cmd`` field and only match the literal filter
+``reply``, so a cmd-less rule can never fire on the server's side of
+an in-process test.
+
+Counters from :func:`injected` (``{'drop': n, 'delay': n, 'reset': n,
+'total': n}``) are folded into the server's ``stats`` RPC reply by
+``_AsyncServer``, so assertions can read injection and apply counts
+through one call (``KVStoreDistAsync.server_health``).
+
+The plan is process-global (both ends of an in-process loopback pair
+see it) but rules target the worker side via the ``cmd`` filter; the
+pending-reset flag is thread-local so a reset armed by one store's
+send can only fire on that same thread's reply read.
+"""
+
+import os
+import random
+import re
+import threading
+import time
+
+__all__ = ['configure', 'clear', 'active', 'injected',
+           'on_send', 'on_recv', 'FaultSpecError']
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``MXNET_KVSTORE_FAULT_SPEC`` rule."""
+
+
+def _parse_duration(text):
+    m = re.fullmatch(r'(\d+(?:\.\d+)?)(ms|s)?', text)
+    if not m:
+        raise FaultSpecError(f'bad duration {text!r} (want e.g. 50ms, 0.2s)')
+    val = float(m.group(1))
+    return val / 1e3 if m.group(2) == 'ms' else val
+
+
+class _Rule:
+    def __init__(self, action, cmd, **kw):
+        self.action = action
+        self.cmd = cmd            # None == any worker request
+        self.seen = 0             # matching sends so far (reset_* counting)
+        self.__dict__.update(kw)
+
+    def matches(self, cmd):
+        if self.cmd is None or self.cmd == '*':
+            # wildcard: any worker REQUEST, never a server reply
+            return cmd != 'reply'
+        return self.cmd == cmd
+
+
+def _parse_rule(text):
+    parts = text.split(':')
+    action = parts[0].strip()
+    opts = {}
+    while parts and '=' in parts[-1]:
+        k, v = parts.pop().split('=', 1)
+        opts[k.strip()] = v.strip()
+    if action == 'drop':
+        if len(parts) != 3:
+            raise FaultSpecError(f'drop rule {text!r}: want drop:CMD:P')
+        p = float(parts[2])
+        if not 0.0 <= p <= 1.0:
+            raise FaultSpecError(f'drop probability {p} outside [0, 1]')
+        return _Rule('drop', parts[1], p=p,
+                     rng=random.Random(int(opts.get('seed', 0))))
+    if action == 'delay':
+        if len(parts) != 3:
+            raise FaultSpecError(f'delay rule {text!r}: want delay:CMD:DUR')
+        return _Rule('delay', parts[1], duration=_parse_duration(parts[2]))
+    if action in ('reset_after', 'reset_every'):
+        if len(parts) == 2:          # reset_after:N — any worker request
+            cmd, n = None, parts[1]
+        elif len(parts) == 3:        # reset_after:CMD:N
+            cmd, n = parts[1], parts[2]
+        else:
+            raise FaultSpecError(
+                f'{action} rule {text!r}: want {action}[:CMD]:N')
+        n = int(n)
+        if n < 1:
+            raise FaultSpecError(f'{action} count must be >= 1, got {n}')
+        return _Rule(action, cmd, n=n)
+    raise FaultSpecError(
+        f'unknown fault action {action!r} in rule {text!r} '
+        "(know: drop, delay, reset_after, reset_every)")
+
+
+class FaultPlan:
+    """A parsed spec plus its injection counters."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.rules = [_parse_rule(r) for r in spec.split(';')
+                      if r.strip()]
+        if not self.rules:
+            raise FaultSpecError(f'empty fault spec {spec!r}')
+        self.counts = {'drop': 0, 'delay': 0, 'reset': 0}
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------- hooks
+    def on_send(self, header):
+        cmd = header.get('cmd', 'reply')
+        delay = 0.0
+        for rule in self.rules:
+            if not rule.matches(cmd):
+                continue
+            if rule.action == 'delay':
+                with self._lock:
+                    self.counts['delay'] += 1
+                delay += rule.duration
+            elif rule.action == 'drop':
+                with self._lock:
+                    hit = rule.rng.random() < rule.p
+                    if hit:
+                        self.counts['drop'] += 1
+                if hit:
+                    raise ConnectionResetError(
+                        f'fault-injected drop of {cmd!r} rpc '
+                        '(message lost before delivery)')
+            else:                      # reset_after / reset_every
+                with self._lock:
+                    rule.seen += 1
+                    fire = (rule.seen == rule.n
+                            if rule.action == 'reset_after'
+                            else rule.seen % rule.n == 0)
+                    if fire:
+                        self.counts['reset'] += 1
+                if fire:
+                    # the request itself goes out — the reply read on
+                    # THIS thread is what dies (lost-reply-after-apply)
+                    self._tls.reset_recv = True
+        if delay:
+            time.sleep(delay)
+
+    def on_recv(self, sock):
+        if getattr(self._tls, 'reset_recv', False):
+            self._tls.reset_recv = False
+            try:
+                # the peer's reply bytes may already sit in the buffer;
+                # a real RST discards them, so must we — otherwise a
+                # non-reconnecting reader would resync on a stale reply
+                sock.close()
+            except OSError:
+                pass
+            raise ConnectionResetError(
+                'fault-injected connection reset before reply')
+
+    def injected(self):
+        with self._lock:
+            out = dict(self.counts)
+        out['total'] = sum(out.values())
+        return out
+
+
+_PLAN = None
+
+
+def configure(spec=None):
+    """Install a fault plan from ``spec`` (or, when ``None``, from
+    ``MXNET_KVSTORE_FAULT_SPEC``). An empty spec clears the plan.
+    Returns the active :class:`FaultPlan` or ``None``."""
+    global _PLAN
+    if spec is None:
+        spec = os.environ.get('MXNET_KVSTORE_FAULT_SPEC', '')
+    _PLAN = FaultPlan(spec) if spec.strip() else None
+    return _PLAN
+
+
+def clear():
+    """Remove any active fault plan."""
+    global _PLAN
+    _PLAN = None
+
+
+def active():
+    """The installed :class:`FaultPlan`, or ``None``."""
+    return _PLAN
+
+
+def injected():
+    """Injection counters of the active plan ({} when no plan)."""
+    return _PLAN.injected() if _PLAN is not None else {}
+
+
+def on_send(header):
+    """Hook point for ``dist_async._send_msg`` (may raise or sleep)."""
+    if _PLAN is not None:
+        _PLAN.on_send(header)
+
+
+def on_recv(sock):
+    """Hook point for ``dist_async._recv_msg`` (may raise and close)."""
+    if _PLAN is not None:
+        _PLAN.on_recv(sock)
+
+
+# a spec set in the environment before process start (the launcher
+# path: tools/launch.py exports it to every worker) arms itself on
+# first import; tests configure()/clear() explicitly
+if os.environ.get('MXNET_KVSTORE_FAULT_SPEC'):
+    configure()

@@ -81,7 +81,11 @@ class Horovod(KVStoreTPUSync):
         if self._hvd is None:
             return super().broadcast(key, value, out, priority)
         if isinstance(value, (list, tuple)):
-            value = _reduce_replicas(value)
+            # first replica wins: broadcast ships a VALUE (rank 0's
+            # weights), so k identical per-device replicas must not be
+            # summed into k× the tensor — the replica sum belongs to
+            # pushpull's gradient semantics only (ADVICE r5)
+            value = value[0]
         outs = out if isinstance(out, (list, tuple)) else [out]
         res = self._hvd.broadcast(tensor=value, root_rank=0,
                                   name=str(key), priority=priority)
@@ -170,8 +174,17 @@ class BytePS(KVStoreTPUSync):
         then the push_pull sum carries rank-0's value to everyone."""
         if self._bps is None:
             return super().broadcast(key, value, out, priority)
-        value = value[0] if isinstance(value, (list, tuple)) \
-            and len(value) == 1 else value
+        if isinstance(value, (list, tuple)):
+            if len(value) != 1:
+                # reference byteps.py asserts a single tensor; letting
+                # a k-replica list through would push `list * 0 == []`
+                # to the backend — garbage, not a broadcast (ADVICE r5)
+                raise ValueError(
+                    'byteps broadcast takes a single tensor per key, '
+                    f'got a {len(value)}-element replica list for key '
+                    f'{key!r} (reference byteps.py asserts '
+                    'a single NDArray)')
+            value = value[0]
         outs = out if isinstance(out, (list, tuple)) else [out]
         inplace = len(outs) == 1 and value is outs[0]
         bval = value if inplace else value.copy()

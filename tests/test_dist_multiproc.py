@@ -76,6 +76,29 @@ def test_two_process_dist_async_kvstore():
         assert f'worker {r}/2: all dist_async assertions passed' in out
 
 
+@pytest.mark.timeout(240)
+def test_two_process_dist_async_fault_tolerance():
+    """Resilient transport acceptance (ISSUE 4): with a fault plan
+    injecting connection resets mid-push (reply lost AFTER the server
+    applied) plus a seeded lossy link, a 2-worker dist_async run must
+    finish with the fault-free final weights, exactly-once verified
+    against the server's push_applied counter
+    (tests/nightly/dist_async_faults.py)."""
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'tools', 'launch.py'),
+         '-n', '2', '--launcher', 'local', '--port', '49916',
+         sys.executable,
+         os.path.join(ROOT, 'tests', 'nightly', 'dist_async_faults.py')],
+        capture_output=True, text=True, timeout=220, env=env, cwd=ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    for r in range(2):
+        assert (f'worker {r}/2: fault-tolerant dist_async run verified'
+                in out)
+
+
 @pytest.mark.timeout(620)   # three 180s launches + slack
 def test_elastic_crash_and_resume(tmp_path):
     """Real fault injection (SURVEY §5): the 2-process job is hard-killed

@@ -457,9 +457,10 @@ def _mesh_psum(nd, n):
     the value a size-n world of identical ranks would allreduce."""
     import jax
     from jax.sharding import Mesh, PartitionSpec as P
+    from mxnet_tpu.kvstore.fusion import _shard_map
     mesh = Mesh(np.asarray(jax.devices()[:n]), ('w',))
-    f = jax.shard_map(lambda x: jax.lax.psum(x, 'w'), mesh=mesh,
-                      in_specs=P(), out_specs=P())
+    f = _shard_map(mesh=mesh, in_specs=P(), out_specs=P())(
+        lambda x: jax.lax.psum(x, 'w'))
     return mx.np.array(np.asarray(f(nd.asnumpy())))
 
 
@@ -638,6 +639,49 @@ def test_delegation_replica_lists_sum_before_collective():
         np.testing.assert_allclose(bo.asnumpy(), w.asnumpy())
     finally:
         Horovod.set_backend(None)
+
+
+def test_horovod_broadcast_replica_list_first_wins():
+    """ADVICE r5 item 1: broadcast ships a VALUE, so a k-replica list
+    (k identical per-device copies — the base-store surface) must
+    broadcast value[0], NOT a k× replica sum."""
+    from mxnet_tpu.kvstore.plugins import Horovod
+    hvd = _MockHvd(size=2)
+    Horovod.set_backend(hvd)
+    try:
+        kv = kvstore.create('horovod')
+        w = mx.np.ones((3,)) * 5
+        replicas = [w, w.copy()]            # 2 identical local replicas
+        o0, o1 = mx.np.zeros((3,)), mx.np.zeros((3,))
+        kv.broadcast('bw', replicas, out=[o0, o1])
+        # the mock's broadcast returns the tensor it was handed: a sum
+        # would land 10.0 here, first-replica-wins lands 5.0
+        np.testing.assert_allclose(o0.asnumpy(), 5.0)
+        np.testing.assert_allclose(o1.asnumpy(), 5.0)
+    finally:
+        Horovod.set_backend(None)
+
+
+def test_byteps_broadcast_multi_replica_list_raises():
+    """ADVICE r5 item 2: a multi-element replica list used to fall
+    through the single-element unwrap, so ``bval * 0`` on a list copy
+    silently pushed ``[]`` to the backend — now a clear ValueError
+    (the reference byteps.py asserts a single NDArray)."""
+    from mxnet_tpu.kvstore.plugins import BytePS
+    bps = _MockBps(size=2)
+    BytePS.set_backend(bps)
+    try:
+        kv = kvstore.create('byteps')
+        w = mx.np.ones((3,))
+        with pytest.raises(ValueError, match='single tensor'):
+            kv.broadcast('bw', [w, w.copy()], out=[mx.np.zeros((3,))])
+        assert not any(c[0] == 'push_pull' for c in bps.calls)
+        # the single-element unwrap still works
+        bo = mx.np.zeros((3,))
+        kv.broadcast('bw1', [w], out=[bo])
+        np.testing.assert_allclose(bo.asnumpy(), 2.0)   # summed x2
+    finally:
+        BytePS.set_backend(None)
     bps = _MockBps(size=2)
     BytePS.set_backend(bps)
     try:

@@ -1,0 +1,372 @@
+"""Fault-injected resilience tests for the ``dist_async`` transport.
+
+Drives every recovery path of the retrying RPC layer
+(``dist_async._rpc_to``) in-process through the deterministic fault
+harness (``mxnet_tpu/kvstore/faults.py``): lost replies after apply
+(seq dedup / exactly-once pushes), lossy links (retry + redial),
+exhausted deadlines (clear ConnectionError), and the bye-tombstone
+semantics that keep a departed rank out of ``get_num_dead_node`` even
+when a delayed heartbeat lands after the goodbye (ADVICE r5).
+"""
+
+import socket
+import threading
+import time
+from contextlib import closing
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+from mxnet_tpu.kvstore import dist_async, faults
+from mxnet_tpu.kvstore.dist_async import _AsyncServer
+
+
+def _free_port():
+    with closing(socket.socket()) as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def async_store(monkeypatch):
+    """A single-worker dist_async store on private ports with the
+    heartbeat pinger parked (it would race the deterministic fault
+    counters), plus guaranteed fault-plan/server cleanup."""
+    created = []
+
+    def make(**env):
+        port = _free_port()
+        monkeypatch.setenv('MX_COORDINATOR', f'127.0.0.1:{_free_port()}')
+        monkeypatch.setenv('MXNET_KVSTORE_ASYNC_PORT', str(port))
+        monkeypatch.setenv('MXNET_KVSTORE_HEARTBEAT_S', '3600')
+        monkeypatch.setenv('MX_PROC_ID', '0')
+        monkeypatch.setenv('MX_NPROC', '1')
+        for k, v in env.items():
+            monkeypatch.setenv(k, str(v))
+        kv = kvstore.create('dist_async')
+        created.append((kv, port))
+        return kv
+
+    yield make
+    faults.clear()
+    for kv, port in created:
+        try:
+            kv.close()
+        except Exception:
+            pass
+        srv = dist_async._SERVERS.pop(port, None)
+        if srv is not None:
+            srv.stop()
+
+
+# ---------------------------------------------------------------- tentpole
+
+def test_push_retried_across_reset_applies_exactly_once(async_store):
+    """ISSUE test (a): the push is DELIVERED, the reply is lost to an
+    injected connection reset, the retry redials and resends — and the
+    server's (client, seq) dedup window replays the cached reply
+    instead of applying the gradient a second time."""
+    kv = async_store()
+    kv.init('w', mx.np.zeros((8,)))
+    faults.configure('reset_after:push:1')
+    kv.push('w', mx.np.ones((8,)))
+    got = kv.pull('w').asnumpy()
+    onp.testing.assert_allclose(got, onp.ones((8,)))   # once, not twice
+    health = kv.server_health()[0]
+    assert health['counters']['push_applied'] == 1
+    assert health['counters']['dedup_replays'] == 1
+    assert health['faults']['reset'] == 1
+    ts = kv.transport_stats()
+    assert ts['retries'] >= 1 and ts['redials'] >= 1
+    assert ts['giveups'] == 0
+
+
+def test_lossy_link_drops_are_retried_to_success(async_store):
+    """Probabilistic pre-delivery drops (seeded, deterministic): every
+    logical push still lands exactly once."""
+    kv = async_store(MXNET_KVSTORE_RPC_BACKOFF_S='0.01')
+    kv.init('w', mx.np.zeros((4,)))
+    faults.configure('drop:push:0.5:seed=1')
+    for _ in range(5):
+        kv.push('w', mx.np.ones((4,)))
+    faults.clear()
+    onp.testing.assert_allclose(kv.pull('w').asnumpy(), 5.0)
+    assert kv.server_health()[0]['counters']['push_applied'] == 5
+    assert kv.transport_stats()['retries'] >= 1
+
+
+def test_deadline_exceeded_raises_connectionerror_naming_target(
+        async_store):
+    """ISSUE test (b): when retries/deadline run out the caller gets a
+    ConnectionError that names the server address and the attempt
+    count (not a bare socket traceback)."""
+    kv = async_store(MXNET_KVSTORE_RPC_RETRIES='2',
+                     MXNET_KVSTORE_RPC_BACKOFF_S='0.01',
+                     MXNET_KVSTORE_RPC_DEADLINE_S='20')
+    kv.init('w', mx.np.zeros((2,)))
+    faults.configure('drop:push:1.0')        # every attempt dies
+    with pytest.raises(ConnectionError) as ei:
+        kv.push('w', mx.np.ones((2,)))
+    faults.clear()
+    msg = str(ei.value)
+    host, port = kv._addrs[0]
+    assert f'{host}:{port}' in msg
+    assert '3 attempt' in msg                # retries=2 -> 3 attempts
+    assert kv.transport_stats()['giveups'] == 1
+    # the store is NOT poisoned: the next call redials and succeeds
+    onp.testing.assert_allclose(kv.pull('w').asnumpy(), 0.0)
+
+
+def test_application_errors_are_not_retried(async_store):
+    """ok:False replies (e.g. pull of a missing key) surface as
+    RuntimeError immediately — the transport must not burn retries on
+    application-level failures."""
+    kv = async_store()
+    kv.init('w', mx.np.zeros((2,)))
+    with pytest.raises(RuntimeError, match='no such key'):
+        kv._rpc_to(0, {'cmd': 'pull', 'key': 'missing'})
+    assert kv.transport_stats()['retries'] == 0
+
+
+def test_delay_fault_injects_latency_and_counts(async_store):
+    kv = async_store()
+    kv.init('w', mx.np.zeros((2,)))
+    faults.configure('delay:pull:30ms')
+    t0 = time.perf_counter()
+    kv.pull('w')
+    elapsed = time.perf_counter() - t0
+    assert elapsed >= 0.03
+    assert faults.injected()['delay'] >= 1
+    faults.clear()
+
+
+def test_close_tombstones_rank_on_server(async_store):
+    """End-to-end bye: after close() the server tombstones the rank,
+    reports it departed (not dead), and keeps it out of the last-seen
+    table."""
+    kv = async_store()
+    kv.init('w', mx.np.zeros((2,)))
+    srv = kv._server
+    kv.close()
+    reply, _ = srv._dispatch({'cmd': 'dead_nodes', 'timeout': -1.0}, b'')
+    assert reply['dead'] == 0 and reply['departed'] == 1
+    reply, _ = srv._dispatch({'cmd': 'stats'}, b'')
+    assert reply['tombstones'] == [0]
+
+
+# ------------------------------------------------- server-unit: tombstones
+
+@pytest.fixture
+def bare_server():
+    srv = _AsyncServer(0, bind_host='127.0.0.1', sid=0)  # never start()ed
+    yield srv
+    srv._server.server_close()
+
+
+def test_tombstoned_rank_ignores_delayed_ping(bare_server):
+    """ISSUE test (c) / ADVICE r5 item 3: a ping still in flight when
+    the worker says bye must NOT re-enter the rank into the last-seen
+    table — the departed worker would otherwise read as dead forever."""
+    srv = bare_server
+    srv._dispatch({'cmd': 'ping', 'rank': 5}, b'')
+    reply, _ = srv._dispatch({'cmd': 'dead_nodes', 'timeout': -1.0}, b'')
+    assert reply['dead'] == 1          # beat older than a future cutoff
+    srv._dispatch({'cmd': 'bye', 'rank': 5}, b'')
+    # the delayed in-flight ping lands AFTER the goodbye
+    srv._dispatch({'cmd': 'ping', 'rank': 5}, b'')
+    reply, _ = srv._dispatch({'cmd': 'dead_nodes', 'timeout': -1.0}, b'')
+    assert reply['dead'] == 0 and reply['departed'] == 1
+    assert 5 not in srv._last_seen
+
+
+def test_tombstone_lifted_by_new_store_data_rpc(bare_server):
+    """A NEW store incarnation of the same rank (same process creating
+    a second dist_async store after closing the first) revives through
+    its first data-plane RPC; a bare ping never does."""
+    srv = bare_server
+    srv._dispatch({'cmd': 'bye', 'rank': 3}, b'')
+    srv._dispatch({'cmd': 'ping', 'rank': 3}, b'')
+    assert 3 in srv._tombstones and 3 not in srv._last_seen
+    srv._dispatch({'cmd': 'push', 'rank': 3, 'key': 'w',
+                   'dtype': 'float32', 'shape': [2]},
+                  onp.ones(2, 'f').tobytes())
+    assert 3 not in srv._tombstones and 3 in srv._last_seen
+
+
+# ---------------------------------------------------- server-unit: dedup
+
+def _push(srv, seq, val, client='c1', key='w'):
+    arr = onp.full((2,), float(val), 'f')
+    return srv._dispatch({'cmd': 'push', 'rank': 0, 'key': key,
+                          'client': client, 'seq': seq,
+                          'dtype': 'float32', 'shape': [2]},
+                         arr.tobytes())
+
+
+def test_dedup_replays_cached_reply_without_reapply(bare_server):
+    srv = bare_server
+    srv._dispatch({'cmd': 'init', 'rank': 0, 'key': 'w', 'client': 'c1',
+                   'seq': 1, 'dtype': 'float32', 'shape': [2]},
+                  onp.zeros(2, 'f').tobytes())
+    _push(srv, 2, 1.0)
+    _push(srv, 2, 1.0)                       # retry of the same seq
+    assert srv._counters['push_applied'] == 1
+    assert srv._counters['dedup_replays'] == 1
+    onp.testing.assert_allclose(srv._store['w'], 1.0)
+    _push(srv, 3, 1.0)                       # a NEW seq applies
+    onp.testing.assert_allclose(srv._store['w'], 2.0)
+
+
+def test_dedup_window_prunes_oldest_entries(monkeypatch):
+    monkeypatch.setenv('MXNET_KVSTORE_DEDUP_WINDOW', '4')
+    srv = _AsyncServer(0, bind_host='127.0.0.1', sid=0)
+    try:
+        for seq in range(1, 8):              # 7 pushes, window of 4
+            _push(srv, seq, 1.0)
+        assert len(srv._dedup) == 4
+        assert ('c1', 7) in srv._dedup and ('c1', 2) not in srv._dedup
+        # an in-window seq replays; a PRUNED seq re-applies (that is
+        # the documented window bound)
+        _push(srv, 7, 1.0)
+        assert srv._counters['dedup_replays'] == 1
+        applied = srv._counters['push_applied']
+        _push(srv, 2, 1.0)
+        assert srv._counters['push_applied'] == applied + 1
+    finally:
+        srv._server.server_close()
+
+
+def test_dedup_does_not_cache_failed_replies(bare_server):
+    srv = bare_server
+    reply, _ = srv._dispatch({'cmd': 'nonsense', 'rank': 0,
+                              'client': 'c9', 'seq': 1}, b'')
+    assert not reply['ok']
+    assert ('c9', 1) not in srv._dedup
+
+
+def test_barrier_duplicate_arrival_is_idempotent(bare_server):
+    """A retried barrier RPC (same client+seq, original handler still
+    blocked) must not count as a second arrival and release the
+    barrier early."""
+    srv = bare_server
+    replies = []
+
+    def arrive(client, seq):
+        r, _ = srv._dispatch({'cmd': 'barrier', 'nproc': 2, 'rank': 0,
+                              'client': client, 'seq': seq}, b'')
+        replies.append(r)
+
+    t1 = threading.Thread(target=arrive, args=('a', 1), daemon=True)
+    t1.start()
+    time.sleep(0.1)
+    t2 = threading.Thread(target=arrive, args=('a', 1), daemon=True)
+    t2.start()                               # the duplicate
+    time.sleep(0.2)
+    with srv._barrier_cv:
+        assert srv._barrier_count == 1       # duplicate did not count
+    assert t1.is_alive() and t2.is_alive()   # nobody released early
+    arrive('b', 1)                           # the real second worker
+    t1.join(5)
+    t2.join(5)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert all(r['ok'] for r in replies)
+
+
+# -------------------------------------------------------- spec grammar
+
+def test_fault_spec_grammar():
+    plan = faults.FaultPlan(
+        'drop:push:0.3:seed=7;delay:pull:50ms;reset_after:5;'
+        'reset_every:push:3;delay:init:0.2s')
+    kinds = [(r.action, r.cmd) for r in plan.rules]
+    assert kinds == [('drop', 'push'), ('delay', 'pull'),
+                     ('reset_after', None), ('reset_every', 'push'),
+                     ('delay', 'init')]
+    assert plan.rules[1].duration == pytest.approx(0.05)
+    assert plan.rules[4].duration == pytest.approx(0.2)
+    assert plan.rules[2].n == 5
+
+
+@pytest.mark.parametrize('bad', [
+    'explode:push:1', 'drop:push:1.5', 'drop:push', 'delay:pull:fast',
+    'reset_after:push:0', 'reset_after:a:b:c',
+])
+def test_fault_spec_rejects_malformed_rules(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultPlan(bad)
+
+
+def test_fault_spec_from_environment(monkeypatch):
+    monkeypatch.setenv('MXNET_KVSTORE_FAULT_SPEC', 'delay:ping:1ms')
+    try:
+        plan = faults.configure()
+        assert plan is not None and plan.rules[0].cmd == 'ping'
+        faults.on_send({'cmd': 'ping'})
+        assert faults.injected() == {'drop': 0, 'delay': 1, 'reset': 0,
+                                     'total': 1}
+    finally:
+        faults.clear()
+    assert faults.injected() == {}
+
+
+def test_cmdless_rules_never_match_server_replies():
+    plan = faults.FaultPlan('reset_after:1;drop:*:1.0')
+    # a server reply header has no 'cmd' — neither wildcard rule fires
+    plan.on_send({'ok': True})
+    assert plan.injected()['total'] == 0
+    with pytest.raises(ConnectionResetError):
+        plan.on_send({'cmd': 'push'})
+
+
+# ------------------------------------------------------------- soak mode
+
+def _soak(monkeypatch, rounds, spec, **kw):
+    import os
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, 'benchmark'))
+    try:
+        import opperf
+    finally:
+        sys.path.pop(0)
+    port = _free_port()
+    monkeypatch.setenv('MX_COORDINATOR', f'127.0.0.1:{_free_port()}')
+    monkeypatch.setenv('MXNET_KVSTORE_ASYNC_PORT', str(port))
+    monkeypatch.setenv('MXNET_KVSTORE_HEARTBEAT_S', '3600')
+    monkeypatch.setenv('MXNET_KVSTORE_RPC_BACKOFF_S', '0.005')
+    monkeypatch.setenv('MX_PROC_ID', '0')
+    monkeypatch.setenv('MX_NPROC', '1')
+    try:
+        return opperf.kvstore_soak(rounds, spec, **kw)
+    finally:
+        faults.clear()
+        srv = dist_async._SERVERS.pop(port, None)
+        if srv is not None:
+            srv.stop()
+
+
+def test_kvstore_soak_smoke(monkeypatch):
+    """The bench-trajectory regression probe (short variant): a few
+    rounds under periodic resets must verify exactly-once and report
+    non-zero retry/injection counters."""
+    res = _soak(monkeypatch, 6, 'reset_every:push:3', size=64, keys=2)
+    assert res['verified_exactly_once']
+    assert res['server_counters']['push_applied'] == 12
+    assert res['faults']['reset'] >= 1
+    assert res['transport']['retries'] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not __import__('os').environ.get('MXNET_TEST_SLOW'),
+                    reason='long soak: set MXNET_TEST_SLOW=1')
+def test_kvstore_soak_long(monkeypatch):
+    """200-round soak under compound chaos (resets + seeded drops +
+    latency): the tier-2 endurance variant of the smoke above."""
+    res = _soak(monkeypatch, 200,
+                'reset_every:push:7;drop:push:0.1:seed=5;delay:pull:1ms',
+                size=256, keys=3)
+    assert res['verified_exactly_once']
+    assert res['server_counters']['push_applied'] == 600
+    assert res['faults']['reset'] >= 10
