@@ -89,6 +89,7 @@ from . import io
 from . import image
 from . import parallel
 from . import amp
+from . import analysis
 from . import quantization
 from . import contrib
 from . import test_utils

@@ -1,0 +1,71 @@
+"""Rule registry for the graph sanitizer.
+
+Each rule is a callable ``run(graph: GraphView, report: AnalysisReport,
+config: dict)`` registered under a stable kebab-case name — the names
+appear in reports, docs/static-analysis.md, and the ``--rules`` CLI
+filter. Registration order is report order.
+
+The six shipped checks (ISSUE 7 tentpole):
+
+==========================  =================================================
+rule                        catches
+==========================  =================================================
+implicit-f32-promotion      f32 compute fed only by bf16/f16 values inside a
+                            low-precision graph (silent upcast)
+large-constant-capture      closed-over array constants baked into the HLO
+recompile-hazard            weak-typed scalar inputs / baked scalar consts
+                            that fragment or stale the jit cache
+host-transfer               callback/infeed/outfeed prims (host sync inside
+                            the step) + eager fallbacks of dynamic-shape ops
+dead-code                   unused params/inputs, pass-through or constant
+                            outputs, DCE-removable equations
+donation-audit              static_alloc donation claims vs XLA's compiled
+                            input-output aliasing; donatable-but-undonated
+                            buffers
+==========================  =================================================
+"""
+
+_RULES = {}     # name -> (fn, needs_compile)
+
+
+def register_rule(name, needs_compile=False):
+    """Decorator registering a sanitizer rule under ``name``.
+    ``needs_compile=True`` marks rules that lower+compile the graph
+    (skipped unless the caller opts in — compilation is not free)."""
+
+    def deco(fn):
+        fn.rule_name = name
+        fn.needs_compile = needs_compile
+        _RULES[name] = fn
+        return fn
+
+    return deco
+
+
+def all_rules():
+    return dict(_RULES)
+
+
+def get_rule(name):
+    return _RULES[name]
+
+
+def run_rules(graph, report, rules=None, compile_rules=False, **config):
+    """Run the selected rules (default: all) over a GraphView."""
+    selected = _RULES if rules is None else {
+        n: _RULES[n] for n in rules}
+    for name, fn in selected.items():
+        if fn.needs_compile and not compile_rules:
+            continue
+        fn(graph, report, config)
+        report.rules_run.append(name)
+    return report
+
+
+# import order == report order
+from . import dtype_promotion    # noqa: E402,F401
+from . import constants          # noqa: E402,F401
+from . import recompile          # noqa: E402,F401
+from . import transfer           # noqa: E402,F401
+from . import dead_code          # noqa: E402,F401
+from . import donation           # noqa: E402,F401

@@ -1,0 +1,49 @@
+"""large-constant-capture: big arrays baked into the compiled graph.
+
+A closed-over array (``self.table = mx.np.array(...)`` instead of a
+``Constant`` parameter) becomes a jaxpr *constant*: XLA embeds it in the
+executable. Costs: the buffer is duplicated per compiled cache entry
+(every (shape, dtype, train) key re-embeds it), it bloats HLO
+serialization/compile time, and on multi-chip it is replicated rather
+than sharded. The fix is always the same — make it a graph argument
+(register it as a ``Constant`` parameter, or pass it as an input).
+
+Threshold: ``const_bytes`` config (default 64 KiB, env override
+``MXNET_ANALYSIS_CONST_BYTES``); constants above 64 MiB are errors (the
+HLO-verifier-style hard stop), smaller hits are warnings.
+"""
+
+import os
+
+from . import register_rule
+from ..walker import _const_nbytes
+
+DEFAULT_BYTES = 64 * 1024
+ERROR_BYTES = 64 * 1024 * 1024
+
+
+def _threshold(config):
+    if 'const_bytes' in config and config['const_bytes'] is not None:
+        return int(config['const_bytes'])
+    return int(os.environ.get('MXNET_ANALYSIS_CONST_BYTES',
+                              DEFAULT_BYTES))
+
+
+@register_rule('large-constant-capture')
+def run(graph, report, config):
+    threshold = _threshold(config)
+    for var, const in zip(graph.jaxpr.constvars, graph.consts):
+        nbytes = _const_nbytes(const)
+        if nbytes < threshold:
+            continue
+        shape = tuple(getattr(const, 'shape', ()))
+        dtype = str(getattr(const, 'dtype', type(const).__name__))
+        severity = 'error' if nbytes >= ERROR_BYTES else 'warning'
+        report.add(
+            'large-constant-capture', severity,
+            f'{dtype}{list(shape)} constant ({nbytes} bytes) baked into '
+            'the graph — it is re-embedded per compile-cache entry and '
+            'replicated across devices; register it as a Constant '
+            'parameter or pass it as an input',
+            nbytes=nbytes, shape=shape, dtype=dtype,
+            threshold=threshold)

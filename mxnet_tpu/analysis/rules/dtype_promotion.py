@@ -1,0 +1,82 @@
+"""implicit-f32-promotion: silent upcasts inside a low-precision graph.
+
+On TPU the MXU runs bf16 natively; an f32 equation in the middle of a
+bf16 graph doubles its HBM traffic and falls off the fast matmul path.
+The expensive variant is *silent*: a ``convert_element_type`` to f32
+inserted by numpy promotion rules (a stray f32 scalar, an f32 constant,
+``mean`` with float64-ish accumulation semantics), not by the user.
+
+Deliberate f32 islands are normal — softmax/norm accumulations upcast
+on purpose. Two exemptions encode that:
+
+* the widened value feeds only accumulation primitives
+  (``reduce_sum``/``dot_general``/...), the classic f32-accumulate
+  pattern;
+* the eqn was emitted by a registered op carrying ``f32_only=True``
+  metadata (ops/registry.py) — the op declares its internal f32 math.
+
+Fires only when the graph is low-precision (AMP enabled, or any
+bf16/f16 input/param): an all-f32 graph has nothing to promote.
+"""
+
+from . import register_rule
+from ..walker import iter_jaxprs, eqn_op, source_location
+
+LOW = ('bfloat16', 'float16')
+WIDE = ('float32', 'float64')
+
+# consumers for which widening is the intended accumulate-in-f32 idiom
+ACCUMULATE_PRIMS = frozenset({
+    'reduce_sum', 'reduce_max', 'reduce_min', 'reduce_prod',
+    'dot_general', 'conv_general_dilated', 'cumsum', 'cumlogsumexp',
+    'reduce_precision', 'convert_element_type',
+})
+
+
+def _dtype(v):
+    aval = getattr(v, 'aval', None)
+    dt = getattr(aval, 'dtype', None)
+    return str(dt) if dt is not None else None
+
+
+@register_rule('implicit-f32-promotion')
+def run(graph, report, config):
+    if not graph.low_precision:
+        return
+    for jaxpr in iter_jaxprs(graph.jaxpr):
+        # consumer map for the accumulate exemption
+        consumers = {}
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                if not isinstance(v, (int, float)) and hasattr(v, 'aval'):
+                    consumers.setdefault(id(v), []).append(eqn)
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name != 'convert_element_type':
+                continue
+            src = _dtype(eqn.invars[0])
+            dst = _dtype(eqn.outvars[0])
+            if src not in LOW or dst not in WIDE:
+                continue
+            op = eqn_op(eqn)
+            if op is not None and getattr(op, 'f32_only', False):
+                continue
+            outs = eqn.outvars[0]
+            eaters = consumers.get(id(outs), [])
+            if eaters and all(e.primitive.name in ACCUMULATE_PRIMS
+                              for e in eaters):
+                continue
+            nbytes = 1
+            for d in getattr(outs.aval, 'shape', ()):
+                nbytes *= d
+            nbytes *= outs.aval.dtype.itemsize
+            via = f' via op {op.name!r}' if op is not None else ''
+            report.add(
+                'implicit-f32-promotion', 'warning',
+                f'{src} value widened to {dst}{via} and consumed by '
+                f'{[e.primitive.name for e in eaters] or "graph outputs"}'
+                f' — {nbytes} bytes of f32 traffic in a low-precision '
+                'graph (cast back after accumulation, or pass '
+                'low-precision operands)',
+                location=source_location(eqn),
+                src_dtype=src, dst_dtype=dst, nbytes=nbytes,
+                consumers=[e.primitive.name for e in eaters])

@@ -1,0 +1,61 @@
+"""recompile-hazard: things that fragment or stale the jit cache.
+
+``_CachedGraph`` keys compiled entries by (input shapes/dtypes,
+train-mode, tree structure). Two statically visible hazards:
+
+* **weak-typed scalar inputs** — a bare Python number flowed into the
+  traced argument list. Each call re-uploads the scalar host→device
+  inside the step, and the same logical knob arriving as ``3`` vs
+  ``3.0`` keys as int32 vs float32 — two full compilations of the same
+  graph. Passing epochs/temperatures this way is the classic per-step
+  recompile bug in raw ``jax.jit`` too. Fix: bake it (attribute),
+  mark it static, or feed a typed 0-d array consistently.
+
+* **baked scalar constants** — a closure-captured Python scalar that
+  was materialized as a 0-d/tiny array const. The value is frozen at
+  trace time: mutating the attribute later silently does nothing until
+  a re-hybridize, where every distinct value compiles a new program.
+  (Scalars that fold into ``Literal``s are fine — XLA constant-folds
+  them; only *captured arrays* carry the staleness trap.)
+
+Shape-leak variant: an `iota`/`broadcast_in_dim` whose size came from a
+Python int that the user varies per call produces a different jaxpr per
+value — invisible from one trace, but the scalar-input check above
+catches the common carrier (the int arriving as an argument instead).
+"""
+
+from . import register_rule
+
+SCALAR_CONST_MAX_ELEMS = 8      # "scalar-ish": 0-d or tiny captured array
+
+
+@register_rule('recompile-hazard')
+def run(graph, report, config):
+    for arg in graph.args:
+        if arg.kind == 'rng':
+            continue
+        aval = arg.aval
+        if getattr(aval, 'weak_type', False) and aval.ndim == 0:
+            report.add(
+                'recompile-hazard', 'warning',
+                f'{arg.label} is a weak-typed {aval.dtype} scalar — a '
+                'bare Python number reached the traced inputs; the same '
+                'knob passed as int vs float compiles two separate '
+                'programs, and the value is re-uploaded host->device '
+                'every step (bake it, or pass a typed 0-d array)',
+                arg=arg.label, dtype=str(aval.dtype))
+    for var, const in zip(graph.jaxpr.constvars, graph.consts):
+        shape = tuple(getattr(const, 'shape', ()))
+        size = 1
+        for d in shape:
+            size *= d
+        if size <= SCALAR_CONST_MAX_ELEMS and \
+                getattr(const, 'ndim', 0) == 0:
+            report.add(
+                'recompile-hazard', 'info',
+                f'scalar {getattr(const, "dtype", "?")} constant baked '
+                'into the graph — frozen at trace time; changing the '
+                'source attribute will not take effect until '
+                're-hybridize, and each distinct value then compiles a '
+                'new program',
+                shape=shape)

@@ -276,13 +276,21 @@ class _CachedGraph:
     src/imperative/cached_op.h:463)."""
 
     def __init__(self, block, static_alloc=False, static_shape=False,
-                 backend=None, flags=None, remat=False):
+                 backend=None, flags=None, remat=False, check=False,
+                 donate_inputs=False):
         self.block = block
         self.static_alloc = static_alloc
         self.static_shape = static_shape
         self.backend = backend
         self.remat = remat or os.environ.get(
             'MXNET_BACKWARD_DO_MIRROR', '') == '1'
+        # lint the traced graph after the first compile (mx.analysis)
+        self.check = check
+        self._checked = False
+        # opt-in: donate input activations to XLA (caller promises not
+        # to reuse the passed buffers); never the default — gluon
+        # callers keep live NDArray handles to their inputs
+        self.donate_inputs = donate_inputs
         self._compiled = {}
         self._out_trees = {}       # per cache entry: output pytree structure
         self._param_order = None
@@ -314,14 +322,22 @@ class _CachedGraph:
             self._param_order = (main, aux)
         return self._param_order
 
-    def _build(self, shapes_key, train_mode, n_in, treedef):
+    def _build(self, shapes_key, train_mode, n_in, treedef, donate=()):
         import jax
 
         pure_fn = self._make_pure(shapes_key, train_mode, treedef)
         jit_kwargs = {}
-        if self.static_alloc:
-            # donate input buffers (≙ static_alloc persistent buffers)
-            jit_kwargs['donate_argnums'] = ()
+        if donate:
+            # static_alloc buffer reuse (≙ the reference's persistent
+            # workspace): donate the mutable aux state (argnum 3, BN
+            # running stats) on recorded-train entries so XLA updates
+            # it in place (input_output_alias), and the inputs (argnum
+            # 1) when the caller opted in via donate_inputs. __call__
+            # computes the tuple; inference entries never donate aux —
+            # lock-free threads share those buffers. The donation-audit
+            # rule (mx.analysis) machine-checks the aliasing actually
+            # happens.
+            jit_kwargs['donate_argnums'] = tuple(donate)
         if self.remat:
             # recompute activations in backward instead of storing them
             # (reference backward mirroring, MXNET_BACKWARD_DO_MIRROR)
@@ -387,11 +403,25 @@ class _CachedGraph:
         # it on by default, autograd.train_mode() turns it on without
         # recording — eager and hybridized must agree in every scope
         train_mode = _tape.is_training()
+        recording = _tape.is_recording()
+        # Donation decision, per entry (and therefore part of the key):
+        # aux state is donated only on recorded-train executables — those
+        # run under the graph lock and immediately rebind the params to
+        # the aliased outputs, so no other thread can keep a handle to
+        # the donated buffer. donate_inputs is the caller's opt-in and
+        # excluded while recording (input activations are backward
+        # residuals).
+        donate = ()
+        if self.static_alloc and train_mode and recording and aux:
+            donate += (3,)
+        if self.donate_inputs and not recording:
+            donate += (1,)
+        donate = tuple(sorted(donate))
         # treedef is part of the key: same leaf shapes under different arg
         # nesting (or train/eval forwards with different output structures)
         # must not share a compiled entry or its output pytree
         key = (tuple((x.shape, str(x.dtype)) for x in in_nds), train_mode,
-               treedef)
+               donate, treedef)
         # Thread-safety contract (reference thread-safe CachedOp,
         # src/imperative/cached_op_threadsafe.cc:1-316; docs/threading.md):
         # compiled steady-state INFERENCE runs lock-free from N threads —
@@ -403,7 +433,7 @@ class _CachedGraph:
         # function and re-enters that swap. Parameter snapshots on the
         # lock-free path still acquire the lock briefly so they can
         # never observe a mid-trace swap.
-        if key in self._ready and not _tape.is_recording():
+        if key in self._ready and not recording:
             with self._lock:
                 # re-check under the lock: a concurrent clear()
                 # (re-hybridize/cast while serving) may have emptied the
@@ -415,19 +445,55 @@ class _CachedGraph:
                 main_nds = [p.data() for p in main]
                 aux_raws = tuple(p.data()._data for p in aux)
             if jfn is not None and out_tree is not None:
-                return self._execute(args, key, jfn, in_nds, main_nds,
-                                     aux_raws, out_tree)
+                try:
+                    return self._execute(args, key, jfn, in_nds, main_nds,
+                                         aux_raws, out_tree)
+                except RuntimeError as e:
+                    if 'deleted' not in str(e).lower():
+                        raise
+                    # a recorded-train step donated the aux buffers this
+                    # thread snapshotted between the lock release and
+                    # dispatch; fall through to the serialized path,
+                    # which re-snapshots the rebound (post-donation)
+                    # state under the lock and executes while holding it
         with self._lock:
             if key not in self._compiled:
                 self._compiled[key] = self._build(key, train_mode,
-                                                  len(in_nds), treedef)
+                                                  len(in_nds), treedef,
+                                                  donate=donate)
             jfn = self._compiled[key]
             main_nds = [p.data() for p in main]
             aux_raws = tuple(p.data()._data for p in aux)
             out = self._execute(args, key, jfn, in_nds, main_nds,
                                 aux_raws, None)
             self._ready.add(key)
+            if self.check and not self._checked:
+                self._checked = True
+                self._run_check(args, train_mode)
             return out
+
+    def _run_check(self, args, train_mode):
+        """hybridize(check=True): lint the just-compiled graph once and
+        route findings through ``warnings`` (mx.analysis). Errors —
+        including strict-promoted warnings under MXNET_ANALYSIS_STRICT=1
+        — raise MXNetError."""
+        from .. import analysis, profiler
+
+        name = type(self.block).__name__
+        try:
+            graph = analysis.trace_block(self.block, *args,
+                                         train=train_mode, name=name)
+            report = analysis.lint_graph(graph)
+        except Exception as e:   # noqa: BLE001 - lint must never kill a step
+            warnings.warn(f'{name}: hybridize(check=True) could not lint '
+                          f'the graph: {type(e).__name__}: {e}',
+                          stacklevel=4)
+            return
+        self.block._analysis_report = report
+        profiler.attach_analysis(name, report)
+        if report.findings:
+            warnings.warn(str(report), stacklevel=4)
+        report.raise_if_errors()
 
     def _execute(self, args, key, jfn, in_nds, main_nds, aux_raws,
                  out_tree):
@@ -505,7 +571,7 @@ class HybridBlock(Block):
     def hybridize(self, active=True, backend=None, backend_opts=None,
                   static_alloc=True, static_shape=False, inline_limit=2,
                   forward_bulk_size=None, backward_bulk_size=None,
-                  remat=False, **kwargs):
+                  remat=False, check=False, donate_inputs=False, **kwargs):
         """Reference block.py:1217. backend= selected subgraph backends in
         the reference (optimize_for); the whole graph goes to XLA here.
 
@@ -513,11 +579,25 @@ class HybridBlock(Block):
         backward recomputes activations instead of keeping them — the
         reference's backward-mirroring memory trade
         (MXNET_BACKWARD_DO_MIRROR, src/nnvm/gradient.cc:58-77), but as a
-        per-block switch."""
+        per-block switch.
+
+        ``check=True`` lints the traced graph right after the first
+        compile (``mx.analysis``: dtype promotion, captured constants,
+        recompile hazards, host transfers, dead code) and reports
+        findings through ``warnings``; error findings — or any finding
+        under ``MXNET_ANALYSIS_STRICT=1`` — raise :class:`MXNetError`.
+
+        ``donate_inputs=True`` donates input activation buffers to XLA
+        on non-recorded entries (buffer reuse — the caller must not
+        touch the passed arrays after the call). Mutable aux state (BN
+        running stats) is donated automatically on recorded-train
+        entries under ``static_alloc``; the ``donation-audit`` analysis
+        rule verifies the aliasing actually happens."""
         self._active = active
         self._cached_graph = _CachedGraph(
             self, static_alloc=static_alloc, static_shape=static_shape,
-            backend=backend, remat=remat) if active else None
+            backend=backend, remat=remat, check=check,
+            donate_inputs=donate_inputs) if active else None
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
 

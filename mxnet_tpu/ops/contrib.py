@@ -71,7 +71,7 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
     return out.reshape(sq, b, heads * hd)
 
 
-@register('flash_attention')
+@register('flash_attention', f32_only=True)
 def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
                     block_k=128):
     """Blockwise fused attention (Pallas on TPU, XLA fallback elsewhere).

@@ -378,7 +378,7 @@ def batch_norm_train(x, gamma, beta, eps=1e-5, axis=1, fix_gamma=False):
     return out, mean, var
 
 
-@register('layer_norm', aliases=('LayerNorm',))
+@register('layer_norm', aliases=('LayerNorm',), f32_only=True)
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
     """Reference: src/operator/nn/layer_norm.cc (hand-fused CUDA kernel).
     Last-axis norms take the Pallas single-HBM-pass kernel on TPU
@@ -460,7 +460,7 @@ def moments(data, axes=None, keepdims=False):
     return mean, var
 
 
-@register('rms_norm')
+@register('rms_norm', f32_only=True)
 def rms_norm(data, gamma, axis=-1, eps=1e-6):
     """New (no reference analog): RMSNorm for the LLM stack. Last-axis
     case takes the Pallas single-pass kernel (ops/pallas/fused_norms.py)."""

@@ -41,11 +41,13 @@ class Op:
 
     __slots__ = ('name', 'fn', 'differentiable', 'stochastic', 'namespaces',
                  'aliases', 'wrap', 'n_out', 'static_argnums',
-                 'static_argnames', 'dynamic_shape', 'vjp_lock')
+                 'static_argnames', 'dynamic_shape', 'vjp_lock',
+                 'host_transfer', 'f32_only')
 
     def __init__(self, name, fn, differentiable=True, stochastic=False,
                  namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1,
-                 static_argnums=(), static_argnames=(), dynamic_shape=False):
+                 static_argnums=(), static_argnames=(), dynamic_shape=False,
+                 host_transfer=None, f32_only=False):
         self.name = name
         self.fn = fn
         # held while a DEFERRED jax.vjp re-traces fn at backward() time
@@ -72,6 +74,15 @@ class Op:
         # raises DynamicShapeError under abstract tracing so callers
         # (e.g. _CachedGraph) can fall back to eager precisely
         self.dynamic_shape = dynamic_shape
+        # mx.analysis metadata (docs/static-analysis.md). host_transfer:
+        # the op forces a device->host sync per call (dynamic-shape ops
+        # always do — the output shape is read from device values).
+        # f32_only: the op intentionally computes in f32 under AMP
+        # (loss-scale bookkeeping, norm accumulations), so the
+        # dtype-promotion rule must not flag its internal upcasts.
+        self.host_transfer = bool(dynamic_shape if host_transfer is None
+                                  else host_transfer)
+        self.f32_only = bool(f32_only)
 
 
 class DynamicShapeError(TypeError):
@@ -83,7 +94,8 @@ class DynamicShapeError(TypeError):
 
 def register(name=None, differentiable=True, stochastic=False,
              namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1,
-             static_argnums=(), static_argnames=(), dynamic_shape=False):
+             static_argnums=(), static_argnames=(), dynamic_shape=False,
+             host_transfer=None, f32_only=False):
     """Decorator registering a raw-array function as an operator.
 
     The decorated ``fn`` takes jax arrays (plus static kwargs) and returns a
@@ -99,7 +111,8 @@ def register(name=None, differentiable=True, stochastic=False,
                 aliases=aliases, wrap=wrap, n_out=n_out,
                 static_argnums=static_argnums,
                 static_argnames=static_argnames,
-                dynamic_shape=dynamic_shape)
+                dynamic_shape=dynamic_shape,
+                host_transfer=host_transfer, f32_only=f32_only)
         _OPS[opname] = op
         for a in aliases:
             _OPS[a] = op
@@ -238,7 +251,11 @@ def invoke(op_name, args, kwargs):
 
     op = _OPS[op_name] if isinstance(op_name, str) else op_name
     out = kwargs.pop('out', None)
-    if op.stochastic:
+    if op.stochastic and kwargs.get('training', True):
+        # training=False (e.g. eval-mode dropout) never consumes the
+        # key: drawing one anyway would burn an RNG fold per call and
+        # leave a dead random_fold_in chain in every eval graph (the
+        # mx.analysis dead-code rule flagged exactly this in the zoo)
         kwargs.setdefault('key', _rng.next_key())
 
     # split tracked NDArrays (incl. inside list/tuple args, e.g. concat)

@@ -17,6 +17,7 @@ _config = {'profile_all': False, 'filename': '/tmp/mxnet_tpu_profile',
 _records = []
 _op_stats = {}      # name -> [count, total_s, min_s, max_s, out_bytes]
 _mem_stats = {'peak_live_bytes': 0}
+_analysis_reports = {}   # graph name -> mx.analysis.AnalysisReport
 
 
 def set_config(profile_all=False, profile_symbolic=True,
@@ -103,11 +104,20 @@ def record_op(name, dt, out_bytes):
             pass
 
 
+def attach_analysis(name, report):
+    """Attach a graph-sanitizer report (``mx.analysis``) so ``dumps()``
+    shows static findings next to the runtime numbers —
+    ``hybridize(check=True)`` calls this after its first-compile lint.
+    Latest report per graph name wins."""
+    with _stats_lock:
+        _analysis_reports[name] = report
+
+
 def dumps(reset=False):
     """Aggregate statistics table (reference ``mx.profiler.dumps()`` over
     ``src/profiler/aggregate_stats.cc``): per-op count / total / avg /
     min / max latency + output bytes, then scoped host timings, then the
-    memory summary."""
+    memory summary, then any attached graph-analysis summaries."""
     lines = ['Profile Statistics:']
     if _op_stats:
         lines.append('Operator summary (imperative dispatch, synced '
@@ -132,10 +142,17 @@ def dumps(reset=False):
     if _config['memory'] and _mem_stats['peak_live_bytes']:
         lines.append(f'Peak live device memory: '
                      f'{_mem_stats["peak_live_bytes"] / 1e6:.2f} MB')
+    if _analysis_reports:
+        lines.append('Graph analysis (mx.analysis):')
+        for name, report in sorted(_analysis_reports.items()):
+            lines.append(f'  {report.summary()}')
+            for f in report.findings:
+                lines.append(f'    [{f.severity}] {f.rule}: {f.message}')
     if reset:
         _records.clear()
         _op_stats.clear()
         _mem_stats['peak_live_bytes'] = 0
+        _analysis_reports.clear()
     return '\n'.join(lines)
 
 

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Regenerate the serialization-format fixtures under tests/fixtures/format/.
+
+The committed files are the contract: tests/test_format_fixtures.py
+asserts that TODAY'S code still loads them bit-exactly (params) and
+reproduces the recorded forward outputs (graph json). Only rerun this
+script on a deliberate format-version bump — never to "fix" a failing
+fixture test, which by construction means a compatibility break
+(docs/static-analysis.md: format stability gate).
+
+Usage: JAX_PLATFORMS=cpu python tests/fixtures/generate_format_fixtures.py
+"""
+
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+
+import numpy as np
+
+
+def build_mlp(mx, nn):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation='relu'), nn.Dense(4))
+    return net, mx.np.array(np.linspace(-1, 1, 2 * 8, dtype='f')
+                            .reshape(2, 8))
+
+
+def build_zoo(mx, nn):
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model('mobilenet0.25', classes=4)
+    x = mx.np.array(np.random.randn(1, 3, 64, 64).astype('f'))
+    return net, x
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    out_dir = os.path.join(HERE, 'format')
+    os.makedirs(out_dir, exist_ok=True)
+
+    for name, build in [('mlp', build_mlp), ('mobilenet0.25', build_zoo)]:
+        np.random.seed(7)
+        mx.random.seed(7)
+        net, x = build(mx, nn)
+        # Xavier keeps activations O(1) through deep stacks — the
+        # recorded outputs stay far from denormals, so the numeric
+        # check in the fixture test is meaningful
+        net.initialize(mx.initializer.Xavier())
+        y = net(x)
+
+        tag = name.replace('.', '_')
+        prefix = os.path.join(out_dir, tag)
+        net.save_parameters(f'{prefix}.params.npz')
+        sym_file, param_file = net.export(prefix)
+        np.save(f'{prefix}.input.npy', x.asnumpy())
+        np.save(f'{prefix}.output.npy', y.asnumpy())
+        print(f'{name}: wrote {os.path.basename(sym_file)}, '
+              f'{os.path.basename(param_file)}, params/input/output '
+              f'({y.asnumpy().ravel()[:3]}...)')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,374 @@
+"""mx.analysis graph sanitizer: per-rule positive/negative fixtures,
+the hybridize(check=True) surface, the donation audit against the
+static_alloc runtime claim, and the tools/graph_lint.py CLI over
+representative zoo models (the CI gate — docs/static-analysis.md)."""
+
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, profiler
+from mxnet_tpu.gluon import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_hit(report):
+    return {(f.rule, f.severity) for f in report.findings}
+
+
+def rule_names(report):
+    return {f.rule for f in report.findings}
+
+
+# ------------------------------------------------------ report plumbing
+def test_report_severities_and_strict():
+    r = mx.analysis.AnalysisReport('g')
+    r.add('some-rule', 'warning', 'w')
+    r.add('some-rule', 'info', 'i')
+    assert r.ok and len(r.warnings) == 1 and len(r.infos) == 1
+    strict = mx.analysis.AnalysisReport('g', strict=True)
+    strict.add('some-rule', 'warning', 'w')
+    assert not strict.ok and len(strict.errors) == 1
+    with pytest.raises(mx.MXNetError):
+        strict.raise_if_errors()
+    with pytest.raises(ValueError):
+        r.add('some-rule', 'fatal', 'bad severity')
+
+
+def test_strict_env_var(monkeypatch):
+    monkeypatch.setenv('MXNET_ANALYSIS_STRICT', '1')
+    r = mx.analysis.AnalysisReport('g')
+    r.add('some-rule', 'warning', 'w')
+    assert r.strict and not r.ok
+
+
+def test_all_rules_registered():
+    names = set(mx.analysis.all_rules())
+    assert {'implicit-f32-promotion', 'large-constant-capture',
+            'recompile-hazard', 'host-transfer', 'dead-code',
+            'donation-audit'} <= names
+
+
+# ------------------------------------------------- rule 1: f32 promotion
+def test_dtype_promotion_flags_bf16_upcast():
+    def f(x):
+        return (x * 2).astype('float32') + 1
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 4), dtype='bfloat16'))
+    assert ('implicit-f32-promotion', 'warning') in rules_hit(r)
+
+
+def test_dtype_promotion_silent_on_f32_graph():
+    def f(x):
+        return (x * 2).astype('float32') + 1
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 4)))
+    assert 'implicit-f32-promotion' not in rule_names(r)
+
+
+def test_dtype_promotion_exempts_f32_only_ops():
+    # layer_norm is registered f32_only=True: its internal f32
+    # statistics are intentional under bf16 (ops/nn.py)
+    def f(x, g, b):
+        return mx.npx.layer_norm(x, g, b)
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 128), dtype='bfloat16'),
+                         mx.np.ones((128,)), mx.np.zeros((128,)))
+    assert 'implicit-f32-promotion' not in rule_names(r)
+
+
+# --------------------------------------------- rule 2: captured constant
+def test_large_constant_capture():
+    big = onp.ones((256, 256), onp.float32)          # 256 KB
+
+    def f(x):
+        return x + mx.np.array(big)
+
+    r = mx.analysis.lint(f, mx.np.ones((256, 256)))
+    assert ('large-constant-capture', 'warning') in rules_hit(r)
+    # no double-report through the host-transfer rule for the same
+    # const upload
+    assert 'host-transfer' not in rule_names(r)
+
+
+def test_small_constant_not_flagged():
+    small = onp.ones((4, 4), onp.float32)
+
+    def f(x):
+        return x + mx.np.array(small)
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 4)))
+    assert 'large-constant-capture' not in rule_names(r)
+
+
+def test_constant_threshold_config_and_env(monkeypatch):
+    tiny = onp.ones((8, 8), onp.float32)             # 256 B
+
+    def f(x):
+        return x + mx.np.array(tiny)
+
+    r = mx.analysis.lint(f, mx.np.ones((8, 8)), const_bytes=128)
+    assert 'large-constant-capture' in rule_names(r)
+    monkeypatch.setenv('MXNET_ANALYSIS_CONST_BYTES', '128')
+    r = mx.analysis.lint(f, mx.np.ones((8, 8)))
+    assert 'large-constant-capture' in rule_names(r)
+
+
+# --------------------------------------------- rule 3: recompile hazard
+def test_recompile_hazard_weak_scalar():
+    def f(x, s):
+        return x * s
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 4)), 3)
+    assert ('recompile-hazard', 'warning') in rules_hit(r)
+
+
+def test_recompile_hazard_silent_on_array_args():
+    def f(x, y):
+        return x * y
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 4)), mx.np.ones((4, 4)))
+    assert 'recompile-hazard' not in rule_names(r)
+
+
+# ------------------------------------------------- rule 4: host transfer
+def test_host_transfer_callbacks():
+    import jax
+
+    def f(x):
+        jax.debug.print('sum {s}', s=x._data.sum())
+        y = jax.pure_callback(
+            lambda a: onp.asarray(a) * 2,
+            jax.ShapeDtypeStruct(x.shape, onp.float32), x._data)
+        return mx.nd.NDArray(y)
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 4)))
+    sevs = {f.severity for f in r.by_rule('host-transfer')}
+    assert 'error' in sevs        # pure_callback stalls the device
+    assert 'warning' in sevs      # debug print = leftover instrumentation
+    assert not r.ok
+
+
+def test_clean_graph_no_host_transfer():
+    def f(x):
+        return (x * 2).sum()
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 4)))
+    assert 'host-transfer' not in rule_names(r)
+
+
+# ----------------------------------------------------- rule 5: dead code
+class _DeadNet(nn.HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.used = nn.Dense(8)
+        self.unused = nn.Dense(8)     # constructed, never called
+
+    def forward(self, x):
+        dead = x * 3 + 1              # reaches no output
+        return self.used(x), x        # second output = pass-through
+
+
+def test_dead_code_rule():
+    net = _DeadNet()
+    r = mx.analysis.lint(net, mx.np.ones((2, 4)))
+    msgs = [f.message for f in r.by_rule('dead-code')]
+    assert any('never left deferred' in m for m in msgs)      # unused.weight
+    assert any('unused parameter' in m for m in msgs)         # unused.bias
+    assert any('pass-through' in m for m in msgs)
+    assert any('reach no output' in m for m in msgs)
+
+
+def test_dead_code_silent_on_clean_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation='relu'), nn.Dense(2))
+    r = mx.analysis.lint(net, mx.np.ones((2, 4)))
+    assert 'dead-code' not in rule_names(r)
+
+
+# ------------------------------------------------ rule 6: donation audit
+def test_donation_audit_proves_static_alloc_aliases():
+    """The static_alloc donation claim (PARITY.md) is machine-checked:
+    recorded-train executables donate BN aux state and XLA records the
+    input-output aliasing in the compiled HLO."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm())
+    r = mx.analysis.lint(net, mx.np.ones((4, 8)), train=True,
+                         donation=True)
+    assert r.stats['donated_args'] == 2       # running_mean, running_var
+    assert r.stats['aliased_args'] == 2
+    assert r.ok
+    assert not [f for f in r.by_rule('donation-audit')
+                if f.severity == 'warning']
+
+
+def test_donation_audit_inference_entries_do_not_donate():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm())
+    r = mx.analysis.lint(net, mx.np.ones((4, 8)), donation=True)
+    infos = r.by_rule('donation-audit')
+    assert infos and all(f.severity == 'info' for f in infos)
+    assert 'donated_args' not in r.stats
+
+
+def test_donation_audit_flags_inert_claim():
+    # output shape matches no input: the donation cannot alias
+    def f(x):
+        return x.sum()
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 4)), donation=True,
+                         donate_argnums=(0,))
+    audit = r.by_rule('donation-audit')
+    assert any(f.severity == 'warning' and 'NOT alias' in f.message
+               for f in audit)
+
+
+def test_donation_audit_skipped_without_flag():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8))
+    r = mx.analysis.lint(net, mx.np.ones((4, 8)))
+    assert 'donation-audit' not in r.rules_run
+
+
+def test_hlo_alias_parser():
+    from mxnet_tpu.analysis.rules.donation import (
+        parse_input_output_aliases)
+    hlo = ('HloModule jit_fn, input_output_alias={ {1}: (8, {}, '
+           'may-alias), {2}: (9, {}, may-alias) }, entry...')
+    assert parse_input_output_aliases(hlo) == {8: 1, 9: 2}
+    assert parse_input_output_aliases('HloModule nothing') == {}
+
+
+# ------------------------------------------- runtime donation semantics
+def test_static_alloc_train_step_donates_and_stats_move():
+    """End-to-end: recorded-train steps run the donating executable, BN
+    running stats advance, and subsequent inference is unaffected."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm())
+    net.initialize()
+    net(mx.np.ones((4, 8)))
+    net.hybridize(static_alloc=True)
+    x = mx.np.array(onp.random.rand(4, 8).astype('f'))
+    rm0 = net[1].running_mean.data().asnumpy().copy()
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+    assert not onp.allclose(rm0, net[1].running_mean.data().asnumpy())
+    g = net._cached_graph
+    assert (3,) in {k[2] for k in g._compiled}       # donating entry
+    y1, y2 = net(x).asnumpy(), net(x).asnumpy()
+    onp.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+
+# ----------------------------------------------- hybridize(check=True)
+class _DeadComputeNet(nn.HybridBlock):
+    """Dead eqns + pass-through output, but no deferred-forever layer —
+    the hybridized runtime itself requires every param initialized."""
+
+    def __init__(self):
+        super().__init__()
+        self.used = nn.Dense(8)
+
+    def forward(self, x):
+        dead = x * 3 + 1
+        return self.used(x), x
+
+
+def test_hybridize_check_warns_and_attaches():
+    net = _DeadComputeNet()
+    net.initialize()
+    net(mx.np.ones((2, 4)))
+    net.hybridize(check=True)
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter('always')
+        net(mx.np.ones((2, 4)))
+    assert any('dead-code' in str(w.message) for w in ws)
+    assert isinstance(net._analysis_report, mx.analysis.AnalysisReport)
+    assert 'Graph analysis' in profiler.dumps(reset=True)
+
+
+def test_hybridize_check_clean_net_silent():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation='relu'), nn.Dense(2))
+    net.initialize()
+    net(mx.np.ones((2, 4)))
+    net.hybridize(check=True)
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter('always')
+        net(mx.np.ones((2, 4)))
+    assert not [w for w in ws if 'AnalysisReport' in str(w.message)]
+    assert net._analysis_report.ok
+
+
+# ------------------------------------------------------- lint() surface
+def test_lint_accepts_shape_tuples():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    r = mx.analysis.lint(net, (2, 8))
+    assert r.ok and r.stats['params'] == 2
+
+
+def test_lint_rejects_non_callable():
+    with pytest.raises(TypeError):
+        mx.analysis.lint(42)
+
+
+def test_lint_rule_subset():
+    def f(x, s):
+        return x * s
+
+    r = mx.analysis.lint(f, mx.np.ones((4, 4)), 3,
+                         rules=['dead-code'])
+    assert r.rules_run == ['dead-code']
+    assert 'recompile-hazard' not in rule_names(r)
+
+
+# ----------------------------------------------------- zoo integration
+@pytest.mark.parametrize('name', ['mobilenet0.25', 'squeezenet1.1'])
+def test_zoo_lints_clean(name):
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model(name, classes=10)
+    net.initialize()
+    r = mx.analysis.lint(net, (1, 3, 224, 224))
+    assert r.ok and not r.warnings, str(r)
+
+
+def test_bert_lints_clean():
+    from mxnet_tpu.gluon.model_zoo import bert
+    net = bert.get_bert_model(num_layers=2, vocab_size=100, units=32,
+                              hidden_size=64, num_heads=2, dropout=0.0,
+                              use_decoder=False, use_classifier=False)
+    net.initialize()
+    toks = mx.np.array(onp.ones((2, 6), 'f'))
+    segs = mx.np.zeros((2, 6))
+    r = mx.analysis.lint(net, toks, segs)
+    assert r.ok and not r.warnings, str(r)
+
+
+# --------------------------------------------------------------- CLI
+def test_cli_three_representative_models():
+    """The CI gate: tools/graph_lint.py over the default representative
+    trio (conv+BN residual, depthwise, transformer) must exit 0."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'graph_lint.py'),
+         'resnet18_v1', 'mobilenet0.25', 'bert'],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'}, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count('clean') >= 3, proc.stdout
+
+
+def test_cli_nonzero_exit_on_failure():
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import graph_lint
+    finally:
+        sys.path.pop(0)
+    assert graph_lint.main(['not_a_model']) == 1

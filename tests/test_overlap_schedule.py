@@ -17,13 +17,34 @@ slice-and-schedule existed to get exactly this overlap/fusion behavior).
 """
 import pytest
 
-try:
-    import jax
+
+def _probe_aot_compiler(timeout_s=45):
+    """True iff the libtpu AOT topology compiler answers promptly.
+
+    Probed in a SUBPROCESS: when the axon tunnel's single TPU grant is
+    held elsewhere, libtpu does not raise — it spins on its lockfile
+    forever. An in-process probe would therefore hang pytest collection
+    for the whole suite; a child process can be killed on timeout and
+    the module degrades to a skip.
+    """
+    import subprocess
+    import sys
+    try:
+        proc = subprocess.run(
+            [sys.executable, '-c',
+             "from jax.experimental import topologies; "
+             "topologies.get_topology_desc("
+             "platform='tpu', topology_name='v5e:2x4')"],
+            timeout=timeout_s, capture_output=True)
+        return proc.returncode == 0
+    except Exception:                                  # pragma: no cover
+        return False
+
+
+_AOT = _probe_aot_compiler()
+if _AOT:                                               # pragma: no cover
     from jax.experimental import topologies
     topologies.get_topology_desc(platform='tpu', topology_name='v5e:2x4')
-    _AOT = True
-except Exception:                                      # pragma: no cover
-    _AOT = False
 
 pytestmark = pytest.mark.skipif(
     not _AOT, reason='libtpu AOT topology compiler unavailable')

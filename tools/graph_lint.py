@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Lint model-zoo graphs with the mx.analysis sanitizer.
+
+Traces each requested model exactly as ``hybridize`` would compile it
+and runs the jaxpr-level rule set (implicit f32 promotion, captured
+constants, recompile hazards, host transfers, dead code — plus the
+compile-backed donation audit with ``--donation``). Exits nonzero when
+any model reports an error-severity finding, so CI can gate on a clean
+zoo (docs/static-analysis.md).
+
+Usage:
+    python tools/graph_lint.py                          # default trio
+    python tools/graph_lint.py resnet18_v1 bert --train
+    python tools/graph_lint.py --all --strict --donation
+
+Runs on whatever backend jax selects; CI pins JAX_PLATFORMS=cpu (the
+jaxpr is backend-independent, only the donation audit compiles).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the three CI representatives: a residual conv net with BN aux state, a
+# depthwise net, and a transformer — between them they cover conv/BN,
+# reshape-heavy, and attention/masking graph shapes
+DEFAULT_MODELS = ['resnet18_v1', 'mobilenet0.25', 'bert']
+
+BERT_SMALL = dict(num_layers=2, vocab_size=100, units=32, hidden_size=64,
+                  num_heads=2, dropout=0.0, use_decoder=False,
+                  use_classifier=False)
+
+
+def build_model(name, classes, mx):
+    """-> (block, example_args) for a zoo name or the small-BERT alias."""
+    import numpy as np
+    if name.startswith('bert'):
+        from mxnet_tpu.gluon.model_zoo import bert
+        if name == 'bert':
+            net = bert.get_bert_model(**BERT_SMALL)
+        else:
+            net = bert.get_bert_model(name)
+        toks = mx.np.array(np.ones((2, 6), 'f'))
+        segs = mx.np.zeros((2, 6))
+        return net, (toks, segs)
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model(name, classes=classes)
+    size = 299 if name == 'inceptionv3' else 224
+    x = mx.np.array(np.ones((1, 3, size, size), 'f'))
+    return net, (x,)
+
+
+def lint_one(name, args, mx):
+    """Lint one model; returns its AnalysisReport (or None on build
+    failure, which is itself reported as an error)."""
+    net, example = build_model(name, args.classes, mx)
+    net.initialize()
+    report = mx.analysis.lint(
+        net, *example, train=args.train, donation=args.donation,
+        strict=True if args.strict else None, name=name)
+    return report
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('models', nargs='*', default=None,
+                   help='zoo model names (plus "bert" for a 2-layer '
+                        f'BERT); default: {" ".join(DEFAULT_MODELS)}')
+    p.add_argument('--all', action='store_true',
+                   help='lint every vision-zoo model plus small BERT')
+    p.add_argument('--train', action='store_true',
+                   help='lint the train-mode graph (dropout, BN batch '
+                        'stats + aux write-backs)')
+    p.add_argument('--donation', action='store_true',
+                   help='also compile and audit buffer donation/aliasing')
+    p.add_argument('--strict', action='store_true',
+                   help='promote warnings to errors (MXNET_ANALYSIS_STRICT)')
+    p.add_argument('--classes', type=int, default=10,
+                   help='classifier width for vision models (default 10)')
+    p.add_argument('--verbose', '-v', action='store_true',
+                   help='print info-severity findings too')
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+
+    if args.all:
+        from mxnet_tpu.gluon.model_zoo.vision import _models
+        models = sorted(_models) + ['bert']
+    else:
+        models = args.models or DEFAULT_MODELS
+
+    n_errors = n_warnings = 0
+    failed = []
+    for name in models:
+        try:
+            report = lint_one(name, args, mx)
+        except Exception as e:   # noqa: BLE001 - report and keep going
+            print(f'{name}: LINT FAILED — {type(e).__name__}: {e}')
+            failed.append(name)
+            continue
+        errs = report.errors
+        warns = [f for f in report.findings if f.severity == 'warning'
+                 and f not in errs]
+        n_errors += len(errs)
+        n_warnings += len(warns)
+        status = 'clean' if not report.findings else report.summary()
+        print(f'{name}: {status}')
+        shown = report.findings if args.verbose else errs + warns
+        for f in shown:
+            loc = f' [{f.location}]' if f.location else ''
+            print(f'  {f.severity.upper()} {f.rule}{loc}: {f.message}')
+
+    print(f'\n{len(models)} model(s): {n_errors} error(s), '
+          f'{n_warnings} warning(s), {len(failed)} failed to lint')
+    if failed:
+        print('failed:', ', '.join(failed))
+    return 1 if (n_errors or failed) else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
