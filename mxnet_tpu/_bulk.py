@@ -56,6 +56,8 @@ import jax
 from jax import lax
 
 from . import _tape
+from .analysis import race as _race
+from .analysis.race import guarded_by as _guarded_by
 
 _MAX_SIBLINGS = 16     # distinct static-arg keys per (position, op) before
                        # the position is treated as unstable
@@ -154,6 +156,14 @@ class _Segment:
     def __init__(self, state):
         self.state = state
         self.lock = threading.RLock()
+        self._race = None
+        if _race.enabled():
+            # declared level 'bulk.segment' (analysis/locks.py); every
+            # entries/trie mutation must hold self.lock — the Eraser
+            # lockset checker verifies it across foreign-thread settles
+            self.lock = _race.tracked(self.lock, 'bulk.segment')
+            self._race = _race.shared_state('bulk._Segment',
+                                            guard=self.lock)
         self.boundary = []          # raw jax arrays
         self.boundary_ids = {}      # (id(raw), id(ag)) -> index
         self.boundary_ags = []      # AGInfo|None per boundary input
@@ -165,9 +175,12 @@ class _Segment:
         self.flushed = False
 
     # ------------------------------------------------------------- recording
+    @_guarded_by('lock')
     def add(self, op, arrays, fn, bulk_key, grad_active):
         """Append one op. Returns list of LazyRefs, or None (caller goes
         eager; segment left consistent)."""
+        if self._race is not None:
+            self._race.write()
         # Pass 1 — validate before mutating anything: an in-segment lazy
         # value whose NDArray carries an _ag DIFFERENT from the AGInfo this
         # segment attached to that output (detach()+attach_grad alias, a
@@ -290,8 +303,11 @@ class _Segment:
         with self.lock:
             if self.flushed:
                 return
+            if self._race is not None:
+                self._race.write()
             self.flushed = True
             if not self.entries:
+                _race.handoff_release(self)
                 return
             self.state.flushes += 1
 
@@ -340,6 +356,9 @@ class _Segment:
             self.entries = []
             self.agrefs = []
             self.ag_by_key = {}
+            # happens-before edge: values are published; the recording
+            # thread's next access to them is a handoff, not a race
+            _race.handoff_release(self)
 
 
 def _build_replay(entries):
@@ -496,7 +515,9 @@ def flush_current():
 
 def materialize(ref):
     if ref.value is None and ref.seg is not None:
-        ref.seg.flush()
+        seg = ref.seg
+        seg.flush()
+        _race.handoff_acquire(seg)
 
 
 # ------------------------------------------------------------ dispatch hook
@@ -518,7 +539,9 @@ def try_record(op, arrays, fn, bulk_key, grad_active):
                 and ref.seg is not _st.segment:
             # lazy value from a foreign (e.g. other-thread) segment:
             # settle it before taking our own lock (avoids lock nesting)
-            ref.seg.flush()
+            fseg = ref.seg
+            fseg.flush()
+            _race.handoff_acquire(fseg)
     while True:
         seg = _current()
         if seg is None:

@@ -27,9 +27,11 @@ from .report import AnalysisReport, Finding, strict_enabled
 from .walker import GraphView, trace_block, trace_function, iter_eqns
 from . import rules
 from .rules import all_rules, run_rules
+from . import locks
+from . import race
 
 __all__ = ['lint', 'AnalysisReport', 'Finding', 'GraphView',
-           'all_rules', 'rules', 'strict_enabled']
+           'all_rules', 'rules', 'strict_enabled', 'locks', 'race']
 
 
 def lint(fn_or_block, *example_args, train=False, rules=None,

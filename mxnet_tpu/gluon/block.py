@@ -298,6 +298,16 @@ class _CachedGraph:
         # serializes tracing + recorded calls; see __call__ (reference:
         # src/imperative/cached_op_threadsafe.cc thread-safe CachedOp)
         self._lock = threading.RLock()
+        self._race = None
+        from ..analysis import race as _race
+        if _race.enabled():
+            # declared level 'block.graph' (analysis/locks.py). Only
+            # cache WRITES are annotated: the lock-free _ready probe on
+            # the steady-state inference path is by design (re-checked
+            # under the lock) and must not be reported.
+            self._lock = _race.tracked(self._lock, 'block.graph')
+            self._race = _race.shared_state('block._CachedGraph.cache',
+                                            guard=self._lock)
         self._ready = set()        # keys whose first call fully completed
         # set when the graph has data-dependent shapes (boolean_mask,
         # np.unique, ...) that abstract jit tracing cannot express —
@@ -308,6 +318,8 @@ class _CachedGraph:
 
     def clear(self):
         with self._lock:
+            if self._race is not None:
+                self._race.write()
             self._compiled.clear()
             self._out_trees.clear()
             self._ready.clear()
@@ -457,6 +469,8 @@ class _CachedGraph:
                     # which re-snapshots the rebound (post-donation)
                     # state under the lock and executes while holding it
         with self._lock:
+            if self._race is not None:
+                self._race.write()
             if key not in self._compiled:
                 self._compiled[key] = self._build(key, train_mode,
                                                   len(in_nds), treedef,
@@ -528,6 +542,8 @@ class _CachedGraph:
             # re-hybridize can retry compilation.
             self._dynamic = True
             with self._lock:
+                if self._race is not None:
+                    self._race.write()
                 self._compiled.pop(key, None)
                 self._out_trees.pop(key, None)
                 self._ready.discard(key)

@@ -10,6 +10,7 @@ import collections
 
 import numpy as _np
 
+from ..analysis import race as _race
 from ..ndarray.ndarray import NDArray, array
 
 DataDesc = collections.namedtuple('DataDesc', ['name', 'shape', 'dtype',
@@ -295,7 +296,13 @@ class PrefetchingIter(DataIter):
                         fail = e
                         break
                     try:
-                        q.put(self._place(self._merge(batches)))
+                        batch = self._place(self._merge(batches))
+                        # happens-before edge for the race checker: the
+                        # consumer's handoff_acquire in __next__ orders
+                        # its reads after everything this thread did to
+                        # the batch (queue handoff = ownership transfer)
+                        _race.handoff_release(q)
+                        q.put(batch)
                     except Exception as e:      # placement (cast/device
                         fail = e                # transfer) failed
                         break
@@ -343,6 +350,7 @@ class PrefetchingIter(DataIter):
         if self._done:
             raise StopIteration
         batch = self._queue.get()
+        _race.handoff_acquire(self._queue)
         if batch is None:
             self._done = True           # exhausted: further next() raises
             raise StopIteration

@@ -153,6 +153,18 @@ class _AsyncServer(threading.Thread):
         self._barrier_gen = 0
         self._barrier_arrivals = set()   # (client, seq) this generation
         self._barrier_cv = threading.Condition()
+        self._race = None
+        from ..analysis import race as _race
+        if _race.enabled():
+            # declared levels 'kvstore.store' / 'kvstore.barrier'
+            # (analysis/locks.py); every _store mutation must hold
+            # self._lock — handler threads race each other and the
+            # heartbeat reaper
+            self._lock = _race.tracked(self._lock, 'kvstore.store')
+            self._barrier_cv = _race.tracked_condition(
+                self._barrier_cv, 'kvstore.barrier')
+            self._race = _race.shared_state('kvstore._AsyncServer._store',
+                                            guard=self._lock)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -285,6 +297,8 @@ class _AsyncServer(threading.Thread):
             arr = _onp.frombuffer(payload, header['dtype']).reshape(
                 header['shape']).copy()
             with self._lock:
+                if self._race is not None:
+                    self._race.write()
                 # first init wins (reference: rank 0 authoritative)
                 self._store.setdefault(header['key'], arr)
                 self._counters['init_applied'] += 1
@@ -293,6 +307,8 @@ class _AsyncServer(threading.Thread):
             grad = _onp.frombuffer(payload, header['dtype']).reshape(
                 header['shape'])
             with self._lock:
+                if self._race is not None:
+                    self._race.write()
                 w = self._store.get(header['key'])
                 if w is None:
                     self._store[header['key']] = grad.copy()
@@ -300,14 +316,19 @@ class _AsyncServer(threading.Thread):
                     # immediate apply — the async DataHandleDefault branch
                     wn = NDArray(w)
                     self._updater(header['key'], NDArray(grad), wn)
+                    # the sync IS the apply: a pull must never observe a
+                    # half-applied weight, so it stays under the store
+                    # lock
                     self._store[header['key']] = _onp.asarray(
-                        wn.asnumpy())
+                        wn.asnumpy())  # lock-lint: disable=blocking-call-under-lock -- server-side updater runs on host CPU arrays; syncing outside the store lock would let pulls read torn updates
                 else:
                     self._store[header['key']] = w + grad
                 self._counters['push_applied'] += 1
             return {'ok': True}, b''
         if cmd == 'pull':
             with self._lock:
+                if self._race is not None:
+                    self._race.read()
                 w = self._store.get(header['key'])
                 if w is None:
                     # a clean error keeps the connection alive (a raise
@@ -361,8 +382,10 @@ class _AsyncServer(threading.Thread):
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                 else:
+                    deadline = _kv_deadline_s()
                     released = self._barrier_cv.wait_for(
-                        lambda: self._barrier_gen != gen, timeout=120)
+                        lambda: self._barrier_gen != gen,
+                        timeout=deadline)
                     if not released:
                         # undo our arrival so later barriers don't
                         # release one worker early, and surface the
@@ -371,13 +394,30 @@ class _AsyncServer(threading.Thread):
                         self._barrier_count -= 1
                         self._barrier_arrivals.discard(ident)
                         return {'ok': False,
-                                'error': 'barrier timeout after 120s: '
-                                         'not all workers arrived'}, b''
+                                'error': f'barrier timeout after '
+                                         f'{deadline:g}s '
+                                         f'(MXNET_KVSTORE_DEADLINE_S): '
+                                         f'not all workers arrived'}, b''
             return {'ok': True}, b''
         return {'ok': False, 'error': f'unknown cmd {cmd!r}'}, b''
 
 
 _SERVERS = {}
+# guards _SERVERS: two stores connecting concurrently in one process
+# must not double-create (and double-bind) the per-port server
+_SERVERS_LOCK = threading.Lock()
+
+
+def _kv_deadline_s():
+    """Liveness deadline for control-plane waits (barrier wait_for,
+    heartbeat join): ``MXNET_KVSTORE_DEADLINE_S`` (default 120) — a dead
+    peer can no longer hang a barrier forever. Distinct from
+    ``MXNET_KVSTORE_RPC_DEADLINE_S``, the per-RPC transport budget."""
+    try:
+        return max(1e-3, float(os.environ.get(
+            'MXNET_KVSTORE_DEADLINE_S', '120')))
+    except ValueError:
+        return 120.0
 
 
 @register
@@ -459,13 +499,14 @@ class KVStoreDistAsync(KVStoreBase):
             # one server per process regardless of how many dist_async
             # stores the worker creates) and must start it before
             # dialing itself below
-            self._server = _SERVERS.get(self._port)
-            if self._server is None:
-                bind = '127.0.0.1' if local else host
-                self._server = _AsyncServer(self._port, bind_host=bind,
-                                            sid=0)
-                self._server.start()
-                _SERVERS[self._port] = self._server
+            with _SERVERS_LOCK:
+                self._server = _SERVERS.get(self._port)
+                if self._server is None:
+                    bind = '127.0.0.1' if local else host
+                    self._server = _AsyncServer(self._port,
+                                                bind_host=bind, sid=0)
+                    self._server.start()
+                    _SERVERS[self._port] = self._server
         # every rank (rank 0 included) connects to the advertised
         # coordinator host: the server may be bound to that interface
         # only, so rank 0 dialing loopback would be refused
@@ -482,13 +523,15 @@ class KVStoreDistAsync(KVStoreBase):
             if 0 < self._rank < self._nserv:
                 my_port = self._port + self._rank
                 myif = self._socks[0].getsockname()[0]
-                self._server = _SERVERS.get(my_port)
-                if self._server is None:
-                    self._server = _AsyncServer(
-                        my_port, bind_host='127.0.0.1' if local else myif,
-                        sid=self._rank)
-                    self._server.start()
-                    _SERVERS[my_port] = self._server
+                with _SERVERS_LOCK:
+                    self._server = _SERVERS.get(my_port)
+                    if self._server is None:
+                        self._server = _AsyncServer(
+                            my_port,
+                            bind_host='127.0.0.1' if local else myif,
+                            sid=self._rank)
+                        self._server.start()
+                        _SERVERS[my_port] = self._server
                 myaddr = f'{myif}:{my_port}'
                 self._rpc_to(0, {'cmd': 'register_server',
                                  'sid': self._rank, 'addr': myaddr})
@@ -551,8 +594,11 @@ class KVStoreDistAsync(KVStoreBase):
             self._hb_stop.set()
             # join BEFORE the bye RPC: an in-flight ping landing after
             # the bye would re-add this rank to the server's last-seen
-            # table and resurrect the dead-forever accounting bug
-            hb.join(timeout=10)
+            # table and resurrect the dead-forever accounting bug.
+            # Deadline-bounded: a pinger stuck in a dying RPC must not
+            # hang close() (the thread is a daemon; leaking it past the
+            # deadline is safe)
+            hb.join(timeout=min(10.0, _kv_deadline_s()))
             self._hb_thread = None
         if 0 in self._socks:
             try:

@@ -147,6 +147,7 @@ def register(reg_name):
     """Register a CustomOpProp subclass (reference operator.py:710)."""
 
     def deco(prop_cls):
+        # lock-lint: disable=unguarded-shared-state -- registration is import-time/main-thread; the worker thread only drains its queue and never touches _REGISTRY
         _REGISTRY[reg_name] = prop_cls
         return prop_cls
 

@@ -92,16 +92,18 @@ def record_op(name, dt, out_bytes):
             s[2] = min(s[2], dt)
             s[3] = max(s[3], dt)
             s[4] += out_bytes
-    if _config['memory']:
-        # O(1) allocator peak where the backend exposes it (TPU does);
-        # a per-op live_arrays() walk would be O(live buffers) per call
-        try:
-            stats = jax.devices()[0].memory_stats()
-            peak = int((stats or {}).get('peak_bytes_in_use', 0))
-            if peak > _mem_stats['peak_live_bytes']:
-                _mem_stats['peak_live_bytes'] = peak
-        except Exception:
-            pass
+        if _config['memory']:
+            # O(1) allocator peak where the backend exposes it (TPU
+            # does); a per-op live_arrays() walk would be O(live
+            # buffers) per call. Under the stats lock so a concurrent
+            # dumps(reset=True) cannot interleave with the update.
+            try:
+                stats = jax.devices()[0].memory_stats()
+                peak = int((stats or {}).get('peak_bytes_in_use', 0))
+                if peak > _mem_stats['peak_live_bytes']:
+                    _mem_stats['peak_live_bytes'] = peak
+            except Exception:
+                pass
 
 
 def attach_analysis(name, report):
@@ -148,11 +150,24 @@ def dumps(reset=False):
             lines.append(f'  {report.summary()}')
             for f in report.findings:
                 lines.append(f'    [{f.severity}] {f.rule}: {f.message}')
+    try:
+        from .analysis import race as _race
+    except ImportError:         # partial install / early interpreter exit
+        _race = None
+    if _race is not None and _race.enabled():
+        lines.append('Concurrency (mx.analysis.race):')
+        lines.append(f'  {_race.summary_line()}')
+        for f in _race.report().findings:
+            loc = f' @ {f.location}' if f.location else ''
+            lines.append(f'    [{f.severity}] {f.rule}: {f.message}{loc}')
     if reset:
-        _records.clear()
-        _op_stats.clear()
-        _mem_stats['peak_live_bytes'] = 0
-        _analysis_reports.clear()
+        # under the stats lock: DataLoader worker threads may be mid-
+        # record_op while the main thread resets between epochs
+        with _stats_lock:
+            _records.clear()
+            _op_stats.clear()
+            _mem_stats['peak_live_bytes'] = 0
+            _analysis_reports.clear()
     return '\n'.join(lines)
 
 
@@ -186,7 +201,8 @@ def scope(name='<unk>:'):
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
-    _records.append((name, time.perf_counter() - t0))
+    with _stats_lock:
+        _records.append((name, time.perf_counter() - t0))
 
 
 class Task:
@@ -199,7 +215,9 @@ class Task:
 
     def stop(self):
         if self._t0 is not None:
-            _records.append((self.name, time.perf_counter() - self._t0))
+            with _stats_lock:
+                _records.append((self.name,
+                                 time.perf_counter() - self._t0))
 
 
 Frame = Task
@@ -226,7 +244,8 @@ class Marker:
         self.name = name
 
     def mark(self, scope='process'):
-        _records.append((self.name, 0.0))
+        with _stats_lock:
+            _records.append((self.name, 0.0))
 
 
 def server_annotation(*a, **kw):

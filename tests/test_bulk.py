@@ -367,3 +367,89 @@ def test_hashable_slice_recurses():
     t_py = registry._hashable(slice(2, None, None))
     assert t_np != t_py
     assert t_py == ('__slice__', ('i', 2), None, None)
+
+
+# ----------------------------------------------- foreign-thread settles
+def test_foreign_thread_settle_interleaving():
+    """Regression pin for the try_record settle window (_bulk.py): the
+    recording thread's segment is flushed BY ANOTHER THREAD between two
+    of its records. The flushed re-check under the segment lock must
+    restart recording into a fresh segment instead of appending to the
+    dead one (which would orphan the outputs). Event-sequenced — the
+    interleaving is the same every run."""
+    import threading
+
+    out = {}
+    e_recorded = threading.Event()
+    e_settled = threading.Event()
+    errs = []
+
+    def recorder():
+        try:
+            with engine.bulk(64):
+                a = mx.np.ones((4,))
+                out['y'] = a + 1            # lazy in segment S1
+                seg1 = out['y']._lazy.seg
+                e_recorded.set()
+                assert e_settled.wait(10)   # main flushed S1 meanwhile
+                # S1 is now foreign-flushed: this record must land in a
+                # fresh segment, not the dead S1
+                b = mx.np.ones((4,)) * 3
+                out['w'] = b + 1
+                assert out['w']._lazy is not None
+                assert out['w']._lazy.seg is not seg1
+                assert seg1.flushed
+        except Exception as e:              # surfaced below
+            errs.append(e)
+            e_recorded.set()
+
+    t = threading.Thread(target=recorder)
+    t.start()
+    assert e_recorded.wait(10)
+    assert not errs
+    # foreign settle: main thread flushes the recorder's live segment
+    onp.testing.assert_allclose(out['y'].asnumpy(), 2.0)
+    e_settled.set()
+    t.join(10)
+    assert not errs
+    onp.testing.assert_allclose(out['w'].asnumpy(), 4.0)
+
+
+def test_foreign_settle_stress():
+    """Thread B keeps settling A's freshest lazy output while A records
+    — every settled value must be correct and A's own sync at the end
+    must agree. (The deterministic single-interleaving version is
+    test_foreign_thread_settle_interleaving; this sweeps the window.)"""
+    import threading
+
+    rounds = 30
+    latest = {'nd': None, 'round': -1}
+    stop = threading.Event()
+    errs = []
+
+    def settler():
+        try:
+            while not stop.is_set():
+                nd, rnd = latest['nd'], latest['round']
+                if nd is not None:
+                    got = nd.asnumpy()      # foreign settle mid-record
+                    onp.testing.assert_allclose(got, float(rnd + 2))
+        except Exception as e:
+            errs.append(e)
+
+    t = threading.Thread(target=settler)
+    t.start()
+    try:
+        finals = []
+        with engine.bulk(8):
+            for i in range(rounds):
+                a = mx.np.ones((4,)) * (i + 1)
+                y = a + 1
+                latest['nd'], latest['round'] = y, i
+                finals.append((i, y))
+        for i, y in finals:
+            onp.testing.assert_allclose(y.asnumpy(), float(i + 2))
+    finally:
+        stop.set()
+        t.join(10)
+    assert not errs, errs

@@ -370,3 +370,20 @@ def test_kvstore_soak_long(monkeypatch):
     assert res['verified_exactly_once']
     assert res['server_counters']['push_applied'] == 600
     assert res['faults']['reset'] >= 10
+
+
+def test_barrier_deadline_bounds_missing_peer(async_store):
+    """Satellite of ISSUE 8: a barrier whose peers never arrive must
+    fail after MXNET_KVSTORE_DEADLINE_S with a clear error instead of
+    hanging the worker forever, and must undo the arrival so a later
+    full barrier still releases cleanly."""
+    kv = async_store(MX_NPROC=2, MXNET_KVSTORE_DEADLINE_S='0.3')
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match='barrier timeout'):
+        kv.barrier()
+    assert time.monotonic() - t0 < 10
+    # the timed-out arrival was rolled back: the barrier still needs
+    # two fresh arrivals, so a second solo attempt times out again
+    # rather than sailing through on the stale count
+    with pytest.raises(RuntimeError, match='barrier timeout'):
+        kv.barrier()

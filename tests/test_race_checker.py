@@ -1,0 +1,390 @@
+"""Dynamic Eraser-style race/deadlock checker (mxnet_tpu.analysis.race).
+
+Every planted race here is DETERMINISTIC — interleavings are sequenced
+with Events (or are single-threaded, for the lock-order findings), so
+the checker either fires on the exact access or the build fails. That is
+the self-test the ISSUE requires: if the checker is ever disabled by a
+bug, the planted lockset violation and the planted lock-order cycle stop
+being detected and these tests go red.
+"""
+
+import os
+import socket
+import threading
+from contextlib import closing
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _bulk
+from mxnet_tpu.analysis import race
+from mxnet_tpu.base import MXNetError
+
+ENV_ENABLED = os.environ.get('MXNET_RACE_CHECK', '') == '1'
+
+
+@pytest.fixture
+def checker():
+    """Checker on with a clean slate; restores the pre-test state so an
+    env-enabled CI rerun keeps its global checker."""
+    was_active = race.enabled()
+    race.enable()
+    race.reset()
+    yield race
+    race.reset()
+    if not was_active:
+        race.disable()
+
+
+def _rules(r):
+    return [f.rule for f in r.report().findings]
+
+
+# ------------------------------------------------------- planted race (CI)
+def test_planted_lockset_violation_detected(checker):
+    """Two threads write one unguarded shared object with no common lock
+    and no happens-before edge between them — the Eraser lockset empties
+    and the checker must report it. Event-sequenced: same interleaving
+    every run."""
+    st = race.shared_state('test.planted')
+    e1, e2 = threading.Event(), threading.Event()
+
+    def writer1():
+        st.write()
+        e1.set()
+        e2.wait(10)
+
+    def writer2():
+        e1.wait(10)
+        st.write()       # exclusive -> shared-mod (no HB from writer1)
+        st.write()       # lockset already empty -> violation fires
+        e2.set()
+
+    t1 = threading.Thread(target=writer1)
+    t2 = threading.Thread(target=writer2)
+    t1.start(), t2.start()
+    t1.join(10), t2.join(10)
+    assert 'lockset-violation' in _rules(checker)
+    with pytest.raises(MXNetError, match='lockset'):
+        race.assert_clean()
+
+
+def test_planted_lock_order_cycle_detected(checker):
+    """A -> B observed, then B -> A requested: the order graph closes a
+    cycle. Single-threaded, so detection is deterministic — no deadlock
+    has to actually happen."""
+    la = race.tracked(threading.Lock(), 'test.order.A')
+    lb = race.tracked(threading.Lock(), 'test.order.B')
+    with la:
+        with lb:
+            pass
+    with lb:
+        with la:
+            pass
+    assert 'lock-order-cycle' in _rules(checker)
+    with pytest.raises(MXNetError, match='cycle'):
+        race.assert_clean()
+
+
+def test_planted_hierarchy_inversion_detected(checker):
+    """Registered level names invert the declared hierarchy: acquiring a
+    'bulk.segment' (level 0) lock while holding 'kvstore.store' (level
+    3) is flagged on first occurrence, single-threaded."""
+    outer = race.tracked(threading.Lock(), 'kvstore.store')
+    inner = race.tracked(threading.Lock(), 'bulk.segment')
+    with outer:
+        with inner:
+            pass
+    assert 'lock-hierarchy' in _rules(checker)
+
+
+def test_correct_order_and_hb_are_clean(checker):
+    """Hierarchy-respecting nesting plus fork/join-ordered writes must
+    produce zero findings."""
+    outer = race.tracked(threading.Lock(), 'bulk.segment')
+    inner = race.tracked(threading.Lock(), 'kvstore.store')
+    st = race.shared_state('test.clean')
+    st.write()
+    with outer:
+        with inner:
+            st2 = race.shared_state('test.guarded', guard=inner)
+            st2.write()
+
+    def child():
+        st.write()          # ordered after main's write by Thread.start
+
+    t = threading.Thread(target=child)
+    t.start()
+    t.join(10)
+    st.write()              # ordered after child's write by Thread.join
+    race.assert_clean()
+    assert _rules(checker) == []
+
+
+# -------------------------------------------------------------- primitives
+def test_guard_annotation_fires_without_lock(checker):
+    lock = race.tracked(threading.Lock(), 'misc.leaf')
+    st = race.shared_state('test.guarded', guard=lock)
+    st.write()
+    assert _rules(checker) == ['guarded-by-violation']
+    f = checker.report().findings[0]
+    assert 'test.guarded' in f.message and 'misc.leaf' in f.message
+
+
+def test_guard_annotation_clean_under_lock(checker):
+    lock = race.tracked(threading.Lock(), 'misc.leaf')
+    st = race.shared_state('test.guarded', guard=lock)
+    with lock:
+        st.write()
+        st.read()
+    st.read()               # reads do not require the guard
+    race.assert_clean()
+
+
+def test_guarded_by_decorator(checker):
+    class Obj:
+        def __init__(self):
+            self.lock = race.tracked(threading.RLock(), 'misc.leaf')
+
+        @race.guarded_by('lock')
+        def mutate(self):
+            return 1
+
+    o = Obj()
+    with o.lock:
+        assert o.mutate() == 1
+    race.assert_clean()
+    assert o.mutate() == 1          # runs, but records the violation
+    assert _rules(checker) == ['guarded-by-violation']
+
+
+def test_handoff_suppresses_ownership_transfer(checker):
+    """Producer writes, publishes via handoff_release; consumer acquires
+    the channel clock before touching the object: an ownership transfer,
+    not a race — the object stays Exclusive."""
+    class _Chan:                    # weakref-able handoff token
+        pass
+
+    chan = _Chan()
+    st = race.shared_state('test.handoff')
+    done = threading.Event()
+
+    def producer():
+        st.write()
+        race.handoff_release(chan)
+        done.set()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    assert done.wait(10)
+    race.handoff_acquire(chan)      # no join yet: the channel is the edge
+    st.write()
+    st.write()
+    t.join(10)
+    race.assert_clean()
+    assert race.stats()['handoffs'] == 1
+
+
+def test_condition_wait_drops_lock_from_held_stack(checker):
+    cv = race.tracked_condition(threading.Condition(), 'kvstore.barrier')
+    with cv:
+        assert cv.held_by_me()
+        cv.wait(0.01)               # releases + re-acquires underneath
+        assert cv.held_by_me()
+    assert not cv.held_by_me()
+    race.assert_clean()
+
+
+def test_tracked_reentrant_rlock(checker):
+    rl = race.tracked(threading.RLock(), 'block.graph')
+    with rl:
+        with rl:                    # re-entrant: no order edge, no finding
+            assert rl.held_by_me()
+    race.assert_clean()
+
+
+def test_stats_and_summary_line(checker):
+    lock = race.tracked(threading.Lock(), 'misc.leaf')
+    st = race.shared_state('test.stats', guard=lock)
+    with lock:
+        st.write()
+    s = race.stats()
+    assert s['acquires'] >= 1 and s['accesses'] >= 1
+    line = race.summary_line()
+    assert '0 error(s)' in line and 'acquires' in line
+
+
+@pytest.mark.skipif(ENV_ENABLED, reason='checker forced on by env')
+def test_disabled_is_identity_and_free():
+    assert not race.enabled()
+    lk = threading.Lock()
+    assert race.tracked(lk, 'misc.leaf') is lk
+    cv = threading.Condition()
+    assert race.tracked_condition(cv, 'kvstore.barrier') is cv
+    st = race.shared_state('test.off')
+    assert st.write() is st and st.read() is st     # inert no-ops
+    assert race.stats() == {}
+    assert race.report().ok
+
+
+# ------------------------------------------------- runtime instrumentation
+def test_segment_instrumentation_is_live(checker):
+    """Build-fails-if-checker-dead probe for the bulk engine: a fresh
+    _Segment constructed under the checker must carry a tracked lock and
+    a guarded SharedState, and an unlocked write on it must be flagged."""
+    seg = _bulk._Segment(_bulk._State())
+    assert isinstance(seg.lock, race.TrackedLock)
+    assert seg.lock.name == 'bulk.segment'
+    assert seg._race is not None
+    with seg.lock:
+        seg._race.write()
+    race.assert_clean()
+    seg._race.write()               # seeded: no lock held
+    assert _rules(checker) == ['guarded-by-violation']
+
+
+def test_cached_graph_instrumentation_is_live(checker):
+    from mxnet_tpu.gluon.block import _CachedGraph
+
+    class Dense(mx.gluon.nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = mx.gluon.nn.Dense(4)
+
+        def forward(self, x):
+            return self.d(x)
+
+    net = Dense()
+    net.initialize()
+    net.hybridize()
+    x = mx.np.ones((2, 8))
+    net(x)
+    graph = net._cached_graph
+    assert isinstance(graph, _CachedGraph)
+    assert isinstance(graph._lock, race.TrackedLock)
+    assert graph._lock.name == 'block.graph'
+    assert graph._race is not None
+    race.assert_clean()
+
+
+def test_bulk_engine_clean_under_checker(checker):
+    """Same-thread record/flush through the real engine: the annotated
+    segment accesses all happen under the tracked segment lock."""
+    with mx.engine.bulk(8):
+        a = mx.np.ones((4,))
+        b = a + 1
+        c = b * 2
+    onp.testing.assert_allclose(c.asnumpy(), 4.0)
+    race.assert_clean()
+    assert race.stats()['accesses'] >= 1
+
+
+def test_foreign_settle_handoff_clean(checker):
+    """Satellite 2 interleaving at checker level: thread A records a
+    bulked segment, main settles A's lazy value (foreign settle =
+    flush + handoff), then A records again. The handoff edge makes
+    main's read an ownership transfer — zero findings."""
+    out = {}
+    e_recorded, e_settled = threading.Event(), threading.Event()
+
+    def worker():
+        with mx.engine.bulk(64):
+            x = mx.np.ones((4,))
+            out['y'] = x + 1
+            e_recorded.set()
+            assert e_settled.wait(10)
+            z = mx.np.ones((4,)) * 3
+            out['w'] = z + 1
+        out['w'].wait_to_read()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    assert e_recorded.wait(10)
+    onp.testing.assert_allclose(out['y'].asnumpy(), 2.0)   # foreign settle
+    e_settled.set()
+    t.join(10)
+    onp.testing.assert_allclose(out['w'].asnumpy(), 4.0)
+    race.assert_clean()
+
+
+def _free_port():
+    with closing(socket.socket()) as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def test_dist_async_faulted_under_checker(checker, monkeypatch):
+    """Integration: the dist_async store with the PR 4 fault harness
+    delaying replies (deterministic scheduling pressure) and two worker
+    threads pushing concurrently. The tracked store lock and barrier CV
+    must satisfy the declared discipline — assert_clean is the gate."""
+    from mxnet_tpu import kvstore
+    from mxnet_tpu.kvstore import dist_async, faults
+
+    port = _free_port()
+    monkeypatch.setenv('MX_COORDINATOR', f'127.0.0.1:{_free_port()}')
+    monkeypatch.setenv('MXNET_KVSTORE_ASYNC_PORT', str(port))
+    monkeypatch.setenv('MXNET_KVSTORE_HEARTBEAT_S', '3600')
+    monkeypatch.setenv('MX_PROC_ID', '0')
+    monkeypatch.setenv('MX_NPROC', '1')
+    kv = kvstore.create('dist_async')
+    try:
+        kv.init('w', mx.np.zeros((8,)))
+        faults.configure('delay:push:10ms')
+        errs = []
+
+        def pusher():
+            try:
+                for _ in range(3):
+                    kv.push('w', mx.np.ones((8,)))
+            except Exception as e:      # surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=pusher) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert not errs
+        onp.testing.assert_allclose(kv.pull('w').asnumpy(), 6.0)
+        srv = dist_async._SERVERS.get(port)
+        assert srv is not None and isinstance(
+            srv._lock, race.TrackedLock)
+        race.assert_clean()
+    finally:
+        faults.clear()
+        kv.close()
+        srv = dist_async._SERVERS.pop(port, None)
+        if srv is not None:
+            srv.stop()
+
+
+# ---------------------------------------------------------------- surfaces
+def test_profiler_concurrency_section(checker):
+    from mxnet_tpu import profiler
+
+    lock = race.tracked(threading.Lock(), 'misc.leaf')
+    st = race.shared_state('test.section', guard=lock)
+    st.write()                      # planted guard violation
+    text = profiler.dumps()
+    assert 'Concurrency (mx.analysis.race):' in text
+    assert 'guarded-by-violation' in text
+    assert 'error(s)' in text
+
+
+def test_findings_carry_caller_location(checker):
+    lock = race.tracked(threading.Lock(), 'misc.leaf')
+    st = race.shared_state('test.loc', guard=lock)
+    st.write()
+    f = checker.report().findings[0]
+    assert f.location and 'test_race_checker.py' in f.location
+
+
+def test_reset_clears_findings_keeps_enabled(checker):
+    st = race.shared_state('test.reset', guard='misc.leaf')
+    st.write()
+    assert not race.report().ok
+    race.reset()
+    assert race.enabled() and race.report().ok
+    race.assert_clean()

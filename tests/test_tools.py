@@ -146,3 +146,33 @@ def test_flakiness_checker_spec_parsing():
     assert p2.endswith('test_tools.py') and name2 == 'test_diagnose_runs'
     p3, name3 = fc.parse_test_spec('test_tools.py')
     assert p3.endswith('test_tools.py') and name3 is None
+
+
+def test_flakiness_checker_race_mode(monkeypatch):
+    """--race injects MXNET_RACE_CHECK=1 into every trial's env (and
+    plain trials leave it unset) without touching the parent env."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'flakiness_checker', 'tools/flakiness_checker.py')
+    fc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fc)
+    seen = []
+
+    class _Res:
+        returncode = 0
+        stdout = b''
+
+    def fake_run(cmd, env=None, capture_output=None):
+        seen.append(env)
+        return _Res()
+
+    monkeypatch.setattr(fc.subprocess, 'run', fake_run)
+    monkeypatch.delenv('MXNET_RACE_CHECK', raising=False)
+    fails = fc.run_trials('tests/test_tools.py', None, 2, seed=0,
+                          verbosity=0, race=True)
+    assert fails == 0 and len(seen) == 2
+    assert all(e.get('MXNET_RACE_CHECK') == '1' for e in seen)
+    seen.clear()
+    fc.run_trials('tests/test_tools.py', None, 1, seed=0, verbosity=0)
+    assert 'MXNET_RACE_CHECK' not in seen[0]
+    assert 'MXNET_RACE_CHECK' not in os.environ

@@ -58,13 +58,18 @@ def parse_test_spec(spec):
     raise ValueError(f'could not locate a test named {spec!r}')
 
 
-def run_trials(path, name, num_trials, seed, verbosity):
+def run_trials(path, name, num_trials, seed, verbosity, race=False):
     target = f'{path}::{name}' if name else path
     rng = random.Random(seed)
     failures = 0
     for trial in range(num_trials):
         trial_seed = rng.randrange(2 ** 31)
         env = dict(os.environ, MXNET_TEST_SEED=str(trial_seed))
+        if race:
+            # each trial runs under the dynamic race/deadlock checker
+            # (mxnet_tpu.analysis.race) — a trial that only fails under
+            # MXNET_RACE_CHECK=1 is a concurrency bug, not seed noise
+            env['MXNET_RACE_CHECK'] = '1'
         cmd = [sys.executable, '-m', 'pytest', '-q', target]
         if verbosity > 2:
             cmd.remove('-q')
@@ -92,6 +97,9 @@ def parse_args():
                         help='seed for the trial-seed sequence '
                         '(reproducible rerun of a flaky batch)')
     parser.add_argument('-v', '--verbosity', type=int, default=2)
+    parser.add_argument('--race', action='store_true',
+                        help='run every trial with MXNET_RACE_CHECK=1 '
+                        '(Eraser-style dynamic race/deadlock checker)')
     return parser.parse_args()
 
 
@@ -99,7 +107,7 @@ def main():
     args = parse_args()
     path, name = parse_test_spec(args.test)
     failures = run_trials(path, name, args.num_trials, args.seed,
-                          args.verbosity)
+                          args.verbosity, race=args.race)
     sys.exit(1 if failures else 0)
 
 

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Static lock-discipline lint over the host runtime.
+
+Companion to ``tools/graph_lint.py`` (which lints compiled graphs): this
+walks ``mxnet_tpu/**`` source ASTs and enforces the lock hierarchy and
+discipline declared in ``mxnet_tpu/analysis/locks.py`` — lock-order
+inversions, blocking calls under a lock, module-level shared state
+mutated without its lock, and thread-local values escaping their thread.
+
+Usage::
+
+    python tools/lock_lint.py                # lint mxnet_tpu/
+    python tools/lock_lint.py path/file.py   # lint specific files/dirs
+    python tools/lock_lint.py --strict       # warnings fail too (CI)
+
+Exit status: 1 if any error finding (or, with ``--strict`` /
+``MXNET_LOCK_LINT_STRICT=1``, any finding at all), else 0.
+
+The checker module is loaded by file path, not package import, so this
+tool runs without importing jax — it is safe (and fast) in any CI stage.
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_locks():
+    path = os.path.join(_REPO, 'mxnet_tpu', 'analysis', 'locks.py')
+    spec = importlib.util.spec_from_file_location('_lock_lint_impl', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='lock-discipline lint for the threaded host runtime')
+    parser.add_argument('paths', nargs='*',
+                        default=[os.path.join(_REPO, 'mxnet_tpu')],
+                        help='files or directories to lint '
+                             '(default: mxnet_tpu/)')
+    parser.add_argument('--strict', action='store_true',
+                        help='treat warnings as errors '
+                             '(also MXNET_LOCK_LINT_STRICT=1)')
+    parser.add_argument('-q', '--quiet', action='store_true',
+                        help='suppress the summary line')
+    args = parser.parse_args(argv)
+
+    locks = _load_locks()
+    findings = []
+    for path in args.paths:
+        if os.path.isdir(path):
+            findings.extend(locks.lint_tree(path))
+        else:
+            findings.extend(locks.lint_file(path))
+
+    errors = [f for f in findings if f.severity == 'error']
+    warnings = [f for f in findings if f.severity != 'error']
+    for f in findings:
+        print(repr(f))
+    strict = args.strict or locks.strict_enabled()
+    if not args.quiet:
+        print(f'lock_lint: {len(errors)} error(s), {len(warnings)} '
+              f'warning(s)' + (' [strict]' if strict else ''))
+    return 1 if (errors or (strict and warnings)) else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
