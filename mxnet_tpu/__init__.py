@@ -90,6 +90,7 @@ from . import image
 from . import parallel
 from . import amp
 from . import analysis
+from . import serve
 from . import quantization
 from . import contrib
 from . import test_utils

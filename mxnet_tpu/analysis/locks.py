@@ -56,6 +56,15 @@ import os
 # a thread holding an ``A``-level lock may acquire a ``B``-level lock,
 # never the reverse. See docs/threading.md for the prose contract.
 LOCK_HIERARCHY = (
+    ('serve.queue', 'DynamicBatcher._cv / DecodeServer._cv (Condition): '
+                    'the bounded admission queue, batching window and '
+                    'drain/close flags; outermost — the scheduler thread '
+                    'releases it before any model dispatch '
+                    '(mxnet_tpu/serve/batcher.py, serve/decode.py)'),
+    ('serve.slots', 'DecodeServer._slot_lock: the KV-cache slot pool '
+                    'table and per-slot sequence state; taken after the '
+                    'queue lock when admitting, never across a compiled '
+                    'step (mxnet_tpu/serve/decode.py)'),
     ('bulk.segment', '_Segment.lock (RLock): per-thread bulked-eager '
                      'segment; foreign threads take it only to settle '
                      '(mxnet_tpu/_bulk.py)'),
@@ -93,6 +102,10 @@ LOCK_SITES = {
         '_SERVERS_LOCK': 'misc.leaf',
     },
     '*/kvstore/faults.py': {'_lock': 'misc.leaf'},
+    '*/serve/batcher.py': {'_cv': 'serve.queue'},
+    '*/serve/decode.py': {'_cv': 'serve.queue', '_slot_lock': 'serve.slots'},
+    '*/serve/metrics.py': {'_lock': 'misc.leaf'},
+    '*/serve/faults.py': {'_lock': 'misc.leaf'},
     '*/profiler.py': {'_stats_lock': 'misc.leaf'},
     '*/symbol/symbol.py': {'_name_lock': 'misc.leaf'},
     '*/operator.py': {'_lock': 'misc.leaf'},

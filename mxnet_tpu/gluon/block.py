@@ -270,6 +270,14 @@ class Block:
         for child in self._children.values():
             child.hybridize(active, **kwargs)
 
+    @property
+    def compile_count(self):
+        """Total XLA executables built for this block's subtree since
+        construction (monotonic; survives re-hybridize/clear). The
+        serving layer (``mx.serve``) asserts this stays flat after
+        bucket prewarm — the zero-recompiles-under-traffic guarantee."""
+        return sum(child.compile_count for child in self._children.values())
+
 
 class _CachedGraph:
     """Compiled-executable cache for one HybridBlock (≙ CachedOp,
@@ -291,6 +299,10 @@ class _CachedGraph:
         # to reuse the passed buffers); never the default — gluon
         # callers keep live NDArray handles to their inputs
         self.donate_inputs = donate_inputs
+        # monotonic count of executables built (never reset by clear():
+        # the serving layer's zero-recompiles-after-warmup guarantee is
+        # checked against this, so re-hybridize churn must show up too)
+        self.compiles = 0
         self._compiled = {}
         self._out_trees = {}       # per cache entry: output pytree structure
         self._param_order = None
@@ -475,6 +487,7 @@ class _CachedGraph:
                 self._compiled[key] = self._build(key, train_mode,
                                                   len(in_nds), treedef,
                                                   donate=donate)
+                self.compiles += 1
             jfn = self._compiled[key]
             main_nds = [p.data() for p in main]
             aux_raws = tuple(p.data()._data for p in aux)
@@ -651,6 +664,50 @@ class HybridBlock(Block):
         main_raws = tuple(p.data()._data for p in main)
         aux_raws = tuple(p.data()._data for p in aux)
         return fn, in_raws, main_raws, aux_raws
+
+    @property
+    def compile_count(self):
+        """See :attr:`Block.compile_count`; adds this block's own cache."""
+        own = self._cached_graph.compiles if isinstance(
+            self._cached_graph, _CachedGraph) else 0
+        return own + sum(c.compile_count for c in self._children.values())
+
+    def prewarm(self, input_specs, dtype='float32'):
+        """Compile executables for a declared set of input shapes before
+        they ever see traffic (the serving layer's bucket warmup; no
+        reference analog — CachedOp compiles lazily per shape).
+
+        ``input_specs``: iterable of entries, each either a shape tuple
+        for a single-input block, a ``(shape, dtype)`` pair, or a tuple
+        of shape tuples for multi-input blocks. Runs one non-recorded
+        forward per entry (discarding outputs) so the compile cache holds
+        every declared bucket. Returns the number of new executables
+        built (0 when everything was already warm)."""
+        before = self.compile_count
+        for spec in input_specs:
+            d = dtype
+            if (isinstance(spec, tuple) and len(spec) == 2
+                    and isinstance(spec[0], tuple)
+                    and isinstance(spec[1], str)):
+                spec, d = spec
+            if isinstance(spec, tuple) and spec \
+                    and isinstance(spec[0], tuple):
+                shapes = spec
+            else:
+                shapes = (tuple(spec),)
+            args = [array(_np.zeros(s, dtype=_np.dtype(d))) for s in shapes]
+            prev = _tape.set_recording(False)
+            try:
+                first = not self._first_forward_done
+                self(*args)
+                if first:
+                    # the very first call runs the shape-inference
+                    # forward without populating the compile cache —
+                    # dispatch again so this bucket is genuinely warm
+                    self(*args)
+            finally:
+                _tape.set_recording(prev)
+        return self.compile_count - before
 
     def infer_shape(self, *args):
         """Reference block.py:1278 — resolve deferred parameter shapes from

@@ -72,16 +72,22 @@ def _rope(x, theta, offset=0):
     """Apply rotary position embeddings to (B, S, H, Dh) — interleaved
     even/odd-pair convention (NOT HuggingFace's rotate-half: converting HF
     checkpoints requires their q/k weight permutation). Pure function of
-    shape: folds into the jit as constants."""
+    shape: folds into the jit as constants.
+
+    ``offset`` is a scalar (shared position shift — prefill / lockstep
+    decode) or a ``(B,)`` array of per-row positions (continuous-batching
+    decode, where every cache slot sits at its own depth)."""
     import jax.numpy as jnp
     _, S, _, Dh = x.shape
     inv = 1.0 / (theta ** (jnp.arange(0, Dh, 2, dtype=jnp.float32) / Dh))
     # offset may be a traced scalar (jitted decode step): keep the arange
-    # static and add the offset
-    pos = jnp.arange(S, dtype=jnp.float32) + offset
-    ang = pos[:, None] * inv[None, :]                  # (S, Dh/2)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    # static and add the offset. atleast_1d makes the scalar and per-row
+    # cases share one code path: pos is (1, S) or (B, S).
+    pos = jnp.atleast_1d(jnp.asarray(offset, jnp.float32))[:, None] \
+        + jnp.arange(S, dtype=jnp.float32)
+    ang = pos[..., None] * inv[None, None, :]          # (1|B, S, Dh/2)
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
     x1, x2 = x[..., ::2], x[..., 1::2]
     xf1 = x1.astype(jnp.float32)
     xf2 = x2.astype(jnp.float32)
@@ -118,7 +124,13 @@ class LlamaAttention(HybridBlock):
         (B, max_len, kv_heads, dh) for incremental decode — new K/V are
         written at ``offset`` (static-shape ``dynamic_update_slice``, the
         TPU-idiomatic KV cache) and attention runs over the cache with an
-        absolute-position causal mask. Returns out, or (out, new_cache)."""
+        absolute-position causal mask. Returns out, or (out, new_cache).
+
+        When ``offset`` is a ``(B,)`` array (continuous-batching decode,
+        ``mx.serve.DecodeServer``) each batch row is an independent cache
+        slot at its own depth: S must be 1, the new K/V land at
+        ``offset[b]`` per row (vectorized scatter) and row b's query
+        attends to cache positions ``<= offset[b]``."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -131,24 +143,39 @@ class LlamaAttention(HybridBlock):
         v = self.v_proj(x)._data.reshape(B, S, self._kv, self._dh)
         q = _rope(q, self._theta, offset=offset)
         k = _rope(k, self._theta, offset=offset)
+        per_slot = getattr(offset, 'ndim', 0) == 1
 
         if cache is not None:
             k_cache, v_cache = cache
-            k_cache = lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, offset, 0, 0))
-            v_cache = lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, offset, 0, 0))
             L = k_cache.shape[1]
+            if per_slot:
+                assert S == 1, 'per-slot offsets decode one token per step'
+                rows = jnp.arange(B)
+                k_cache = k_cache.at[rows, offset].set(
+                    k[:, 0].astype(k_cache.dtype))
+                v_cache = v_cache.at[rows, offset].set(
+                    v[:, 0].astype(v_cache.dtype))
+            else:
+                k_cache = lax.dynamic_update_slice(
+                    k_cache, k.astype(k_cache.dtype), (0, offset, 0, 0))
+                v_cache = lax.dynamic_update_slice(
+                    v_cache, v.astype(v_cache.dtype), (0, offset, 0, 0))
             rep = self._h // self._kv
             kf = jnp.repeat(k_cache, rep, 2) if rep > 1 else k_cache
             vf = jnp.repeat(v_cache, rep, 2) if rep > 1 else v_cache
             scores = jnp.einsum(
                 'bshd,blhd->bhsl', q.astype(jnp.float32),
                 kf.astype(jnp.float32)) * (self._dh ** -0.5)
-            # query i (absolute position offset+i) sees cache slots <= it
-            qpos = offset + jnp.arange(S)[:, None]
-            mask = jnp.arange(L)[None, :] <= qpos        # (S, L)
-            scores = jnp.where(mask[None, None], scores, -1e30)
+            if per_slot:
+                # row b's single query (absolute position offset[b]) sees
+                # its own slots <= offset[b]
+                mask = jnp.arange(L)[None, :] <= offset[:, None]  # (B, L)
+                scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+            else:
+                # query i (absolute position offset+i) sees slots <= it
+                qpos = offset + jnp.arange(S)[:, None]
+                mask = jnp.arange(L)[None, :] <= qpos        # (S, L)
+                scores = jnp.where(mask[None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             out = jnp.einsum('bhsl,blhd->bshd', probs,
                              vf.astype(jnp.float32)).astype(x.dtype)
@@ -255,7 +282,15 @@ class LlamaForCausalLM(HybridBlock):
 
     def init_caches(self, batch_size, max_length=None, dtype='float32'):
         """Allocate per-layer KV caches: list of (k, v), each
-        (B, max_length, kv_heads, dh)."""
+        (B, max_length, kv_heads, dh).
+
+        ``batch_size`` is a free parameter, not hard-wired to one value:
+        re-initializing at a different *bucketed* batch size reuses the
+        per-step compiled fn as long as the bucket matches — callers with
+        varying live batch sizes pad rows up to a bucket (see
+        ``generate(batch_bucket=...)``) or hand slots out of a fixed-size
+        pool (``mx.serve.DecodeServer``), masking/ignoring retired rows
+        instead of retracing."""
         import jax.numpy as jnp
         cfg = self.cfg
         L = max_length or cfg.max_length
@@ -264,38 +299,14 @@ class LlamaForCausalLM(HybridBlock):
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_layers)]
 
-    def generate(self, token_ids, max_new_tokens=32, max_length=None,
-                 temperature=0.0, seed=0):
-        """Autoregressive generation with a static-shape KV cache.
-
-        TPU design: prefill is one jitted call over the whole prompt; each
-        decode step is ONE jitted call reused for every position (the
-        offset enters as a traced scalar, so there is exactly one compile
-        for the prefill shape and one for the (B, 1) decode shape — no
-        per-position retracing). Greedy when ``temperature == 0``, else
-        temperature sampling.
-
-        token_ids: (B, S) NDArray / array of prompt tokens.
-        Returns (B, S + max_new_tokens) NDArray.
-        """
-        import jax
-        import jax.numpy as jnp
+    def _param_run(self):
+        """The decode-step closure shared by :meth:`generate` and
+        ``mx.serve.DecodeServer``: a pure ``run(praws, tok_raw, caches,
+        offset) -> (logits_raw, caches)`` over raw parameter arrays
+        (traceable — swaps the raws into the Parameters for the span of
+        one forward), plus the current praws mapping."""
         from ... import _tape
         from ...ndarray.ndarray import NDArray
-
-        toks = token_ids._data if isinstance(token_ids, NDArray) \
-            else jnp.asarray(token_ids)
-        toks = toks.astype(jnp.int32)
-        B, S = toks.shape
-        # default cache length is sized from the power-of-two-rounded
-        # decode budget (not the tight S + max_new_tokens), so
-        # varying-length generate() calls land on a handful of compiled
-        # (cache-shape, scan-length) programs instead of one per n
-        n_pow2 = 1
-        while n_pow2 < max(max_new_tokens - 1, 1):
-            n_pow2 *= 2
-        L = max_length or min(self.cfg.max_length, S + n_pow2 + 1)
-        assert S + max_new_tokens <= L, 'max_length too small'
 
         params = self.collect_params()
         praws = {name: p.data()._data for name, p in params.items()}
@@ -314,6 +325,60 @@ class LlamaForCausalLM(HybridBlock):
                 for p, d in saved:
                     p._data = d
                 _tape.set_recording(prev)
+
+        return run, praws
+
+    def generate(self, token_ids, max_new_tokens=32, max_length=None,
+                 temperature=0.0, seed=0, batch_bucket=None):
+        """Autoregressive generation with a static-shape KV cache.
+
+        TPU design: prefill is one jitted call over the whole prompt; each
+        decode step is ONE jitted call reused for every position (the
+        offset enters as a traced scalar, so there is exactly one compile
+        for the prefill shape and one for the (B, 1) decode shape — no
+        per-position retracing). Greedy when ``temperature == 0``, else
+        temperature sampling.
+
+        token_ids: (B, S) NDArray / array of prompt tokens.
+        Returns (B, S + max_new_tokens) NDArray.
+
+        ``batch_bucket`` pads the batch dim up to a declared bucket size
+        (dummy rows, sliced off the result) so varying live batch sizes
+        share ONE set of compiled prefill/decode programs and one cache
+        shape — re-running at a different B within the bucket neither
+        re-traces the per-step fn nor reallocates a differently-shaped
+        cache. Batch rows are independent under causal attention, so the
+        dummy rows cannot perturb the real ones.
+        """
+        import jax
+        import jax.numpy as jnp
+        from ...ndarray.ndarray import NDArray
+
+        toks = token_ids._data if isinstance(token_ids, NDArray) \
+            else jnp.asarray(token_ids)
+        toks = toks.astype(jnp.int32)
+        B_req, S = toks.shape
+        if batch_bucket is not None:
+            if batch_bucket < B_req:
+                raise ValueError(
+                    f'batch_bucket={batch_bucket} smaller than the '
+                    f'actual batch {B_req}')
+            if batch_bucket > B_req:
+                toks = jnp.concatenate(
+                    [toks, jnp.zeros((batch_bucket - B_req, S),
+                                     jnp.int32)])
+        B = toks.shape[0]
+        # default cache length is sized from the power-of-two-rounded
+        # decode budget (not the tight S + max_new_tokens), so
+        # varying-length generate() calls land on a handful of compiled
+        # (cache-shape, scan-length) programs instead of one per n
+        n_pow2 = 1
+        while n_pow2 < max(max_new_tokens - 1, 1):
+            n_pow2 *= 2
+        L = max_length or min(self.cfg.max_length, S + n_pow2 + 1)
+        assert S + max_new_tokens <= L, 'max_length too small'
+
+        run, praws = self._param_run()
 
         def pick(logits, key):
             last = logits[:, -1, :].astype(jnp.float32)
@@ -378,7 +443,8 @@ class LlamaForCausalLM(HybridBlock):
             rest, caches = decode_n(praws, nxt, caches,
                                     jnp.asarray(S, jnp.int32), key)
             out.append(rest[:n_rest].T)   # drop pad-to-power-of-2 excess
-        return NDArray(jnp.concatenate(out, axis=1))
+        full = jnp.concatenate(out, axis=1)
+        return NDArray(full[:B_req])      # drop batch-bucket dummy rows
 
 
 def llama_partition_rules(axis='tp'):

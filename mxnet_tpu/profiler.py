@@ -15,9 +15,25 @@ import jax
 _config = {'profile_all': False, 'filename': '/tmp/mxnet_tpu_profile',
            'running': False, 'ops': False, 'memory': False}
 _records = []
-_op_stats = {}      # name -> [count, total_s, min_s, max_s, out_bytes]
+# name -> [count, total_s, min_s, max_s, out_bytes, samples]; ``samples``
+# is a bounded ring of per-call latencies feeding the percentile columns
+_op_stats = {}
+_OP_SAMPLES = 512
 _mem_stats = {'peak_live_bytes': 0}
 _analysis_reports = {}   # graph name -> mx.analysis.AnalysisReport
+_serving = {}            # server name -> stats-snapshot provider (mx.serve)
+
+
+def percentiles(samples, qs=(50, 95, 99)):
+    """Nearest-rank percentiles of a latency sample set, as
+    ``{q: value}``. Shared between the per-op table and the Serving
+    section (``mx.serve`` metrics use the same estimator so the two
+    surfaces agree)."""
+    if not samples:
+        return {q: 0.0 for q in qs}
+    s = sorted(samples)
+    return {q: s[min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))]
+            for q in qs}
 
 
 def set_config(profile_all=False, profile_symbolic=True,
@@ -85,13 +101,17 @@ def record_op(name, dt, out_bytes):
     with _stats_lock:
         s = _op_stats.get(name)
         if s is None:
-            _op_stats[name] = [1, dt, dt, dt, out_bytes]
+            _op_stats[name] = [1, dt, dt, dt, out_bytes, [dt]]
         else:
             s[0] += 1
             s[1] += dt
             s[2] = min(s[2], dt)
             s[3] = max(s[3], dt)
             s[4] += out_bytes
+            if len(s[5]) < _OP_SAMPLES:
+                s[5].append(dt)
+            else:
+                s[5][s[0] % _OP_SAMPLES] = dt
         if _config['memory']:
             # O(1) allocator peak where the backend exposes it (TPU
             # does); a per-op live_arrays() walk would be O(live
@@ -106,6 +126,22 @@ def record_op(name, dt, out_bytes):
                 pass
 
 
+def attach_serving(name, provider):
+    """Register a serving-stats snapshot provider (``mx.serve`` servers
+    call this at construction) so ``dumps()`` shows a Serving section
+    next to the op table. ``provider`` is a zero-arg callable returning
+    the stats dict; it stays registered across ``dumps(reset=True)`` —
+    the server owns its counters' lifetime, not the profiler."""
+    with _stats_lock:
+        _serving[name] = provider
+
+
+def detach_serving(name):
+    """Drop a serving provider (called from ``Server.close()``)."""
+    with _stats_lock:
+        _serving.pop(name, None)
+
+
 def attach_analysis(name, report):
     """Attach a graph-sanitizer report (``mx.analysis``) so ``dumps()``
     shows static findings next to the runtime numbers —
@@ -118,20 +154,23 @@ def attach_analysis(name, report):
 def dumps(reset=False):
     """Aggregate statistics table (reference ``mx.profiler.dumps()`` over
     ``src/profiler/aggregate_stats.cc``): per-op count / total / avg /
-    min / max latency + output bytes, then scoped host timings, then the
-    memory summary, then any attached graph-analysis summaries."""
+    p50 / p95 / p99 latency + output bytes, then scoped host timings,
+    then the memory summary, then the serving section (``mx.serve``),
+    then any attached graph-analysis summaries."""
     lines = ['Profile Statistics:']
     if _op_stats:
         lines.append('Operator summary (imperative dispatch, synced '
                      'per call):')
         lines.append(f'{"Name":<32}{"Count":>8}{"Total(ms)":>12}'
-                     f'{"Avg(ms)":>10}{"Min(ms)":>10}{"Max(ms)":>10}'
-                     f'{"Out(MB)":>10}')
-        for name, (c, t, lo, hi, nb) in sorted(
+                     f'{"Avg(ms)":>10}{"p50(ms)":>10}{"p95(ms)":>10}'
+                     f'{"p99(ms)":>10}{"Out(MB)":>10}')
+        for name, (c, t, _lo, _hi, nb, samples) in sorted(
                 _op_stats.items(), key=lambda kv: -kv[1][1]):
+            pct = percentiles(samples)
             lines.append(f'{name:<32}{c:>8}{t * 1e3:>12.3f}'
-                         f'{t / c * 1e3:>10.3f}{lo * 1e3:>10.3f}'
-                         f'{hi * 1e3:>10.3f}{nb / 1e6:>10.2f}')
+                         f'{t / c * 1e3:>10.3f}{pct[50] * 1e3:>10.3f}'
+                         f'{pct[95] * 1e3:>10.3f}{pct[99] * 1e3:>10.3f}'
+                         f'{nb / 1e6:>10.2f}')
     agg = {}
     for name, dt in _records:
         c, t = agg.get(name, (0, 0.0))
@@ -144,6 +183,29 @@ def dumps(reset=False):
     if _config['memory'] and _mem_stats['peak_live_bytes']:
         lines.append(f'Peak live device memory: '
                      f'{_mem_stats["peak_live_bytes"] / 1e6:.2f} MB')
+    if _serving:
+        lines.append('Serving (mx.serve):')
+        for name, provider in sorted(_serving.items()):
+            try:
+                snap = provider()
+            except Exception:    # a closed/broken server must not kill dumps
+                continue
+            lines.append(
+                f'  {name}: requests={snap.get("requests", 0)} '
+                f'completed={snap.get("completed", 0)} '
+                f'shed={snap.get("shed", 0)} '
+                f'expired={snap.get("expired", 0)} '
+                f'batches={snap.get("batches", 0)} '
+                f'occupancy={snap.get("occupancy_avg", 0.0):.2f}')
+            lat = snap.get('latency_ms', {})
+            qt = snap.get('queue_ms', {})
+            if lat or qt:
+                lines.append(
+                    f'    latency_ms p50/p95/p99: '
+                    f'{lat.get(50, 0.0):.3f}/{lat.get(95, 0.0):.3f}/'
+                    f'{lat.get(99, 0.0):.3f}   queue_ms p50/p95/p99: '
+                    f'{qt.get(50, 0.0):.3f}/{qt.get(95, 0.0):.3f}/'
+                    f'{qt.get(99, 0.0):.3f}')
     if _analysis_reports:
         lines.append('Graph analysis (mx.analysis):')
         for name, report in sorted(_analysis_reports.items()):

@@ -1,0 +1,39 @@
+"""``mx.serve`` — dynamic-batching inference serving runtime.
+
+The training stack's whole design (hybridize → one XLA executable,
+static shapes, bucketed retracing) is exactly what a serving system
+needs, so this package is thin: a model registry that lints and
+pre-warms (:class:`ModelRunner`), a coalescing request batcher over
+bucketed shapes (:class:`DynamicBatcher`), a continuous-batching decode
+loop for generate workloads (:class:`DecodeServer`), typed admission
+control (:class:`ServerOverloaded` & friends) and serving metrics that
+surface in ``mx.profiler.dumps()``'s Serving section and
+:func:`stats`.
+
+Environment knobs: ``MXNET_SERVE_BUCKETS``, ``MXNET_SERVE_MAX_WAIT_US``,
+``MXNET_SERVE_QUEUE_DEPTH``, ``MXNET_SERVE_DEADLINE_MS``,
+``MXNET_SERVE_FAULT_SPEC`` (docs/env_vars.md; the design doc is
+docs/serving.md).
+"""
+
+from .errors import ServeError, ServerOverloaded, DeadlineExceeded, \
+    ServerClosed
+from .buckets import parse_buckets, pick_bucket, pow2_bucket, \
+    default_buckets
+from .runner import ModelRunner
+from .batcher import DynamicBatcher
+from .decode import DecodeServer
+from .metrics import ServingMetrics, registry as _registry
+from . import faults
+
+__all__ = ['ModelRunner', 'DynamicBatcher', 'DecodeServer',
+           'ServingMetrics', 'ServeError', 'ServerOverloaded',
+           'DeadlineExceeded', 'ServerClosed', 'parse_buckets',
+           'pick_bucket', 'pow2_bucket', 'default_buckets', 'faults',
+           'stats']
+
+
+def stats():
+    """Snapshot of every live server's metrics: name -> stats dict
+    (the same payload the profiler's Serving section renders)."""
+    return {name: m.snapshot() for name, m in _registry().items()}

@@ -1,0 +1,314 @@
+"""Dynamic request batcher: bounded admission queue + coalescing
+scheduler.
+
+Clipper-style adaptive batching over bucketed static shapes: client
+threads ``submit()`` single examples into a bounded queue; one
+scheduler thread coalesces whatever arrived inside the batching window
+(``max_wait_us``) — or as soon as ``max_batch`` requests are waiting —
+into one padded dispatch through the :class:`ModelRunner`. Padding to a
+pre-warmed bucket keeps the compiled-graph cache key stable, so after
+warmup the XLA compile counter stays flat no matter how request sizes
+mix (``recompiles`` in :meth:`stats` machine-checks it).
+
+Admission control:
+
+* queue at ``queue_depth`` → the request is shed at submit with
+  :class:`ServerOverloaded` (clients back off; the queue never grows
+  without bound);
+* a request whose deadline expires while queued is aborted with
+  :class:`DeadlineExceeded` BEFORE any device dispatch — expiry is
+  checked when the batch is cut, so a stalled scheduler never burns
+  device time on answers nobody is waiting for;
+* ``close(drain=True)`` stops admission and flushes the queue;
+  ``close(drain=False)`` rejects everything still queued with
+  :class:`ServerClosed`.
+
+Locking (declared in ``analysis/locks.py``): ``_cv`` is the single
+``serve.queue`` condition — OUTERMOST in the hierarchy because the
+scheduler releases it before touching the model; no lock is ever held
+across a dispatch. Tests drive :meth:`run_once` directly with a fake
+``clock`` for fully deterministic coalescing/expiry scenarios — the
+scheduler thread runs the exact same code path.
+"""
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+from ..analysis import race as _race
+from . import faults as _faults
+from .errors import DeadlineExceeded, ServerClosed, ServerOverloaded
+from .metrics import ServingMetrics, register as _register, \
+    unregister as _unregister
+
+__all__ = ['DynamicBatcher', 'Request']
+
+_DEF_QUEUE_DEPTH = 256
+_DEF_MAX_WAIT_US = 2000
+
+
+def _env_int(name, default):
+    import os
+    v = os.environ.get(name, '')
+    return int(v) if v.strip() else default
+
+
+def _env_float(name, default):
+    import os
+    v = os.environ.get(name, '')
+    return float(v) if v.strip() else default
+
+
+class Request:
+    """One queued example: payload + completion future + timing."""
+
+    __slots__ = ('payload', 'future', 'submit_t', 'deadline')
+
+    def __init__(self, payload, submit_t, deadline):
+        self.payload = payload
+        self.future = Future()
+        self.submit_t = submit_t
+        self.deadline = deadline        # absolute clock time or None
+
+
+class DynamicBatcher:
+    """Coalesce single-example submissions into bucketed batches.
+
+    Parameters
+    ----------
+    runner : ModelRunner
+        The registered (linted + pre-warmed) model.
+    max_batch : int, optional
+        Cap on rows per dispatch (default: the runner's largest
+        bucket; larger queues are split across dispatches).
+    max_wait_us : int, optional
+        Batching window in microseconds (``MXNET_SERVE_MAX_WAIT_US``,
+        default 2000): how long the first queued request waits for
+        company before the batch is cut.
+    queue_depth : int, optional
+        Admission bound (``MXNET_SERVE_QUEUE_DEPTH``, default 256).
+    deadline_ms : float, optional
+        Default per-request deadline (``MXNET_SERVE_DEADLINE_MS``,
+        unset = no deadline); ``submit(deadline_ms=...)`` overrides.
+    clock : callable
+        Monotonic time source (tests inject a fake clock).
+    start : bool
+        Start the scheduler thread (False for deterministic tests that
+        call :meth:`run_once` themselves).
+    """
+
+    def __init__(self, runner, max_batch=None, max_wait_us=None,
+                 queue_depth=None, deadline_ms=None,
+                 clock=time.monotonic, name=None, start=True):
+        self.runner = runner
+        self.max_batch = min(max_batch or runner.max_batch,
+                             runner.max_batch)
+        if max_wait_us is None:
+            max_wait_us = _env_int('MXNET_SERVE_MAX_WAIT_US',
+                                   _DEF_MAX_WAIT_US)
+        self.max_wait = max_wait_us / 1e6
+        self.queue_depth = queue_depth if queue_depth is not None \
+            else _env_int('MXNET_SERVE_QUEUE_DEPTH', _DEF_QUEUE_DEPTH)
+        if deadline_ms is None:
+            deadline_ms = _env_float('MXNET_SERVE_DEADLINE_MS', 0.0)
+        self.default_deadline = (deadline_ms / 1e3) or None
+        self._clock = clock
+        self.name = name or f'batcher:{runner.name}'
+
+        # serve.queue — outermost: released before every model dispatch
+        self._cv = _race.tracked_condition(threading.Condition(),
+                                           'serve.queue')
+        self._queue = deque()
+        self._queue_state = _race.shared_state(
+            f'{self.name}._queue', guard='serve.queue')
+        self._draining = False
+        self._closed = False
+
+        self.metrics = ServingMetrics(self.name)
+        self._metrics_name = _register(self.name, self.metrics)
+        self.compile_baseline = runner.compile_count
+
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._scheduler_loop, daemon=True,
+                name=f'{self.name}-sched')
+            self._thread.start()
+
+    # --------------------------------------------------------- admission
+    def submit(self, payload, deadline_ms=None):
+        """Enqueue one example; returns a Future resolving to its
+        (already unpadded) output row. Sheds with ServerOverloaded at
+        capacity, ServerClosed once draining/closed."""
+        now = self._clock()
+        if deadline_ms is None:
+            dl = now + self.default_deadline if self.default_deadline \
+                else None
+        else:
+            dl = now + deadline_ms / 1e3
+        req = Request(payload, now, dl)
+        with self._cv:
+            if self._closed or self._draining:
+                raise ServerClosed(f'{self.name} is not accepting work')
+            if len(self._queue) >= self.queue_depth:
+                self.metrics.on_shed()
+                raise ServerOverloaded(
+                    f'{self.name} queue at capacity '
+                    f'({self.queue_depth}); request shed')
+            self._queue_state.write()
+            self._queue.append(req)
+            self.metrics.on_submit()
+            self._cv.notify()
+        return req.future
+
+    def submit_sync(self, payload, deadline_ms=None, timeout=None):
+        """submit() + block for the result."""
+        return self.submit(payload, deadline_ms).result(timeout)
+
+    # --------------------------------------------------------- scheduling
+    @_race.guarded_by('_cv')
+    def _cut_batch(self, now):
+        """Pop one dispatchable batch, expiring dead requests first.
+        Returns (batch, expired) — called with the queue lock held."""
+        expired = []
+        while self._queue and self._queue[0].deadline is not None \
+                and self._queue[0].deadline <= now:
+            self._queue_state.write()
+            expired.append(self._queue.popleft())
+        batch = []
+        while self._queue and len(batch) < self.max_batch:
+            req = self._queue[0]
+            if req.deadline is not None and req.deadline <= now:
+                self._queue_state.write()
+                expired.append(self._queue.popleft())
+                continue
+            self._queue_state.write()
+            batch.append(self._queue.popleft())
+        return batch, expired
+
+    def run_once(self, block=True, timeout=0.1):
+        """One scheduler iteration: honor the batching window, cut a
+        batch, dispatch it. Returns the number of requests resolved
+        (completed + expired); 0 when idle or (non-blocking) while the
+        window is still open.
+
+        ``block=False`` never sleeps — tests drive this directly with a
+        fake clock for deterministic coalescing and expiry scenarios.
+        """
+        with self._cv:
+            if block:
+                self._cv.wait_for(
+                    lambda: self._queue or self._closed, timeout)
+            if not self._queue:
+                return 0
+            # batching window: the OLDEST request waits at most
+            # max_wait for company; full batch or drain cuts it early
+            while (len(self._queue) < self.max_batch
+                    and not self._draining and not self._closed):
+                remaining = (self._queue[0].submit_t + self.max_wait
+                             - self._clock())
+                if remaining <= 0:
+                    break
+                if not block:
+                    return 0            # window open: nothing to do yet
+                self._cv.wait(remaining)
+                if not self._queue:
+                    return 0
+            batch, expired = self._cut_batch(self._clock())
+        # ---- lock released: everything below may block on the device
+        for req in expired:
+            self.metrics.on_expired()
+            self._fail(req, DeadlineExceeded(
+                'deadline expired in queue; aborted before dispatch'))
+        if not batch:
+            return len(expired)
+        try:
+            _faults.on('dispatch')
+            rows, n_pad = self.runner.run_batch(
+                [r.payload for r in batch])
+        except Exception as e:               # noqa: BLE001 — fail the batch
+            for req in batch:
+                self.metrics.on_failed()
+                self._fail(req, e)
+            return len(batch) + len(expired)
+        now = self._clock()
+        self.metrics.on_dispatch(
+            len(batch), n_pad, [now - r.submit_t for r in batch])
+        if self.runner.compile_count != self.compile_baseline:
+            self.metrics.on_recompile(
+                self.runner.compile_count - self.compile_baseline)
+            self.compile_baseline = self.runner.compile_count
+        for req, row in zip(batch, rows):
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(row)
+            self.metrics.on_complete(self._clock() - req.submit_t)
+        return len(batch) + len(expired)
+
+    @staticmethod
+    def _fail(req, exc):
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(exc)
+
+    def _scheduler_loop(self):
+        while True:
+            self.run_once(block=True)
+            with self._cv:
+                if self._closed and not self._queue:
+                    return
+                if self._draining and not self._queue:
+                    self._closed = True
+                    self._cv.notify_all()
+                    return
+
+    # ------------------------------------------------------------- close
+    def close(self, drain=True, timeout=10.0):
+        """Stop admission. ``drain=True`` flushes queued work first;
+        ``drain=False`` rejects it with ServerClosed immediately."""
+        with self._cv:
+            if self._closed:
+                return
+            self._draining = True
+            if not drain:
+                while self._queue:
+                    self._queue_state.write()
+                    req = self._queue.popleft()
+                    self._fail(req, ServerClosed(
+                        f'{self.name} closed without drain'))
+                self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        else:
+            # deterministic mode: the caller owns the loop — flush here
+            while drain and self.run_once(block=False):
+                pass
+            with self._cv:
+                self._closed = True
+        _unregister(self._metrics_name)
+
+    @property
+    def closed(self):
+        with self._cv:
+            return self._closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=exc[0] is None)
+        return False
+
+    # ------------------------------------------------------------- stats
+    def stats(self):
+        """Metrics snapshot plus the zero-recompile check's inputs."""
+        out = self.metrics.snapshot()
+        out['compile_count'] = self.runner.compile_count
+        with self._cv:
+            out['queued'] = len(self._queue)
+        return out
+
+    def __repr__(self):
+        return (f'<DynamicBatcher {self.name!r} max_batch={self.max_batch} '
+                f'window={self.max_wait * 1e6:.0f}us '
+                f'depth={self.queue_depth}>')

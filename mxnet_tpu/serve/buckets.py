@@ -1,0 +1,59 @@
+"""Shape-bucket policy for the serving runtime.
+
+XLA compiles one executable per input shape, so a server admitting
+arbitrary batch sizes would compile arbitrarily many programs — the
+recompile stall (seconds) is the single worst serving-latency event.
+The fix is the standard bucketed-shape discipline: declare a small set
+of batch buckets up front (``MXNET_SERVE_BUCKETS``), pre-warm an
+executable per bucket at registration, then pad every dispatched batch
+up to the smallest covering bucket and slice the pad rows off the
+result. After warmup the compile counter must stay flat — the batcher
+asserts it (see docs/serving.md for sizing guidance).
+"""
+
+import os
+
+__all__ = ['parse_buckets', 'pick_bucket', 'pow2_bucket',
+           'default_buckets']
+
+_DEFAULT = '1,2,4,8'
+
+
+def parse_buckets(spec):
+    """Parse ``"1,2,4,8"`` into a sorted, deduplicated tuple of ints."""
+    try:
+        vals = sorted({int(tok) for tok in str(spec).split(',')
+                       if tok.strip()})
+    except ValueError:
+        raise ValueError(
+            f'bad bucket spec {spec!r}: want comma-separated ints, '
+            f'e.g. "1,2,4,8" (MXNET_SERVE_BUCKETS)') from None
+    if not vals or vals[0] < 1:
+        raise ValueError(f'bad bucket spec {spec!r}: buckets must be >= 1')
+    return tuple(vals)
+
+
+def default_buckets():
+    """Buckets from ``MXNET_SERVE_BUCKETS`` (default ``1,2,4,8``)."""
+    return parse_buckets(os.environ.get('MXNET_SERVE_BUCKETS', _DEFAULT))
+
+
+def pick_bucket(n, buckets):
+    """Smallest bucket >= n, or None when n exceeds every bucket (the
+    caller then splits the batch at the largest bucket)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return None
+
+
+def pow2_bucket(n, lo=1, hi=None):
+    """Round n up to a power of two in [lo, hi] — prompt-length buckets
+    for the decode server (same trick ``generate()`` uses for its scan
+    length)."""
+    b = max(lo, 1)
+    while b < n:
+        b *= 2
+    if hi is not None:
+        b = min(b, hi)
+    return b

@@ -1,0 +1,457 @@
+"""Continuous-batching decode server for causal-LM generate traffic.
+
+Orca-style iteration-level scheduling: instead of batching whole
+``generate()`` calls (where one long sequence holds the batch hostage),
+the server owns a fixed pool of KV-cache *slots* and re-forms the batch
+at every decode step — a finished sequence frees its slot and a queued
+prompt takes it over between steps, so a late-arriving request joins
+the RUNNING batch without waiting for the current one to finish.
+
+Static shapes throughout, so nothing ever retraces after warmup:
+
+* ONE compiled step function over the full pool ``(slots, 1)`` with a
+  per-row offset vector — each slot decodes at its own depth (the
+  per-slot path in ``LlamaAttention.forward``); inactive rows compute
+  garbage that is never read;
+* one compiled prefill per power-of-two prompt bucket — prompts are
+  padded up, the slot index and true length enter as traced scalars
+  (``lax.dynamic_slice`` carves the slot's cache row out of the pool,
+  the forward fills it, ``dynamic_update_slice`` puts it back);
+* pad/garbage safety is positional: row ``b`` only ever attends to
+  cache positions ``<= offset[b]``, and every such position was written
+  by the CURRENT occupant (prefill covers ``0..alen``, each step writes
+  its offset before attending) — residue from retired sequences or
+  warmup sits strictly above the mask.
+
+Compile counting is a trace-time side effect (the counter bump inside
+the jitted bodies only runs when XLA actually retraces), so
+``stats()['recompiles']`` machine-checks the zero-recompile guarantee
+the same way the batcher does.
+
+Locking: ``_cv`` (``serve.queue``) guards admission, ``_slot_lock``
+(``serve.slots``, taken inside the queue lock, never across a compiled
+step) guards the slot table; the cache pool itself is touched only by
+the scheduler thread.
+"""
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from functools import partial
+
+from ..analysis import race as _race
+from . import faults as _faults
+from .buckets import pick_bucket, pow2_bucket
+from ..gluon.parameter import DeferredInitializationError
+from .errors import DeadlineExceeded, ServeError, ServerClosed, \
+    ServerOverloaded
+from .metrics import ServingMetrics, register as _register, \
+    unregister as _unregister
+
+__all__ = ['DecodeServer']
+
+_MIN_PROMPT_BUCKET = 8
+
+
+class _Seq:
+    """One live sequence: its slot, depth, and remaining budget."""
+
+    __slots__ = ('request', 'slot', 'offset', 'remaining', 'tokens')
+
+    def __init__(self, request, slot, offset, remaining):
+        self.request = request
+        self.slot = slot
+        self.offset = offset        # next cache write position
+        self.remaining = remaining
+        self.tokens = []            # generated token ids (host ints)
+
+
+class _DecodeRequest:
+    __slots__ = ('prompt', 'max_new', 'future', 'submit_t', 'deadline')
+
+    def __init__(self, prompt, max_new, submit_t, deadline):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.future = Future()
+        self.submit_t = submit_t
+        self.deadline = deadline
+
+
+class DecodeServer:
+    """Slot-pooled continuous batching over a ``LlamaForCausalLM``.
+
+    Parameters
+    ----------
+    net : LlamaForCausalLM
+        Initialized model (params materialized — run one forward first).
+    slots : int
+        KV-cache pool size == the decode batch shape (default 4).
+    max_length : int, optional
+        Per-slot cache length (default ``net.cfg.max_length``).
+    prompt_buckets : tuple[int], optional
+        Power-of-two prompt-length buckets to pre-compile (default: the
+        full ladder 8, 16, ... up to ``max_length``).
+    queue_depth, deadline_ms, clock, start
+        As in :class:`DynamicBatcher`.
+    warmup : bool
+        Pre-compile the step fn and every prompt bucket at construction
+        (default True — required for the zero-recompile guarantee).
+    """
+
+    def __init__(self, net, slots=4, max_length=None, prompt_buckets=None,
+                 queue_depth=None, deadline_ms=None, clock=time.monotonic,
+                 name=None, start=True, warmup=True):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        self.net = net
+        self.slots = int(slots)
+        self.max_length = int(max_length or net.cfg.max_length)
+        if prompt_buckets is None:
+            ladder, b = [], min(_MIN_PROMPT_BUCKET, self.max_length)
+            while b < self.max_length:
+                ladder.append(b)
+                b *= 2
+            prompt_buckets = tuple(ladder) or (self.max_length,)
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        if self.prompt_buckets[-1] > self.max_length:
+            raise ServeError(
+                f'prompt bucket {self.prompt_buckets[-1]} exceeds '
+                f'max_length {self.max_length}')
+        import os
+        self.queue_depth = queue_depth if queue_depth is not None else \
+            int(os.environ.get('MXNET_SERVE_QUEUE_DEPTH', '') or 256)
+        if deadline_ms is None:
+            deadline_ms = float(
+                os.environ.get('MXNET_SERVE_DEADLINE_MS', '') or 0.0)
+        self.default_deadline = (deadline_ms / 1e3) or None
+        self._clock = clock
+        self.name = name or f'decode:{type(net).__name__}'
+
+        self._cv = _race.tracked_condition(threading.Condition(),
+                                           'serve.queue')
+        self._queue = deque()
+        self._queue_state = _race.shared_state(
+            f'{self.name}._queue', guard='serve.queue')
+        self._slot_lock = _race.tracked(threading.Lock(), 'serve.slots')
+        self._table = [None] * self.slots      # slot -> _Seq | None
+        self._table_state = _race.shared_state(
+            f'{self.name}._table', guard='serve.slots')
+        self._draining = False
+        self._closed = False
+
+        self.metrics = ServingMetrics(self.name)
+        self._metrics_name = _register(self.name, self.metrics)
+        self._compiles = 0          # bumped at TRACE time only
+
+        try:
+            run, self._praws = net._param_run()
+        except DeferredInitializationError:
+            # deferred-shape params materialize on the first forward —
+            # the server owns warmup, so trigger one here
+            import numpy as _host_np
+            from .. import _tape
+            from ..ndarray.ndarray import array
+            prev = _tape.set_recording(False)
+            try:
+                net(array(_host_np.zeros((1, 1), dtype='int32')))
+            finally:
+                _tape.set_recording(prev)
+            run, self._praws = net._param_run()
+        self._pool = net.init_caches(self.slots, self.max_length)
+        self._offsets = [0] * self.slots
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def step(praws, toks, pool, offsets):
+            self._compiles += 1     # trace-time side effect
+            logits, pool = run(praws, toks[:, None], pool, offsets)
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            return nxt, pool
+
+        self._step = step
+
+        def make_prefill(plen):
+            @partial(jax.jit, donate_argnums=(2,))
+            def prefill(praws, tok, pool, slot, alen):
+                self._compiles += 1
+                row = [(lax.dynamic_slice(k, (slot, 0, 0, 0),
+                                          (1,) + k.shape[1:]),
+                        lax.dynamic_slice(v, (slot, 0, 0, 0),
+                                          (1,) + v.shape[1:]))
+                       for k, v in pool]
+                logits, row = run(praws, tok, row, 0)
+                pool = [(lax.dynamic_update_slice(pk, rk, (slot, 0, 0, 0)),
+                         lax.dynamic_update_slice(pv, rv, (slot, 0, 0, 0)))
+                        for (pk, pv), (rk, rv) in zip(pool, row)]
+                nxt = jnp.argmax(
+                    logits[0, alen - 1].astype(jnp.float32)).astype(
+                        jnp.int32)
+                return nxt, pool
+            return prefill
+
+        self._prefills = {p: make_prefill(p) for p in self.prompt_buckets}
+
+        if warmup:
+            self.warmup_compiles = self._warmup()
+            self.compile_baseline = self._compiles
+        else:
+            self.warmup_compiles = 0
+            self.compile_baseline = None
+
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=f'{self.name}-sched')
+            self._thread.start()
+
+    # ------------------------------------------------------------ warmup
+    def _warmup(self):
+        """Trace every prefill bucket + the step fn against slot 0. The
+        garbage this writes into the pool sits above every live mask."""
+        import jax.numpy as jnp
+        before = self._compiles
+        zero = jnp.zeros((), jnp.int32)
+        for plen, fn in self._prefills.items():
+            tok = jnp.zeros((1, plen), jnp.int32)
+            _, self._pool = fn(self._praws, tok, self._pool, zero,
+                               jnp.asarray(1, jnp.int32))
+        toks = jnp.zeros((self.slots,), jnp.int32)
+        offs = jnp.zeros((self.slots,), jnp.int32)
+        _, self._pool = self._step(self._praws, toks, self._pool, offs)
+        return self._compiles - before
+
+    # --------------------------------------------------------- admission
+    def submit(self, prompt, max_new_tokens=32, deadline_ms=None):
+        """Queue one prompt (1-D int sequence); returns a Future
+        resolving to the list of generated token ids (greedy)."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ServeError('empty prompt')
+        if pick_bucket(len(prompt), self.prompt_buckets) is None:
+            raise ServeError(
+                f'prompt of {len(prompt)} tokens exceeds the largest '
+                f'prompt bucket {self.prompt_buckets[-1]}')
+        if len(prompt) + max_new_tokens > self.max_length:
+            raise ServeError(
+                f'prompt {len(prompt)} + max_new {max_new_tokens} '
+                f'exceeds the cache length {self.max_length}')
+        now = self._clock()
+        if deadline_ms is None:
+            dl = now + self.default_deadline if self.default_deadline \
+                else None
+        else:
+            dl = now + deadline_ms / 1e3
+        req = _DecodeRequest(prompt, max_new_tokens, now, dl)
+        with self._cv:
+            if self._closed or self._draining:
+                raise ServerClosed(f'{self.name} is not accepting work')
+            if len(self._queue) >= self.queue_depth:
+                self.metrics.on_shed()
+                raise ServerOverloaded(
+                    f'{self.name} queue at capacity '
+                    f'({self.queue_depth}); request shed')
+            self._queue_state.write()
+            self._queue.append(req)
+            self.metrics.on_submit()
+            self._cv.notify()
+        return req.future
+
+    def generate_sync(self, prompt, max_new_tokens=32, deadline_ms=None,
+                      timeout=None):
+        return self.submit(prompt, max_new_tokens,
+                           deadline_ms).result(timeout)
+
+    # -------------------------------------------------------- slot table
+    @_race.guarded_by('_slot_lock')
+    def _free_slots(self):
+        return [i for i, s in enumerate(self._table) if s is None]
+
+    @_race.guarded_by('_slot_lock')
+    def _set_slot(self, i, seq):
+        self._table_state.write()
+        self._table[i] = seq
+
+    # --------------------------------------------------------- the loop
+    def step_once(self):
+        """One scheduler iteration: expire, admit into free slots
+        (prefill), then one decode step over the pool. Returns the
+        number of sequences touched (admitted + stepped + expired) —
+        0 means fully idle. Deterministic: tests call this directly."""
+        import jax.numpy as jnp
+
+        now = self._clock()
+        admitted, expired = [], []
+        with self._cv:
+            while self._queue and self._queue[0].deadline is not None \
+                    and self._queue[0].deadline <= now:
+                self._queue_state.write()
+                expired.append(self._queue.popleft())
+            with self._slot_lock:
+                free = self._free_slots()
+                while self._queue and free:
+                    req = self._queue[0]
+                    if req.deadline is not None and req.deadline <= now:
+                        self._queue_state.write()
+                        expired.append(self._queue.popleft())
+                        continue
+                    self._queue_state.write()
+                    self._queue.popleft()
+                    slot = free.pop(0)
+                    # reserve before prefill so the next round cannot
+                    # double-assign; ready once offset is real
+                    seq = _Seq(req, slot, 0, req.max_new)
+                    self._set_slot(slot, seq)
+                    admitted.append(seq)
+        for req in expired:
+            self.metrics.on_expired()
+            self._fail(req, DeadlineExceeded(
+                'deadline expired in queue; aborted before prefill'))
+        # ---- locks released: device work below
+        for seq in admitted:
+            req = seq.request
+            try:
+                _faults.on('prefill')
+                alen = len(req.prompt)
+                plen = pick_bucket(alen, self.prompt_buckets)
+                tok = jnp.asarray(
+                    [req.prompt + [0] * (plen - alen)], jnp.int32)
+                nxt, self._pool = self._prefills[plen](
+                    self._praws, tok, self._pool,
+                    jnp.asarray(seq.slot, jnp.int32),
+                    jnp.asarray(alen, jnp.int32))
+            except Exception as e:           # noqa: BLE001
+                self.metrics.on_failed()
+                with self._slot_lock:
+                    self._set_slot(seq.slot, None)
+                self._fail(req, e)
+                continue
+            seq.offset = alen
+            seq.tokens.append(int(nxt))
+            seq.remaining -= 1
+            self.metrics.on_admit([self._clock() - req.submit_t])
+        with self._slot_lock:
+            live = [s for s in self._table if s is not None]
+        stepped = 0
+        if live:
+            alive = [s for s in live if s.remaining > 0]
+            if alive:
+                stepped = len(alive)
+                try:
+                    _faults.on('step')
+                    toks = [0] * self.slots
+                    offs = list(self._offsets)
+                    for s in alive:
+                        toks[s.slot] = s.tokens[-1]
+                        offs[s.slot] = s.offset
+                    nxt, self._pool = self._step(
+                        self._praws, jnp.asarray(toks, jnp.int32),
+                        self._pool, jnp.asarray(offs, jnp.int32))
+                    nxt = [int(t) for t in nxt]
+                except Exception as e:       # noqa: BLE001
+                    for s in live:
+                        self.metrics.on_failed()
+                        with self._slot_lock:
+                            self._set_slot(s.slot, None)
+                        self._fail(s.request, e)
+                    return len(admitted) + len(expired)
+                for s in alive:
+                    s.tokens.append(nxt[s.slot])
+                    s.offset += 1
+                    self._offsets[s.slot] = s.offset
+                    s.remaining -= 1
+                self.metrics.on_step(stepped)
+            for s in live:
+                if s.remaining <= 0:
+                    with self._slot_lock:
+                        self._set_slot(s.slot, None)   # slot freed
+                    if s.request.future.set_running_or_notify_cancel():
+                        s.request.future.set_result(list(s.tokens))
+                    self.metrics.on_complete(
+                        self._clock() - s.request.submit_t)
+        if self.compile_baseline is not None \
+                and self._compiles != self.compile_baseline:
+            self.metrics.on_recompile(
+                self._compiles - self.compile_baseline)
+            self.compile_baseline = self._compiles
+        return len(admitted) + stepped + len(expired)
+
+    @staticmethod
+    def _fail(req, exc):
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(exc)
+
+    def _loop(self):
+        while True:
+            n = self.step_once()
+            with self._cv:
+                if self._closed:
+                    return
+                busy = self._queue or any(
+                    s is not None for s in self._table)
+                if self._draining and not busy:
+                    self._closed = True
+                    self._cv.notify_all()
+                    return
+                if n == 0 and not busy:
+                    self._cv.wait(0.05)
+
+    # ------------------------------------------------------------- close
+    def close(self, drain=True, timeout=30.0):
+        """Stop admission; drain live sequences or reject everything."""
+        with self._cv:
+            if self._closed:
+                return
+            self._draining = True
+            if not drain:
+                while self._queue:
+                    self._queue_state.write()
+                    self._fail(self._queue.popleft(), ServerClosed(
+                        f'{self.name} closed without drain'))
+                with self._slot_lock:
+                    for i, s in enumerate(self._table):
+                        if s is not None:
+                            self._set_slot(i, None)
+                            self._fail(s.request, ServerClosed(
+                                f'{self.name} closed without drain'))
+                self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        else:
+            while drain and self.step_once():
+                pass
+            with self._cv:
+                self._closed = True
+        _unregister(self._metrics_name)
+
+    @property
+    def closed(self):
+        with self._cv:
+            return self._closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=exc[0] is None)
+        return False
+
+    # ------------------------------------------------------------- stats
+    def stats(self):
+        out = self.metrics.snapshot()
+        out['compile_count'] = self._compiles
+        with self._cv:
+            out['queued'] = len(self._queue)
+        with self._slot_lock:
+            out['active_slots'] = sum(
+                1 for s in self._table if s is not None)
+        out['slots'] = self.slots
+        return out
+
+    def __repr__(self):
+        return (f'<DecodeServer {self.name!r} slots={self.slots} '
+                f'max_length={self.max_length} '
+                f'prompt_buckets={self.prompt_buckets}>')

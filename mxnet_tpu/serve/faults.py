@@ -1,0 +1,165 @@
+"""Deterministic fault injection for the serving runtime.
+
+Admission control is only trustworthy if its failure paths can be
+driven on demand: a deadline that expires *in queue* needs the
+scheduler to stall at exactly the right moment, and a model error
+mid-batch must fail that batch's requests without wedging the server.
+Same philosophy as :mod:`mxnet_tpu.kvstore.faults`, scoped to the
+serving pipeline's stages instead of the wire.
+
+Spec grammar — ``MXNET_SERVE_FAULT_SPEC`` or :func:`configure`,
+semicolon-separated rules::
+
+    stall:STAGE:DUR       sleep DUR (``50ms``, ``0.2s``, bare seconds)
+                          when STAGE is reached. With a fake clock the
+                          injected ``sleep`` advances virtual time, so
+                          "the scheduler stalled 200ms mid-dispatch" is
+                          a deterministic test, not a sleep-and-hope.
+    error:STAGE[:N]       raise ``RuntimeError`` on the N-th hit of
+                          STAGE (default 1; fires once).
+    error_every:STAGE:N   same, every N-th hit (soak mode).
+
+``STAGE`` is one of the pipeline's hook points — ``dispatch`` (batch
+handed to the model), ``prefill`` (decode-server prompt prefill),
+``step`` (one continuous-batching decode step) — or ``*`` for any.
+"""
+
+import os
+import re
+import threading
+import time as _time
+
+__all__ = ['configure', 'clear', 'active', 'injected', 'on',
+           'FaultSpecError', 'STAGES']
+
+STAGES = ('dispatch', 'prefill', 'step')
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``MXNET_SERVE_FAULT_SPEC`` rule."""
+
+
+def _parse_duration(text):
+    m = re.fullmatch(r'(\d+(?:\.\d+)?)(ms|s)?', text)
+    if not m:
+        raise FaultSpecError(f'bad duration {text!r} (want e.g. 50ms, 0.2s)')
+    val = float(m.group(1))
+    return val / 1e3 if m.group(2) == 'ms' else val
+
+
+class _Rule:
+    def __init__(self, action, stage, **kw):
+        self.action = action
+        self.stage = stage
+        self.seen = 0
+        self.__dict__.update(kw)
+
+    def matches(self, stage):
+        return self.stage in ('*', stage)
+
+
+def _parse_rule(text):
+    parts = [p.strip() for p in text.split(':')]
+    action = parts[0]
+    if action == 'stall':
+        if len(parts) != 3:
+            raise FaultSpecError(f'stall rule {text!r}: want stall:STAGE:DUR')
+        return _Rule('stall', parts[1], duration=_parse_duration(parts[2]))
+    if action in ('error', 'error_every'):
+        if len(parts) == 2 and action == 'error':
+            stage, n = parts[1], 1
+        elif len(parts) == 3:
+            stage, n = parts[1], int(parts[2])
+        else:
+            raise FaultSpecError(
+                f'{action} rule {text!r}: want {action}:STAGE[:N]')
+        if n < 1:
+            raise FaultSpecError(f'{action} count must be >= 1, got {n}')
+        return _Rule(action, stage, n=n)
+    raise FaultSpecError(
+        f'unknown serve fault action {action!r} in rule {text!r} '
+        "(know: stall, error, error_every)")
+
+
+class FaultPlan:
+    """A parsed spec plus its injection counters."""
+
+    def __init__(self, spec, sleep=None):
+        self.spec = spec
+        self.rules = [_parse_rule(r) for r in spec.split(';') if r.strip()]
+        if not self.rules:
+            raise FaultSpecError(f'empty serve fault spec {spec!r}')
+        self.sleep = sleep or _time.sleep
+        self.counts = {'stall': 0, 'error': 0}
+        self._lock = threading.Lock()
+
+    def on(self, stage):
+        stall = 0.0
+        for rule in self.rules:
+            if not rule.matches(stage):
+                continue
+            if rule.action == 'stall':
+                with self._lock:
+                    self.counts['stall'] += 1
+                stall += rule.duration
+            else:
+                with self._lock:
+                    rule.seen += 1
+                    fire = (rule.seen == rule.n if rule.action == 'error'
+                            else rule.seen % rule.n == 0)
+                    if fire:
+                        self.counts['error'] += 1
+                if fire:
+                    if stall:
+                        self.sleep(stall)
+                    raise RuntimeError(
+                        f'fault-injected error at serve stage {stage!r}')
+        if stall:
+            self.sleep(stall)
+
+    def injected(self):
+        with self._lock:
+            out = dict(self.counts)
+        out['total'] = sum(out.values())
+        return out
+
+
+_PLAN = None
+
+
+def configure(spec=None, sleep=None):
+    """Install a fault plan from ``spec`` (or ``MXNET_SERVE_FAULT_SPEC``
+    when ``None``). ``sleep`` overrides the stall sleeper — tests pass a
+    fake clock's ``advance`` so stalls are virtual. An empty spec clears
+    the plan. Returns the active :class:`FaultPlan` or ``None``."""
+    global _PLAN
+    if spec is None:
+        spec = os.environ.get('MXNET_SERVE_FAULT_SPEC', '')
+    _PLAN = FaultPlan(spec, sleep=sleep) if spec.strip() else None
+    return _PLAN
+
+
+def clear():
+    """Remove any active fault plan."""
+    global _PLAN
+    _PLAN = None
+
+
+def active():
+    """The installed :class:`FaultPlan`, or ``None``."""
+    return _PLAN
+
+
+def injected():
+    """Injection counters of the active plan ({} when no plan)."""
+    return _PLAN.injected() if _PLAN is not None else {}
+
+
+def on(stage):
+    """Pipeline hook (may sleep or raise). Free when no plan is set."""
+    if _PLAN is not None:
+        _PLAN.on(stage)
+
+
+if os.environ.get('MXNET_SERVE_FAULT_SPEC'):
+    configure()

@@ -1,0 +1,163 @@
+"""Serving metrics: latency/queue-time percentiles, occupancy, shed and
+timeout counters.
+
+One :class:`ServingMetrics` per server, registered into
+``mx.profiler``'s Serving section (``profiler.dumps()``) and aggregated
+by :func:`mxnet_tpu.serve.stats`. Percentiles use the same nearest-rank
+estimator as the profiler's per-op table (``profiler.percentiles``) so
+the two surfaces always agree on what "p99" means.
+
+Thread-safety: counters are updated from the scheduler thread while
+``snapshot()`` is called from client threads / the profiler — everything
+mutable sits behind ``_lock`` (a leaf lock: nothing else is ever
+acquired while holding it, level ``misc.leaf`` in
+``analysis/locks.py``).
+"""
+
+import threading
+from collections import deque
+
+from .. import profiler
+from ..analysis import race as _race
+
+__all__ = ['ServingMetrics', 'registry', 'register', 'unregister']
+
+_SAMPLES = 2048
+
+# live servers: name -> ServingMetrics (module-level so serve.stats()
+# can aggregate without holding server references)
+_REGISTRY = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+class ServingMetrics:
+    """Bounded-memory serving counters for one server."""
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        if _race.enabled():
+            self._lock = _race.tracked(self._lock, 'misc.leaf')
+        self._latency_s = deque(maxlen=_SAMPLES)   # submit -> result
+        self._queue_s = deque(maxlen=_SAMPLES)     # submit -> dispatch
+        self._requests = 0
+        self._completed = 0
+        self._failed = 0
+        self._shed = 0
+        self._expired = 0
+        self._batches = 0
+        self._batched_rows = 0      # real rows across dispatched batches
+        self._padded_rows = 0       # pad rows burned to reach a bucket
+        self._steps = 0             # decode steps (continuous batching)
+        self._active_rows = 0       # active slots across decode steps
+        self._recompiles = 0        # compiles observed AFTER warmup
+
+    # ------------------------------------------------------------ events
+    def on_submit(self):
+        with self._lock:
+            self._requests += 1
+
+    def on_shed(self):
+        with self._lock:
+            self._shed += 1
+
+    def on_expired(self):
+        with self._lock:
+            self._expired += 1
+
+    def on_dispatch(self, n_real, n_pad, queue_times_s):
+        with self._lock:
+            self._batches += 1
+            self._batched_rows += n_real
+            self._padded_rows += n_pad
+            self._queue_s.extend(queue_times_s)
+
+    def on_admit(self, queue_times_s):
+        """Queue-time samples for slot-pool admission (decode server —
+        no per-batch dispatch event to hang them on)."""
+        with self._lock:
+            self._queue_s.extend(queue_times_s)
+
+    def on_step(self, n_active):
+        with self._lock:
+            self._steps += 1
+            self._active_rows += n_active
+
+    def on_complete(self, latency_s):
+        with self._lock:
+            self._completed += 1
+            self._latency_s.append(latency_s)
+
+    def on_failed(self):
+        with self._lock:
+            self._failed += 1
+
+    def on_recompile(self, n=1):
+        """A post-warmup XLA compile — the event the bucketed-shape
+        discipline exists to prevent; any nonzero count is a bug."""
+        with self._lock:
+            self._recompiles += n
+
+    # ---------------------------------------------------------- snapshot
+    def snapshot(self):
+        """Point-in-time stats dict (the ``serve.stats()`` payload and
+        the profiler Serving section's data source)."""
+        with self._lock:
+            lat = list(self._latency_s)
+            qt = list(self._queue_s)
+            batches = self._batches
+            rows = self._batched_rows
+            steps = self._steps
+            active = self._active_rows
+            out = {
+                'requests': self._requests,
+                'completed': self._completed,
+                'failed': self._failed,
+                'shed': self._shed,
+                'expired': self._expired,
+                'batches': batches,
+                'padded_rows': self._padded_rows,
+                'steps': steps,
+                'recompiles': self._recompiles,
+            }
+        # percentiles off-lock: sorting 2k samples under the leaf lock
+        # would stall the scheduler's counter updates
+        out['latency_ms'] = {q: v * 1e3 for q, v in
+                             profiler.percentiles(lat).items()}
+        out['queue_ms'] = {q: v * 1e3 for q, v in
+                           profiler.percentiles(qt).items()}
+        # occupancy: mean real rows per dispatched batch (batcher) or
+        # mean active slots per step (decode server)
+        if steps:
+            out['occupancy_avg'] = active / steps
+        elif batches:
+            out['occupancy_avg'] = rows / batches
+        else:
+            out['occupancy_avg'] = 0.0
+        return out
+
+
+def register(name, metrics):
+    """Register a server's metrics under a unique name (suffixing on
+    collision) and attach it to the profiler Serving section. Returns
+    the registered name."""
+    with _REGISTRY_LOCK:
+        base, n = name, 1
+        while name in _REGISTRY:
+            n += 1
+            name = f'{base}#{n}'
+        _REGISTRY[name] = metrics
+    profiler.attach_serving(name, metrics.snapshot)
+    return name
+
+
+def unregister(name):
+    with _REGISTRY_LOCK:
+        _REGISTRY.pop(name, None)
+    profiler.detach_serving(name)
+
+
+def registry():
+    """Snapshot of live server metrics: name -> ServingMetrics."""
+    with _REGISTRY_LOCK:
+        return dict(_REGISTRY)

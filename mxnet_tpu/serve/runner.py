@@ -1,0 +1,127 @@
+"""Model registry for the serving runtime.
+
+A :class:`ModelRunner` owns one hybridized model end-to-end for
+serving: it lints the traced graph at registration (rejecting models
+whose graphs carry error findings — a recompile-per-step model must
+never reach traffic), pre-warms one XLA executable per declared batch
+bucket, and dispatches padded batches with autograd recording off.
+
+The core guarantee is **zero compiles after warmup**: every dispatch
+pads its batch up to a pre-warmed bucket, so the ``_CachedGraph`` key
+``(shapes, train=False, ...)`` always hits a warmed entry. The
+``compile_count`` property (backed by the monotonic per-graph compile
+counter in ``gluon/block.py``) lets the batcher machine-check it.
+"""
+
+import numpy as _np
+
+from .. import analysis as _analysis
+from ..ndarray.ndarray import NDArray, array
+from .. import _tape
+from .buckets import default_buckets, pick_bucket
+from .errors import ServeError
+
+__all__ = ['ModelRunner']
+
+
+class ModelRunner:
+    """One registered model: lint, hybridize, prewarm, dispatch.
+
+    Parameters
+    ----------
+    net : HybridBlock
+        An initialized block. It is hybridized here
+        (``static_alloc=True``) if not already active.
+    example_shape : tuple
+        Per-example input shape WITHOUT the batch dimension, e.g.
+        ``(3, 224, 224)``; bucket ``b`` is warmed at
+        ``(b,) + example_shape``.
+    buckets : tuple[int], optional
+        Batch buckets (default ``MXNET_SERVE_BUCKETS`` / ``1,2,4,8``).
+    dtype : str
+        Input dtype for warmup and padding.
+    lint : bool
+        Run ``mx.analysis.lint`` on the inference graph at registration
+        and reject on error findings (default True).
+    name : str, optional
+        Display name (defaults to the block's class name).
+    """
+
+    def __init__(self, net, example_shape, buckets=None, dtype='float32',
+                 lint=True, name=None):
+        self.net = net
+        self.example_shape = tuple(example_shape)
+        self.buckets = tuple(sorted(buckets)) if buckets \
+            else default_buckets()
+        self.dtype = dtype
+        self.name = name or type(net).__name__
+        if not getattr(net, '_active', False):
+            net.hybridize(static_alloc=True)
+        if lint:
+            shape = (self.buckets[0],) + self.example_shape
+            report = _analysis.lint(net, shape, name=self.name)
+            if report.errors:
+                msgs = '; '.join(f.message for f in report.errors[:3])
+                raise ServeError(
+                    f'model {self.name!r} rejected at registration: '
+                    f'{len(report.errors)} graph lint error(s): {msgs}')
+            self.lint_report = report
+        else:
+            self.lint_report = None
+        self.warmup_compiles = self.prewarm()
+
+    # ------------------------------------------------------------ warmup
+    def prewarm(self):
+        """Compile one executable per bucket; returns compiles done."""
+        specs = [((b,) + self.example_shape, self.dtype)
+                 for b in self.buckets]
+        return self.net.prewarm(specs)
+
+    @property
+    def compile_count(self):
+        """Monotonic executable count for the model's subtree."""
+        return self.net.compile_count
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    # ---------------------------------------------------------- dispatch
+    def bucket_for(self, n):
+        """Smallest warmed bucket covering ``n`` rows (None if n too
+        big — the batcher then splits at ``max_batch``)."""
+        return pick_bucket(n, self.buckets)
+
+    def run_batch(self, rows):
+        """Run ``rows`` (list of per-example arrays, each
+        ``example_shape``) as one padded dispatch.
+
+        Returns the UNPADDED per-row outputs as a list of NDArrays —
+        pad rows are sliced off before anything reaches a caller.
+        """
+        n = len(rows)
+        bucket = self.bucket_for(n)
+        if bucket is None:
+            raise ServeError(
+                f'batch of {n} exceeds the largest bucket '
+                f'{self.max_batch} — the batcher must split first')
+        batch = _np.zeros((bucket,) + self.example_shape,
+                          dtype=_np.dtype(self.dtype))
+        for i, r in enumerate(rows):
+            r = r.asnumpy() if isinstance(r, NDArray) else _np.asarray(r)
+            if r.shape != self.example_shape:
+                raise ServeError(
+                    f'request shape {r.shape} != declared example shape '
+                    f'{self.example_shape} for model {self.name!r}')
+            batch[i] = r
+        prev = _tape.set_recording(False)
+        try:
+            out = self.net(array(batch))
+        finally:
+            _tape.set_recording(prev)
+        return [out[i] for i in range(n)], bucket - n
+
+    def __repr__(self):
+        return (f'<ModelRunner {self.name!r} buckets={self.buckets} '
+                f'example={self.example_shape} '
+                f'compiles={self.compile_count}>')

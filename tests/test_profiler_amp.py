@@ -28,8 +28,13 @@ def test_profiler_per_op_aggregate_table():
     assert lines, table
     count = int(lines[0].split()[1])
     assert count == 3
-    # columns: name count total avg min max out_mb
-    assert len(lines[0].split()) == 7
+    # columns: name count total avg p50 p95 p99 out_mb
+    assert len(lines[0].split()) == 8
+    assert 'p99(ms)' in table
+    # p50 <= p95 <= p99, all drawn from the recorded samples
+    _, _, _, avg, p50, p95, p99, _ = (float(v) if i else v for i, v in
+                                      enumerate(lines[0].split()))
+    assert p50 <= p95 <= p99
 
 
 def test_profiler_memory_summary():

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the ``mx.serve`` runtime.
+
+Two workloads, mirroring the two server types:
+
+* **resnet**: resnet18_v1 behind a :class:`DynamicBatcher` — an
+  open-loop arrival process (submissions at a fixed rate, independent
+  of completions, so queueing/shedding behaves like real traffic
+  rather than closed-loop self-throttling) measuring request
+  throughput, p50/p95/p99 latency, time-in-queue, batch occupancy and
+  the shed count.
+* **llama**: llama_tiny behind a :class:`DecodeServer` — continuous
+  batching over mixed prompt lengths, measuring generated tokens/s and
+  step occupancy.
+
+Both sections assert the serving core guarantee — ``recompiles == 0``
+after warmup — and the script exits nonzero if it is violated, so the
+bench doubles as an end-to-end check.
+
+Output: one JSON document (BENCH_* style — ``metric``/``value``/
+``unit`` plus the stats snapshot) written to ``--out`` (default
+``SERVE_r01.json``) and echoed as a single JSON line on stdout.
+
+Run:
+  python tools/serve_bench.py                 # full (SERVE_r01.json)
+  python tools/serve_bench.py --smoke         # tier-1 smoke (seconds)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _percentile_trim(stats):
+    """Keep the JSON lean: drop raw sample vectors, round latencies."""
+    out = dict(stats)
+    for key in ('latency_ms', 'queue_ms'):
+        if key in out:
+            out[key] = {str(q): round(v, 3) for q, v in out[key].items()}
+    if 'occupancy_avg' in out:
+        out['occupancy_avg'] = round(out['occupancy_avg'], 3)
+    return out
+
+
+def bench_resnet(args):
+    import numpy as onp
+    from mxnet_tpu import serve
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    net = resnet18_v1(classes=10)
+    net.initialize()
+    shape = (3, args.image_size, args.image_size)
+    t0 = time.perf_counter()
+    runner = serve.ModelRunner(net, shape, buckets=args.buckets,
+                               lint=False)
+    warm_s = time.perf_counter() - t0
+    batcher = serve.DynamicBatcher(
+        runner, max_wait_us=args.max_wait_us,
+        queue_depth=args.queue_depth, name='bench-resnet')
+
+    rng = onp.random.RandomState(0)
+    imgs = [rng.rand(*shape).astype('float32') for _ in range(8)]
+    futs, shed = [], 0
+    interval = 1.0 / args.rate
+    start = time.perf_counter()
+    for i in range(args.requests):           # open loop: fixed arrivals
+        target = start + i * interval
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        try:
+            futs.append(batcher.submit(imgs[i % len(imgs)]))
+        except serve.ServerOverloaded:
+            shed += 1
+    for f in futs:
+        f.result(120)
+    wall = time.perf_counter() - start
+    stats = batcher.stats()
+    batcher.close()
+    doc = {
+        'metric': f'resnet18_serve_batch{runner.max_batch}'
+                  f'_im{args.image_size}',
+        'value': round(len(futs) / wall, 2),
+        'unit': 'req/s',
+        'offered_rate': args.rate,
+        'requests': args.requests,
+        'warmup_s': round(warm_s, 2),
+        'wall_s': round(wall, 2),
+        'shed_at_submit': shed,
+    }
+    doc.update(_percentile_trim(stats))
+    return doc
+
+
+def bench_llama(args):
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve
+    from mxnet_tpu.gluon.model_zoo.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    net(mx.np.zeros((1, 2)))
+    t0 = time.perf_counter()
+    server = serve.DecodeServer(
+        net, slots=args.slots, max_length=args.max_length,
+        prompt_buckets=args.prompt_buckets, name='bench-llama')
+    warm_s = time.perf_counter() - t0
+
+    import random
+    rnd = random.Random(0)
+    futs = []
+    interval = 1.0 / args.rate
+    start = time.perf_counter()
+    for i in range(args.prompts):            # open loop
+        target = start + i * interval
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        plen = rnd.randint(2, args.prompt_buckets[-1])
+        prompt = [rnd.randrange(net.cfg.vocab_size) for _ in range(plen)]
+        futs.append(server.submit(prompt,
+                                  max_new_tokens=args.new_tokens))
+    toks = sum(len(f.result(300)) for f in futs)
+    wall = time.perf_counter() - start
+    stats = server.stats()
+    server.close()
+    doc = {
+        'metric': f'llama_tiny_continuous_decode_slots{args.slots}',
+        'value': round(toks / wall, 2),
+        'unit': 'tok/s',
+        'offered_rate': args.rate,
+        'prompts': args.prompts,
+        'new_tokens_each': args.new_tokens,
+        'warmup_s': round(warm_s, 2),
+        'wall_s': round(wall, 2),
+    }
+    doc.update(_percentile_trim(stats))
+    return doc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    ap.add_argument('--smoke', action='store_true',
+                    help='tiny config for the tier-1 CI smoke')
+    ap.add_argument('--out', default='SERVE_r01.json')
+    ap.add_argument('--rate', type=float, default=None,
+                    help='offered load, requests/s (open loop)')
+    ap.add_argument('--requests', type=int, default=None)
+    ap.add_argument('--cpu', action='store_true')
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+    if args.smoke:
+        args.image_size = 32
+        args.buckets = (1, 2)
+        args.requests = args.requests or 10
+        args.rate = args.rate or 200.0
+        args.max_wait_us = 2000
+        args.queue_depth = 64
+        args.slots = 2
+        args.max_length = 32
+        args.prompt_buckets = (8,)
+        args.prompts = 4
+        args.new_tokens = 4
+    else:
+        args.image_size = 64
+        args.buckets = (1, 2, 4, 8)
+        args.requests = args.requests or 200
+        args.rate = args.rate or 400.0
+        args.max_wait_us = 5000
+        args.queue_depth = 256
+        args.slots = 4
+        args.max_length = 128
+        args.prompt_buckets = (8, 16)
+        args.prompts = 24
+        args.new_tokens = 16
+
+    doc = {'config': 'smoke' if args.smoke else 'full',
+           'resnet': bench_resnet(args),
+           'llama': bench_llama(args)}
+    with open(args.out, 'w') as f:
+        json.dump(doc, f, indent=1)
+        f.write('\n')
+    print(json.dumps({
+        'resnet_req_s': doc['resnet']['value'],
+        'resnet_p99_ms': doc['resnet']['latency_ms'].get('99'),
+        'resnet_occupancy': doc['resnet']['occupancy_avg'],
+        'llama_tok_s': doc['llama']['value'],
+        'llama_occupancy': doc['llama']['occupancy_avg'],
+        'recompiles': doc['resnet']['recompiles']
+        + doc['llama']['recompiles'],
+        'out': args.out}))
+    if doc['resnet']['recompiles'] or doc['llama']['recompiles']:
+        print('FAIL: recompiles after warmup', file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
