@@ -61,6 +61,11 @@ LOCK_HIERARCHY = (
                     'drain/close flags; outermost — the scheduler thread '
                     'releases it before any model dispatch '
                     '(mxnet_tpu/serve/batcher.py, serve/decode.py)'),
+    ('serve.pages', 'PageAllocator._lock: the paged-KV free list, page '
+                    'refcounts and prefix cache; taken inside the queue '
+                    'lock while admitting and NEVER while holding the '
+                    'slot lock — page release on retire happens after '
+                    'the slot is freed (mxnet_tpu/serve/pages.py)'),
     ('serve.slots', 'DecodeServer._slot_lock: the KV-cache slot pool '
                     'table and per-slot sequence state; taken after the '
                     'queue lock when admitting, never across a compiled '
@@ -104,6 +109,7 @@ LOCK_SITES = {
     '*/kvstore/faults.py': {'_lock': 'misc.leaf'},
     '*/serve/batcher.py': {'_cv': 'serve.queue'},
     '*/serve/decode.py': {'_cv': 'serve.queue', '_slot_lock': 'serve.slots'},
+    '*/serve/pages.py': {'_lock': 'serve.pages'},
     '*/serve/metrics.py': {'_lock': 'misc.leaf'},
     '*/serve/faults.py': {'_lock': 'misc.leaf'},
     '*/profiler.py': {'_stats_lock': 'misc.leaf'},

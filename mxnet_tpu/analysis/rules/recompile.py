@@ -22,6 +22,17 @@ Shape-leak variant: an `iota`/`broadcast_in_dim` whose size came from a
 Python int that the user varies per call produces a different jaxpr per
 value — invisible from one trace, but the scalar-input check above
 catches the common carrier (the int arriving as an argument instead).
+
+Non-hazard worth stating, because it looks like one: **integer index
+inputs** (gather/scatter indices such as the decode server's int32
+block tables, per-row offset vectors, slot ids). These are traced
+VALUES — the jit cache keys on their shape/dtype only, so re-pointing
+a slot at different KV pages or changing a row's depth never retraces.
+The rule counts them in ``report.stats['traced_index_inputs']`` so a
+serving audit can assert its dynamic indices actually entered the
+graph as traced arrays (a block table demoted to a Python list would
+bake as a constant and show up missing here — and recompile per
+value).
 """
 
 from . import register_rule
@@ -31,10 +42,16 @@ SCALAR_CONST_MAX_ELEMS = 8      # "scalar-ish": 0-d or tiny captured array
 
 @register_rule('recompile-hazard')
 def run(graph, report, config):
+    traced_index_inputs = 0
     for arg in graph.args:
         if arg.kind == 'rng':
             continue
         aval = arg.aval
+        if aval.ndim >= 1 and 'int' in str(aval.dtype) and \
+                not getattr(aval, 'weak_type', False):
+            # typed integer array input: a traced index (block table,
+            # offset vector, ...) — values never key the jit cache
+            traced_index_inputs += 1
         if getattr(aval, 'weak_type', False) and aval.ndim == 0:
             report.add(
                 'recompile-hazard', 'warning',
@@ -59,3 +76,4 @@ def run(graph, report, config):
                 're-hybridize, and each distinct value then compiles a '
                 'new program',
                 shape=shape)
+    report.stats['traced_index_inputs'] = traced_index_inputs

@@ -119,7 +119,7 @@ class LlamaAttention(HybridBlock):
                                flatten=False)
         self.o_proj = nn.Dense(cfg.units, use_bias=False, flatten=False)
 
-    def forward(self, x, cache=None, offset=0):
+    def forward(self, x, cache=None, offset=0, pages=None):
         """cache: optional (k_cache, v_cache) raw arrays of shape
         (B, max_len, kv_heads, dh) for incremental decode — new K/V are
         written at ``offset`` (static-shape ``dynamic_update_slice``, the
@@ -130,7 +130,22 @@ class LlamaAttention(HybridBlock):
         ``mx.serve.DecodeServer``) each batch row is an independent cache
         slot at its own depth: S must be 1, the new K/V land at
         ``offset[b]`` per row (vectorized scatter) and row b's query
-        attends to cache positions ``<= offset[b]``."""
+        attends to cache positions ``<= offset[b]``.
+
+        When ``pages`` is given (paged KV, vLLM-style), ``cache`` is the
+        GLOBAL page pool ``(num_pages, page_size, kv_heads, dh)`` shared
+        by every sequence and ``pages`` is the int32 block table
+        ``(B, pages_per_seq)`` mapping row ``b``'s logical positions
+        onto pool pages — a traced VALUE, so re-pointing a slot at
+        different pages never retraces. Logical position ``p`` of row
+        ``b`` lives at ``pool[pages[b, p // page_size], p % page_size]``.
+        New K/V are scattered through the block table, then each row's
+        logical cache is gathered back for attention; the causal mask is
+        identical to the dense layout, so dead rows (block table full of
+        the garbage page) compute garbage nobody reads. Supports the
+        per-slot decode case (S == 1, ``offset`` is ``(B,)``) and the
+        chunked-prefill case (B == 1, ``offset`` a scalar: queries at
+        absolute positions ``offset + i``)."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -147,22 +162,49 @@ class LlamaAttention(HybridBlock):
 
         if cache is not None:
             k_cache, v_cache = cache
-            L = k_cache.shape[1]
-            if per_slot:
-                assert S == 1, 'per-slot offsets decode one token per step'
-                rows = jnp.arange(B)
-                k_cache = k_cache.at[rows, offset].set(
-                    k[:, 0].astype(k_cache.dtype))
-                v_cache = v_cache.at[rows, offset].set(
-                    v[:, 0].astype(v_cache.dtype))
+            if pages is not None:
+                psz = k_cache.shape[1]
+                L = pages.shape[1] * psz
+                if per_slot:
+                    assert S == 1, \
+                        'per-slot offsets decode one token per step'
+                    pid = pages[jnp.arange(B), offset // psz]      # (B,)
+                    k_cache = k_cache.at[pid, offset % psz].set(
+                        k[:, 0].astype(k_cache.dtype))
+                    v_cache = v_cache.at[pid, offset % psz].set(
+                        v[:, 0].astype(v_cache.dtype))
+                else:
+                    assert B == 1, 'chunked prefill fills one sequence'
+                    pos = jnp.asarray(offset, jnp.int32) + jnp.arange(S)
+                    pid = pages[0, pos // psz]                     # (S,)
+                    k_cache = k_cache.at[pid, pos % psz].set(
+                        k[0].astype(k_cache.dtype))
+                    v_cache = v_cache.at[pid, pos % psz].set(
+                        v[0].astype(v_cache.dtype))
+                # gather each row's logical cache out of the pool
+                kf = k_cache[pages].reshape(B, L, self._kv, self._dh)
+                vf = v_cache[pages].reshape(B, L, self._kv, self._dh)
             else:
-                k_cache = lax.dynamic_update_slice(
-                    k_cache, k.astype(k_cache.dtype), (0, offset, 0, 0))
-                v_cache = lax.dynamic_update_slice(
-                    v_cache, v.astype(v_cache.dtype), (0, offset, 0, 0))
+                L = k_cache.shape[1]
+                if per_slot:
+                    assert S == 1, \
+                        'per-slot offsets decode one token per step'
+                    rows = jnp.arange(B)
+                    k_cache = k_cache.at[rows, offset].set(
+                        k[:, 0].astype(k_cache.dtype))
+                    v_cache = v_cache.at[rows, offset].set(
+                        v[:, 0].astype(v_cache.dtype))
+                else:
+                    k_cache = lax.dynamic_update_slice(
+                        k_cache, k.astype(k_cache.dtype),
+                        (0, offset, 0, 0))
+                    v_cache = lax.dynamic_update_slice(
+                        v_cache, v.astype(v_cache.dtype),
+                        (0, offset, 0, 0))
+                kf, vf = k_cache, v_cache
             rep = self._h // self._kv
-            kf = jnp.repeat(k_cache, rep, 2) if rep > 1 else k_cache
-            vf = jnp.repeat(v_cache, rep, 2) if rep > 1 else v_cache
+            kf = jnp.repeat(kf, rep, 2) if rep > 1 else kf
+            vf = jnp.repeat(vf, rep, 2) if rep > 1 else vf
             scores = jnp.einsum(
                 'bshd,blhd->bhsl', q.astype(jnp.float32),
                 kf.astype(jnp.float32)) * (self._dh ** -0.5)
@@ -219,12 +261,12 @@ class LlamaBlock(HybridBlock):
         self.post_attention_layernorm = RMSNorm(cfg.units, cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cache=None, offset=0):
+    def forward(self, x, cache=None, offset=0, pages=None):
         if cache is None:
             x = x + self.self_attn(self.input_layernorm(x))
             return x + self.mlp(self.post_attention_layernorm(x))
         att, cache = self.self_attn(self.input_layernorm(x), cache=cache,
-                                    offset=offset)
+                                    offset=offset, pages=pages)
         x = x + att
         return x + self.mlp(self.post_attention_layernorm(x)), cache
 
@@ -243,7 +285,7 @@ class LlamaModel(HybridBlock):
             self.layers.append(blk)
         self.norm = RMSNorm(cfg.units, cfg.rms_norm_eps)
 
-    def forward(self, token_ids, caches=None, offset=0):
+    def forward(self, token_ids, caches=None, offset=0, pages=None):
         x = self.embed_tokens(token_ids)
         if caches is None:
             for blk in self.layers:
@@ -251,7 +293,7 @@ class LlamaModel(HybridBlock):
             return self.norm(x)
         new_caches = []
         for blk, cache in zip(self.layers, caches):
-            x, cache = blk(x, cache=cache, offset=offset)
+            x, cache = blk(x, cache=cache, offset=offset, pages=pages)
             new_caches.append(cache)
         return self.norm(x), new_caches
 
@@ -267,12 +309,13 @@ class LlamaForCausalLM(HybridBlock):
             self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
                                     flatten=False)
 
-    def forward(self, token_ids, caches=None, offset=0):
+    def forward(self, token_ids, caches=None, offset=0, pages=None):
         from ... import np as mnp
         if caches is None:
             h = self.model(token_ids)
         else:
-            h, caches = self.model(token_ids, caches=caches, offset=offset)
+            h, caches = self.model(token_ids, caches=caches, offset=offset,
+                                   pages=pages)
         if self.cfg.tie_word_embeddings:
             emb = self.model.embed_tokens.weight.data()
             logits = mnp.matmul(h, emb.T)
@@ -299,6 +342,21 @@ class LlamaForCausalLM(HybridBlock):
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_layers)]
 
+    def init_paged_pool(self, num_pages, page_size, dtype='float32'):
+        """Allocate the paged-KV pool: per layer, (k, v) arrays of shape
+        ``(num_pages, page_size, kv_heads, dh)``. Unlike
+        :meth:`init_caches` there is no batch dimension — every
+        sequence's cache is a set of pages it names through its block
+        table (``forward(..., pages=...)``), so pool bytes are a memory
+        budget decoupled from both the decode batch shape and any
+        per-sequence ``max_length`` reservation."""
+        import jax.numpy as jnp
+        cfg = self.cfg
+        dh = cfg.units // cfg.num_heads
+        shape = (num_pages, page_size, cfg.num_kv_heads, dh)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_layers)]
+
     def _param_run(self):
         """The decode-step closure shared by :meth:`generate` and
         ``mx.serve.DecodeServer``: a pure ``run(praws, tok_raw, caches,
@@ -311,7 +369,7 @@ class LlamaForCausalLM(HybridBlock):
         params = self.collect_params()
         praws = {name: p.data()._data for name, p in params.items()}
 
-        def run(praws_, tok, caches, offset):
+        def run(praws_, tok, caches, offset, pages=None):
             saved = []
             prev = _tape.set_recording(False)
             try:
@@ -319,7 +377,7 @@ class LlamaForCausalLM(HybridBlock):
                     saved.append((p, p._data))
                     p._data = {c: NDArray(praws_[name]) for c in p._data}
                 logits, caches = self.forward(NDArray(tok), caches=caches,
-                                              offset=offset)
+                                              offset=offset, pages=pages)
                 return logits._data, caches
             finally:
                 for p, d in saved:

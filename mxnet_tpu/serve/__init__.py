@@ -5,32 +5,38 @@ static shapes, bucketed retracing) is exactly what a serving system
 needs, so this package is thin: a model registry that lints and
 pre-warms (:class:`ModelRunner`), a coalescing request batcher over
 bucketed shapes (:class:`DynamicBatcher`), a continuous-batching decode
-loop for generate workloads (:class:`DecodeServer`), typed admission
+loop for generate workloads over a paged KV cache
+(:class:`DecodeServer` + :class:`PageAllocator`), typed admission
 control (:class:`ServerOverloaded` & friends) and serving metrics that
 surface in ``mx.profiler.dumps()``'s Serving section and
 :func:`stats`.
 
 Environment knobs: ``MXNET_SERVE_BUCKETS``, ``MXNET_SERVE_MAX_WAIT_US``,
 ``MXNET_SERVE_QUEUE_DEPTH``, ``MXNET_SERVE_DEADLINE_MS``,
-``MXNET_SERVE_FAULT_SPEC`` (docs/env_vars.md; the design doc is
+``MXNET_SERVE_FAULT_SPEC``, ``MXNET_SERVE_PAGE_SIZE``,
+``MXNET_SERVE_PAGES``, ``MXNET_SERVE_PREFILL_CHUNK``,
+``MXNET_SERVE_PREFIX_CACHE`` (docs/env_vars.md; the design doc is
 docs/serving.md).
 """
 
 from .errors import ServeError, ServerOverloaded, DeadlineExceeded, \
-    ServerClosed
+    ServerClosed, PagesExhausted
 from .buckets import parse_buckets, pick_bucket, pow2_bucket, \
-    default_buckets
+    default_buckets, chunk_spans
 from .runner import ModelRunner
 from .batcher import DynamicBatcher
 from .decode import DecodeServer
+from .pages import PageAllocator, chain_key
 from .metrics import ServingMetrics, registry as _registry
 from . import faults
+from . import pages
 
 __all__ = ['ModelRunner', 'DynamicBatcher', 'DecodeServer',
-           'ServingMetrics', 'ServeError', 'ServerOverloaded',
-           'DeadlineExceeded', 'ServerClosed', 'parse_buckets',
-           'pick_bucket', 'pow2_bucket', 'default_buckets', 'faults',
-           'stats']
+           'PageAllocator', 'ServingMetrics', 'ServeError',
+           'ServerOverloaded', 'PagesExhausted', 'DeadlineExceeded',
+           'ServerClosed', 'parse_buckets', 'pick_bucket', 'pow2_bucket',
+           'default_buckets', 'chunk_spans', 'chain_key', 'faults',
+           'pages', 'stats']
 
 
 def stats():

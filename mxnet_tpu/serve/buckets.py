@@ -14,7 +14,7 @@ asserts it (see docs/serving.md for sizing guidance).
 import os
 
 __all__ = ['parse_buckets', 'pick_bucket', 'pow2_bucket',
-           'default_buckets']
+           'default_buckets', 'chunk_spans']
 
 _DEFAULT = '1,2,4,8'
 
@@ -45,6 +45,19 @@ def pick_bucket(n, buckets):
         if b >= n:
             return b
     return None
+
+
+def chunk_spans(n, chunk):
+    """Fixed-size chunk spans covering ``n`` positions: a list of
+    ``(start, length)`` with every length == ``chunk`` except possibly
+    the last. Chunked prefill (decode server) dispatches one span per
+    scheduler iteration — the ONE compiled prefill shape replaces the
+    per-bucket executable ladder for prompts."""
+    if n < 1:
+        raise ValueError(f'need at least one position, got {n}')
+    if chunk < 1:
+        raise ValueError(f'chunk must be >= 1, got {chunk}')
+    return [(s, min(chunk, n - s)) for s in range(0, n, chunk)]
 
 
 def pow2_bucket(n, lo=1, hi=None):

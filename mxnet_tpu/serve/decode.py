@@ -1,37 +1,63 @@
-"""Continuous-batching decode server for causal-LM generate traffic.
+"""Continuous-batching decode server over a paged KV cache.
 
 Orca-style iteration-level scheduling: instead of batching whole
 ``generate()`` calls (where one long sequence holds the batch hostage),
-the server owns a fixed pool of KV-cache *slots* and re-forms the batch
+the server owns a fixed pool of decode *slots* and re-forms the batch
 at every decode step — a finished sequence frees its slot and a queued
 prompt takes it over between steps, so a late-arriving request joins
 the RUNNING batch without waiting for the current one to finish.
 
+KV memory is **paged** (vLLM's PagedAttention, Kwon et al. SOSP 2023):
+one global pool of fixed-size pages ``(num_pages, page_size, kv_heads,
+dh)`` per layer, and each sequence holds only the pages its actual
+depth needs, named through a per-slot int32 **block table** that enters
+the compiled step as a traced input. Slot count is therefore a batch
+shape, and pool bytes are a memory budget — the two are decoupled, so
+``slots=16`` can run on the byte budget a 4-slot dense carve used, with
+admission gated on pages instead of reserving ``max_length`` per slot.
+
+Prompts load via **chunked prefill** (Sarathi-Serve, Agrawal et al.
+OSDI 2024): fixed ``prefill_chunk``-token chunks interleave with decode
+steps at the scheduler, so a 2048-token prompt cannot head-of-line
+block the running decodes — inter-token latency stays bounded by one
+chunk. Full chunks are published to a **prefix cache** (chain hash over
+the token prefix), so a repeated system prompt resolves to warm pages
+with zero prefill dispatches for the shared part.
+
 Static shapes throughout, so nothing ever retraces after warmup:
 
 * ONE compiled step function over the full pool ``(slots, 1)`` with a
-  per-row offset vector — each slot decodes at its own depth (the
-  per-slot path in ``LlamaAttention.forward``); inactive rows compute
-  garbage that is never read;
-* one compiled prefill per power-of-two prompt bucket — prompts are
-  padded up, the slot index and true length enter as traced scalars
-  (``lax.dynamic_slice`` carves the slot's cache row out of the pool,
-  the forward fills it, ``dynamic_update_slice`` puts it back);
+  per-row offset vector and the ``(slots, max_pages)`` block table —
+  each slot decodes at its own depth through its own pages; idle rows
+  carry an all-garbage-page block table and scatter into page 0, which
+  nothing ever reads;
+* ONE compiled prefill-chunk function ``(1, prefill_chunk)`` — the
+  chunk's absolute start offset, block-table row and last-real-token
+  index enter as traced values, so every chunk of every prompt length
+  reuses the same executable (the old design compiled one prefill per
+  pow2 prompt bucket AND ran it monolithically);
 * pad/garbage safety is positional: row ``b`` only ever attends to
   cache positions ``<= offset[b]``, and every such position was written
-  by the CURRENT occupant (prefill covers ``0..alen``, each step writes
-  its offset before attending) — residue from retired sequences or
-  warmup sits strictly above the mask.
+  by the CURRENT occupant (prefill chunks cover ``0..alen``, each step
+  writes its offset before attending) — residue from retired sequences,
+  chunk padding or warmup sits strictly above the mask. Prefix-cache
+  pages are the one exception, and they hold exactly the K/V the same
+  tokens would have produced (the cache key covers the entire prefix).
 
 Compile counting is a trace-time side effect (the counter bump inside
 the jitted bodies only runs when XLA actually retraces), so
 ``stats()['recompiles']`` machine-checks the zero-recompile guarantee
-the same way the batcher does.
+the same way the batcher does; :meth:`DecodeServer.audit_donation`
+additionally machine-checks that every per-layer page buffer is
+donated and aliased through the compiled step (no double-residency of
+the KV pool).
 
-Locking: ``_cv`` (``serve.queue``) guards admission, ``_slot_lock``
-(``serve.slots``, taken inside the queue lock, never across a compiled
-step) guards the slot table; the cache pool itself is touched only by
-the scheduler thread.
+Locking: ``_cv`` (``serve.queue``) guards admission, the page
+allocator's lock (``serve.pages``, taken inside the queue lock during
+admission) guards the free list / refcounts / prefix cache, and
+``_slot_lock`` (``serve.slots``, innermost of the three, never held
+across a compiled step or an allocator call) guards the slot table;
+the cache pool itself is touched only by the scheduler thread.
 """
 
 import threading
@@ -42,29 +68,35 @@ from functools import partial
 
 from ..analysis import race as _race
 from . import faults as _faults
-from .buckets import pick_bucket, pow2_bucket
+from . import pages as _pages
+from .buckets import chunk_spans
 from ..gluon.parameter import DeferredInitializationError
-from .errors import DeadlineExceeded, ServeError, ServerClosed, \
-    ServerOverloaded
+from .errors import DeadlineExceeded, PagesExhausted, ServeError, \
+    ServerClosed, ServerOverloaded
 from .metrics import ServingMetrics, register as _register, \
     unregister as _unregister
+from .pages import PageAllocator
 
 __all__ = ['DecodeServer']
 
-_MIN_PROMPT_BUCKET = 8
-
 
 class _Seq:
-    """One live sequence: its slot, depth, and remaining budget."""
+    """One live sequence: its slot, pages, depth and remaining budget."""
 
-    __slots__ = ('request', 'slot', 'offset', 'remaining', 'tokens')
+    __slots__ = ('request', 'slot', 'offset', 'remaining', 'tokens',
+                 'pages', 'filled', 'phase', 'ckey', 'last_t')
 
-    def __init__(self, request, slot, offset, remaining):
+    def __init__(self, request, slot):
         self.request = request
         self.slot = slot
-        self.offset = offset        # next cache write position
-        self.remaining = remaining
+        self.offset = 0             # next cache write position
+        self.remaining = request.max_new
         self.tokens = []            # generated token ids (host ints)
+        self.pages = []             # page ids, logical order
+        self.filled = 0             # prompt tokens already in cache
+        self.phase = 'prefill'      # 'prefill' -> 'decode'
+        self.ckey = _pages.EMPTY_KEY    # chain key of consumed chunks
+        self.last_t = 0.0           # last token timestamp (intertoken)
 
 
 class _DecodeRequest:
@@ -79,48 +111,71 @@ class _DecodeRequest:
 
 
 class DecodeServer:
-    """Slot-pooled continuous batching over a ``LlamaForCausalLM``.
+    """Paged-KV continuous batching over a ``LlamaForCausalLM``.
 
     Parameters
     ----------
     net : LlamaForCausalLM
         Initialized model (params materialized — run one forward first).
     slots : int
-        KV-cache pool size == the decode batch shape (default 4).
+        Decode batch shape == max concurrent sequences (default 4).
+        With paging this is NOT a memory reservation: raise it freely
+        and let ``num_pages`` be the budget.
     max_length : int, optional
-        Per-slot cache length (default ``net.cfg.max_length``).
-    prompt_buckets : tuple[int], optional
-        Power-of-two prompt-length buckets to pre-compile (default: the
-        full ladder 8, 16, ... up to ``max_length``).
+        Longest supported sequence (prompt + generated; default
+        ``net.cfg.max_length``), rounded up to whole prefill chunks —
+        it sizes the block-table width, not any allocation.
+    page_size : int, optional
+        Token positions per KV page (``MXNET_SERVE_PAGE_SIZE``,
+        default 16).
+    num_pages : int, optional
+        Page-pool size including the reserved garbage page
+        (``MXNET_SERVE_PAGES``; default: the dense-carve equivalent
+        ``slots * max_length / page_size + 1``).
+    prefill_chunk : int, optional
+        Prompt tokens per prefill dispatch (``MXNET_SERVE_PREFILL_CHUNK``,
+        default 32) — must be a multiple of ``page_size``. One chunk
+        runs per scheduler iteration, interleaved with decode steps.
+    prefix_cache : bool, optional
+        Reuse warm pages for repeated full prompt chunks
+        (``MXNET_SERVE_PREFIX_CACHE``, default on).
     queue_depth, deadline_ms, clock, start
         As in :class:`DynamicBatcher`.
     warmup : bool
-        Pre-compile the step fn and every prompt bucket at construction
+        Pre-compile the step and prefill-chunk fns at construction
         (default True — required for the zero-recompile guarantee).
     """
 
-    def __init__(self, net, slots=4, max_length=None, prompt_buckets=None,
+    def __init__(self, net, slots=4, max_length=None, page_size=None,
+                 num_pages=None, prefill_chunk=None, prefix_cache=None,
                  queue_depth=None, deadline_ms=None, clock=time.monotonic,
                  name=None, start=True, warmup=True):
+        import os
         import jax
         import jax.numpy as jnp
-        from jax import lax
 
         self.net = net
         self.slots = int(slots)
-        self.max_length = int(max_length or net.cfg.max_length)
-        if prompt_buckets is None:
-            ladder, b = [], min(_MIN_PROMPT_BUCKET, self.max_length)
-            while b < self.max_length:
-                ladder.append(b)
-                b *= 2
-            prompt_buckets = tuple(ladder) or (self.max_length,)
-        self.prompt_buckets = tuple(sorted(prompt_buckets))
-        if self.prompt_buckets[-1] > self.max_length:
+        self.page_size = int(page_size or _pages.default_page_size())
+        max_length = int(max_length or net.cfg.max_length)
+        if prefill_chunk is None:
+            prefill_chunk = min(_pages.default_prefill_chunk(), max_length)
+            prefill_chunk = max(self.page_size,
+                                prefill_chunk - prefill_chunk
+                                % self.page_size)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 1 or \
+                self.prefill_chunk % self.page_size:
             raise ServeError(
-                f'prompt bucket {self.prompt_buckets[-1]} exceeds '
-                f'max_length {self.max_length}')
-        import os
+                f'prefill_chunk {self.prefill_chunk} must be a positive '
+                f'multiple of page_size {self.page_size}')
+        # whole chunks must fit the block table (pad positions of the
+        # final chunk included), so max_length rounds up to chunks
+        c = self.prefill_chunk
+        self.max_length = -(-max_length // c) * c
+        self._max_pages = self.max_length // self.page_size
+        num_pages = num_pages or _pages.default_num_pages(
+            self.slots, self.max_length, self.page_size)
         self.queue_depth = queue_depth if queue_depth is not None else \
             int(os.environ.get('MXNET_SERVE_QUEUE_DEPTH', '') or 256)
         if deadline_ms is None:
@@ -129,6 +184,11 @@ class DecodeServer:
         self.default_deadline = (deadline_ms / 1e3) or None
         self._clock = clock
         self.name = name or f'decode:{type(net).__name__}'
+        self._prefix_on = prefix_cache if prefix_cache is not None \
+            else _pages.prefix_cache_enabled()
+        #: prefill chunks dispatched per scheduler iteration — 1 keeps
+        #: inter-token latency bounded by a single chunk (Sarathi)
+        self.prefill_chunks_per_step = 1
 
         self._cv = _race.tracked_condition(threading.Condition(),
                                            'serve.queue')
@@ -144,6 +204,8 @@ class DecodeServer:
 
         self.metrics = ServingMetrics(self.name)
         self._metrics_name = _register(self.name, self.metrics)
+        self._alloc = PageAllocator(num_pages, self.page_size,
+                                    name=self.name, metrics=self.metrics)
         self._compiles = 0          # bumped at TRACE time only
 
         try:
@@ -160,39 +222,38 @@ class DecodeServer:
             finally:
                 _tape.set_recording(prev)
             run, self._praws = net._param_run()
-        self._pool = net.init_caches(self.slots, self.max_length)
-        self._offsets = [0] * self.slots
+        self._pool = net.init_paged_pool(num_pages, self.page_size)
 
-        @partial(jax.jit, donate_argnums=(2,))
-        def step(praws, toks, pool, offsets):
-            self._compiles += 1     # trace-time side effect
-            logits, pool = run(praws, toks[:, None], pool, offsets)
+        # un-jitted bodies are kept for audit_donation()/lint — tracing
+        # them does not disturb the compile counter
+        def step_body(praws, toks, pool, offsets, pages):
+            logits, pool = run(praws, toks[:, None], pool, offsets,
+                               pages=pages)
             nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
                              axis=-1).astype(jnp.int32)
             return nxt, pool
 
+        def prefill_body(praws, tok, pool, off, pages, last):
+            logits, pool = run(praws, tok, pool, off, pages=pages)
+            nxt = jnp.argmax(
+                logits[0, last].astype(jnp.float32)).astype(jnp.int32)
+            return nxt, pool
+
+        self._step_body = step_body
+        self._prefill_body = prefill_body
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def step(praws, toks, pool, offsets, pages):
+            self._compiles += 1     # trace-time side effect
+            return step_body(praws, toks, pool, offsets, pages)
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def prefill(praws, tok, pool, off, pages, last):
+            self._compiles += 1
+            return prefill_body(praws, tok, pool, off, pages, last)
+
         self._step = step
-
-        def make_prefill(plen):
-            @partial(jax.jit, donate_argnums=(2,))
-            def prefill(praws, tok, pool, slot, alen):
-                self._compiles += 1
-                row = [(lax.dynamic_slice(k, (slot, 0, 0, 0),
-                                          (1,) + k.shape[1:]),
-                        lax.dynamic_slice(v, (slot, 0, 0, 0),
-                                          (1,) + v.shape[1:]))
-                       for k, v in pool]
-                logits, row = run(praws, tok, row, 0)
-                pool = [(lax.dynamic_update_slice(pk, rk, (slot, 0, 0, 0)),
-                         lax.dynamic_update_slice(pv, rv, (slot, 0, 0, 0)))
-                        for (pk, pv), (rk, rv) in zip(pool, row)]
-                nxt = jnp.argmax(
-                    logits[0, alen - 1].astype(jnp.float32)).astype(
-                        jnp.int32)
-                return nxt, pool
-            return prefill
-
-        self._prefills = {p: make_prefill(p) for p in self.prompt_buckets}
+        self._prefill = prefill
 
         if warmup:
             self.warmup_compiles = self._warmup()
@@ -209,18 +270,20 @@ class DecodeServer:
 
     # ------------------------------------------------------------ warmup
     def _warmup(self):
-        """Trace every prefill bucket + the step fn against slot 0. The
-        garbage this writes into the pool sits above every live mask."""
+        """Trace the prefill-chunk fn and the step fn once each. Their
+        all-zero block tables point every write at the garbage page, so
+        warmup residue is unreachable by construction."""
         import jax.numpy as jnp
         before = self._compiles
-        zero = jnp.zeros((), jnp.int32)
-        for plen, fn in self._prefills.items():
-            tok = jnp.zeros((1, plen), jnp.int32)
-            _, self._pool = fn(self._praws, tok, self._pool, zero,
-                               jnp.asarray(1, jnp.int32))
+        tok = jnp.zeros((1, self.prefill_chunk), jnp.int32)
+        row = jnp.zeros((1, self._max_pages), jnp.int32)
+        _, self._pool = self._prefill(
+            self._praws, tok, self._pool, jnp.zeros((), jnp.int32), row,
+            jnp.asarray(self.prefill_chunk - 1, jnp.int32))
         toks = jnp.zeros((self.slots,), jnp.int32)
         offs = jnp.zeros((self.slots,), jnp.int32)
-        _, self._pool = self._step(self._praws, toks, self._pool, offs)
+        bt = jnp.zeros((self.slots, self._max_pages), jnp.int32)
+        _, self._pool = self._step(self._praws, toks, self._pool, offs, bt)
         return self._compiles - before
 
     # --------------------------------------------------------- admission
@@ -230,14 +293,21 @@ class DecodeServer:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ServeError('empty prompt')
-        if pick_bucket(len(prompt), self.prompt_buckets) is None:
-            raise ServeError(
-                f'prompt of {len(prompt)} tokens exceeds the largest '
-                f'prompt bucket {self.prompt_buckets[-1]}')
         if len(prompt) + max_new_tokens > self.max_length:
             raise ServeError(
                 f'prompt {len(prompt)} + max_new {max_new_tokens} '
                 f'exceeds the cache length {self.max_length}')
+        # a request whose worst-case page need exceeds the whole pool
+        # can never be admitted — shed now, not after queueing
+        spans = chunk_spans(len(prompt), self.prefill_chunk)
+        worst = max(spans[-1][0] + self.prefill_chunk,
+                    len(prompt) + max_new_tokens)
+        if self._alloc.pages_for(worst) > self._alloc.usable:
+            self.metrics.on_shed()
+            raise PagesExhausted(
+                f'request needs {self._alloc.pages_for(worst)} KV pages '
+                f'but the pool holds {self._alloc.usable} '
+                f'(MXNET_SERVE_PAGES)')
         now = self._clock()
         if deadline_ms is None:
             dl = now + self.default_deadline if self.default_deadline \
@@ -274,12 +344,122 @@ class DecodeServer:
         self._table_state.write()
         self._table[i] = seq
 
+    # -------------------------------------------------------- page plans
+    def _plan_pages(self, req):
+        """Prefix-cache probe + page allocation for a request's whole
+        lifetime (padded prompt span and decode budget — admission is
+        the gate, so decode can never die of page starvation).
+        Returns (pages, chain_key, filled_tokens); raises
+        :class:`PagesExhausted` on a transient shortage, with any
+        prefix pins rolled back."""
+        alen = len(req.prompt)
+        c = self.prefill_chunk
+        pages, ckey, filled, hits = [], _pages.EMPTY_KEY, 0, 0
+        if self._prefix_on:
+            # the final chunk always dispatches (its logits seed the
+            # first generated token), so only chunks strictly before it
+            # are reusable
+            limit = (alen - 1) // c
+            while filled // c < limit:
+                chunk = tuple(req.prompt[filled:filled + c])
+                key = _pages.chain_key(ckey, chunk)
+                got = self._alloc.lookup(key)
+                if got is None:
+                    break
+                pages.extend(got)
+                ckey = key
+                filled += c
+                hits += 1
+        n_left = -(-(alen - filled) // c)
+        span_end = filled + n_left * c      # chunk padding writes too
+        lifetime = max(span_end, alen + req.max_new)
+        need = self._alloc.pages_for(lifetime) - len(pages)
+        try:
+            pages.extend(self._alloc.alloc(need))
+        except PagesExhausted:
+            self._alloc.release(pages)      # roll back the prefix pins
+            raise
+        self.metrics.on_prefix(hits, n_left)
+        return pages, ckey, filled
+
+    def _block_rows(self, seqs):
+        """int32 block-table rows for ``seqs``, padded to the table
+        width with the garbage page."""
+        import numpy as onp
+        rows = onp.full((len(seqs), self._max_pages), _pages.GARBAGE_PAGE,
+                        onp.int32)
+        for i, s in enumerate(seqs):
+            rows[i, :len(s.pages)] = s.pages
+        return rows
+
+    def _retire(self, seq, result=None, error=None):
+        """Return a sequence's pages to the pool (prefix-cache pins
+        survive), free its slot and resolve its future."""
+        self._alloc.release(seq.pages)
+        seq.pages = []
+        with self._slot_lock:
+            self._set_slot(seq.slot, None)
+        if error is not None:
+            self._fail(seq.request, error)
+        else:
+            if seq.request.future.set_running_or_notify_cancel():
+                seq.request.future.set_result(result)
+            self.metrics.on_complete(
+                self._clock() - seq.request.submit_t)
+
+    # ---------------------------------------------------------- prefill
+    def _prefill_one(self, seq):
+        """Dispatch ONE chunk of ``seq``'s prompt through the compiled
+        prefill fn; on the final chunk the sequence turns to decode
+        with its first generated token. Returns 1 (a chunk ran) or 0
+        (the sequence failed and was retired)."""
+        import jax.numpy as jnp
+        req = seq.request
+        c = self.prefill_chunk
+        psz = self.page_size
+        alen = len(req.prompt)
+        start = seq.filled
+        real = min(c, alen - start)
+        is_final = start + real >= alen
+        try:
+            _faults.on('prefill')
+            toks = req.prompt[start:start + real] + [0] * (c - real)
+            row = jnp.asarray(self._block_rows([seq]))
+            nxt, self._pool = self._prefill(
+                self._praws, jnp.asarray([toks], jnp.int32), self._pool,
+                jnp.asarray(start, jnp.int32), row,
+                jnp.asarray(real - 1 if is_final else c - 1, jnp.int32))
+            self.metrics.on_prefill_chunk()
+        except Exception as e:              # noqa: BLE001
+            self.metrics.on_failed()
+            self._retire(seq, error=e)
+            return 0
+        if self._prefix_on and real == c:
+            # a full chunk is shareable: publish its pages under the
+            # chain key of the entire prefix through this chunk
+            key = _pages.chain_key(
+                seq.ckey, tuple(req.prompt[start:start + c]))
+            self._alloc.insert(
+                key, seq.pages[start // psz:(start + c) // psz])
+            seq.ckey = key
+        seq.filled = start + real
+        if is_final:
+            now = self._clock()
+            seq.offset = alen
+            seq.tokens.append(int(nxt))
+            seq.remaining -= 1
+            seq.phase = 'decode'
+            seq.last_t = now
+            self.metrics.on_first_token(now - req.submit_t)
+        return 1
+
     # --------------------------------------------------------- the loop
     def step_once(self):
-        """One scheduler iteration: expire, admit into free slots
-        (prefill), then one decode step over the pool. Returns the
-        number of sequences touched (admitted + stepped + expired) —
-        0 means fully idle. Deterministic: tests call this directly."""
+        """One scheduler iteration: expire, admit into free slots, run
+        at most ``prefill_chunks_per_step`` prompt chunks, then one
+        decode step over the pool. Returns the number of sequences
+        touched (admitted + prefilled + stepped + expired) — 0 means
+        fully idle. Deterministic: tests call this directly."""
         import jax.numpy as jnp
 
         now = self._clock()
@@ -291,92 +471,93 @@ class DecodeServer:
                 expired.append(self._queue.popleft())
             with self._slot_lock:
                 free = self._free_slots()
-                while self._queue and free:
-                    req = self._queue[0]
-                    if req.deadline is not None and req.deadline <= now:
-                        self._queue_state.write()
-                        expired.append(self._queue.popleft())
-                        continue
+            while self._queue and free:
+                req = self._queue[0]
+                if req.deadline is not None and req.deadline <= now:
                     self._queue_state.write()
-                    self._queue.popleft()
-                    slot = free.pop(0)
-                    # reserve before prefill so the next round cannot
-                    # double-assign; ready once offset is real
-                    seq = _Seq(req, slot, 0, req.max_new)
+                    expired.append(self._queue.popleft())
+                    continue
+                try:
+                    pages, ckey, filled = self._plan_pages(req)
+                except PagesExhausted:
+                    # transient shortage: the request stays queued
+                    # (FIFO backpressure) until sequences retire and
+                    # their pages come back
+                    break
+                self._queue_state.write()
+                self._queue.popleft()
+                slot = free.pop(0)
+                seq = _Seq(req, slot)
+                seq.pages, seq.ckey, seq.filled = pages, ckey, filled
+                with self._slot_lock:
                     self._set_slot(slot, seq)
-                    admitted.append(seq)
+                admitted.append(seq)
+                self.metrics.on_admit([now - req.submit_t])
         for req in expired:
             self.metrics.on_expired()
             self._fail(req, DeadlineExceeded(
                 'deadline expired in queue; aborted before prefill'))
-        # ---- locks released: device work below
-        for seq in admitted:
-            req = seq.request
-            try:
-                _faults.on('prefill')
-                alen = len(req.prompt)
-                plen = pick_bucket(alen, self.prompt_buckets)
-                tok = jnp.asarray(
-                    [req.prompt + [0] * (plen - alen)], jnp.int32)
-                nxt, self._pool = self._prefills[plen](
-                    self._praws, tok, self._pool,
-                    jnp.asarray(seq.slot, jnp.int32),
-                    jnp.asarray(alen, jnp.int32))
-            except Exception as e:           # noqa: BLE001
-                self.metrics.on_failed()
-                with self._slot_lock:
-                    self._set_slot(seq.slot, None)
-                self._fail(req, e)
-                continue
-            seq.offset = alen
-            seq.tokens.append(int(nxt))
-            seq.remaining -= 1
-            self.metrics.on_admit([self._clock() - req.submit_t])
+        # ---- locks released: device work below (scheduler thread only)
         with self._slot_lock:
             live = [s for s in self._table if s is not None]
+        prefilling = sorted((s for s in live if s.phase == 'prefill'),
+                            key=lambda s: s.request.submit_t)
+        prefilled = 0
+        for seq in prefilling[:self.prefill_chunks_per_step]:
+            prefilled += self._prefill_one(seq)
+        with self._slot_lock:
+            live = [s for s in self._table if s is not None]
+        decoding = [s for s in live if s.phase == 'decode']
         stepped = 0
-        if live:
-            alive = [s for s in live if s.remaining > 0]
+        st = self._alloc.stats()
+        self.metrics.on_pages(st['pages_in_use'], st['pages_usable'])
+        if decoding:
+            alive = [s for s in decoding if s.remaining > 0]
             if alive:
                 stepped = len(alive)
                 try:
+                    import numpy as onp
                     _faults.on('step')
                     toks = [0] * self.slots
-                    offs = list(self._offsets)
-                    for s in alive:
+                    offs = [0] * self.slots
+                    # rows with no live decode (idle, mid-prefill or
+                    # just-finished) keep all-garbage block tables and
+                    # offset 0: the step's unconditional scatter for
+                    # them lands in page 0, never in anyone's pages
+                    bt = onp.full((self.slots, self._max_pages),
+                                  _pages.GARBAGE_PAGE, onp.int32)
+                    rows = self._block_rows(alive)
+                    for i, s in enumerate(alive):
                         toks[s.slot] = s.tokens[-1]
                         offs[s.slot] = s.offset
+                        bt[s.slot] = rows[i]
                     nxt, self._pool = self._step(
                         self._praws, jnp.asarray(toks, jnp.int32),
-                        self._pool, jnp.asarray(offs, jnp.int32))
+                        self._pool, jnp.asarray(offs, jnp.int32),
+                        jnp.asarray(bt))
                     nxt = [int(t) for t in nxt]
                 except Exception as e:       # noqa: BLE001
                     for s in live:
                         self.metrics.on_failed()
-                        with self._slot_lock:
-                            self._set_slot(s.slot, None)
-                        self._fail(s.request, e)
-                    return len(admitted) + len(expired)
+                        self._retire(s, error=e)
+                    return len(admitted) + prefilled + len(expired)
+                now2 = self._clock()
                 for s in alive:
                     s.tokens.append(nxt[s.slot])
                     s.offset += 1
-                    self._offsets[s.slot] = s.offset
                     s.remaining -= 1
-                self.metrics.on_step(stepped)
-            for s in live:
+                    self.metrics.on_token_gap(now2 - s.last_t)
+                    s.last_t = now2
+                self.metrics.on_step(stepped, self.slots)
+            for s in decoding:
                 if s.remaining <= 0:
-                    with self._slot_lock:
-                        self._set_slot(s.slot, None)   # slot freed
-                    if s.request.future.set_running_or_notify_cancel():
-                        s.request.future.set_result(list(s.tokens))
-                    self.metrics.on_complete(
-                        self._clock() - s.request.submit_t)
+                    self._retire(s, result=list(s.tokens))
         if self.compile_baseline is not None \
                 and self._compiles != self.compile_baseline:
             self.metrics.on_recompile(
                 self._compiles - self.compile_baseline)
             self.compile_baseline = self._compiles
-        return len(admitted) + stepped + len(expired)
+        return len(admitted) + prefilled + stepped + len(expired)
 
     @staticmethod
     def _fail(req, exc):
@@ -411,11 +592,14 @@ class DecodeServer:
                     self._fail(self._queue.popleft(), ServerClosed(
                         f'{self.name} closed without drain'))
                 with self._slot_lock:
-                    for i, s in enumerate(self._table):
-                        if s is not None:
-                            self._set_slot(i, None)
-                            self._fail(s.request, ServerClosed(
-                                f'{self.name} closed without drain'))
+                    live = [s for s in self._table if s is not None]
+                    for s in live:
+                        self._set_slot(s.slot, None)
+                for s in live:      # page release outside serve.slots
+                    self._alloc.release(s.pages)
+                    s.pages = []
+                    self._fail(s.request, ServerClosed(
+                        f'{self.name} closed without drain'))
                 self._closed = True
             self._cv.notify_all()
         if self._thread is not None:
@@ -439,6 +623,29 @@ class DecodeServer:
         self.close(drain=exc[0] is None)
         return False
 
+    # --------------------------------------------------------- analysis
+    def audit_donation(self):
+        """Machine-check the paged pool's buffer donation: lint the
+        (un-jitted) step body with the pool leaves donated exactly as
+        the compiled step donates them, compile, and parse the HLO
+        ``input_output_alias`` table. Every per-layer (k, v) page
+        buffer must alias an output — otherwise the pool is doubly
+        resident across the step. Returns the ``AnalysisReport``
+        (``report.stats['aliased_args']`` vs ``['donated_args']``)."""
+        import jax
+        import jax.numpy as jnp
+        from .. import analysis
+        toks = jnp.zeros((self.slots,), jnp.int32)
+        offs = jnp.zeros((self.slots,), jnp.int32)
+        bt = jnp.zeros((self.slots, self._max_pages), jnp.int32)
+        n_praws = len(jax.tree.leaves(self._praws))
+        pool_idx = tuple(range(n_praws + 1,
+                               n_praws + 1 + 2 * len(self._pool)))
+        return analysis.lint(
+            self._step_body, self._praws, toks, self._pool, offs, bt,
+            donation=True, donate_argnums=pool_idx,
+            name=f'{self.name}.step')
+
     # ------------------------------------------------------------- stats
     def stats(self):
         out = self.metrics.snapshot()
@@ -449,9 +656,14 @@ class DecodeServer:
             out['active_slots'] = sum(
                 1 for s in self._table if s is not None)
         out['slots'] = self.slots
+        out['max_length'] = self.max_length
+        out['prefill_chunk'] = self.prefill_chunk
+        out.update(self._alloc.stats())
         return out
 
     def __repr__(self):
         return (f'<DecodeServer {self.name!r} slots={self.slots} '
                 f'max_length={self.max_length} '
-                f'prompt_buckets={self.prompt_buckets}>')
+                f'page_size={self.page_size} '
+                f'pages={self._alloc.num_pages} '
+                f'prefill_chunk={self.prefill_chunk}>')

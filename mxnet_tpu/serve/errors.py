@@ -10,7 +10,7 @@ catch-all handlers keep working.
 from ..base import MXNetError
 
 __all__ = ['ServeError', 'ServerOverloaded', 'DeadlineExceeded',
-           'ServerClosed']
+           'ServerClosed', 'PagesExhausted']
 
 
 class ServeError(MXNetError):
@@ -21,6 +21,14 @@ class ServerOverloaded(ServeError):
     """The bounded request queue is at capacity — the request was shed
     at admission (load shedding, never silent queueing without bound).
     Clients should back off and retry."""
+
+
+class PagesExhausted(ServerOverloaded):
+    """The paged KV pool cannot supply the pages a request needs — a
+    memory-shaped overload (``serve/pages.py``), shed like any other:
+    clients back off and retry. Raised at ``submit`` when the request
+    could never fit the pool, and by the allocator when a transient
+    shortage outlives every evictable prefix-cache entry."""
 
 
 class DeadlineExceeded(ServeError):

@@ -40,6 +40,8 @@ class ServingMetrics:
             self._lock = _race.tracked(self._lock, 'misc.leaf')
         self._latency_s = deque(maxlen=_SAMPLES)   # submit -> result
         self._queue_s = deque(maxlen=_SAMPLES)     # submit -> dispatch
+        self._ttft_s = deque(maxlen=_SAMPLES)      # submit -> 1st token
+        self._intertok_s = deque(maxlen=_SAMPLES)  # token -> next token
         self._requests = 0
         self._completed = 0
         self._failed = 0
@@ -50,7 +52,15 @@ class ServingMetrics:
         self._padded_rows = 0       # pad rows burned to reach a bucket
         self._steps = 0             # decode steps (continuous batching)
         self._active_rows = 0       # active slots across decode steps
+        self._dispatched_rows = 0   # pool rows dispatched (active + idle)
         self._recompiles = 0        # compiles observed AFTER warmup
+        self._prefill_chunks = 0    # chunked-prefill dispatches
+        self._prefix_hit = 0        # prompt chunks served from warm pages
+        self._prefix_miss = 0       # prompt chunks that needed prefill
+        self._page_evictions = 0    # prefix-cache entries dropped LRU
+        self._pages_in_use = 0      # gauge: pool pages held right now
+        self._pages_usable = 0      # gauge: pool pages available to seqs
+        self._page_util_sum = 0.0   # per-step utilization accumulator
 
     # ------------------------------------------------------------ events
     def on_submit(self):
@@ -78,10 +88,54 @@ class ServingMetrics:
         with self._lock:
             self._queue_s.extend(queue_times_s)
 
-    def on_step(self, n_active):
+    def on_step(self, n_active, n_rows=None):
+        """One continuous-batching decode step: ``n_active`` live
+        sequences out of ``n_rows`` dispatched pool rows (the compiled
+        step always runs the full pool shape — idle rows are honest
+        waste, tracked separately from the active count)."""
         with self._lock:
             self._steps += 1
             self._active_rows += n_active
+            self._dispatched_rows += n_rows if n_rows is not None \
+                else n_active
+            if self._pages_usable:
+                self._page_util_sum += \
+                    self._pages_in_use / self._pages_usable
+
+    def on_first_token(self, ttft_s):
+        """Time-to-first-token: submit → the prompt's first generated
+        token (the tail of the last prefill chunk)."""
+        with self._lock:
+            self._ttft_s.append(ttft_s)
+
+    def on_token_gap(self, gap_s):
+        """Inter-token gap for one live sequence — the latency a
+        streaming client perceives between tokens; chunked prefill
+        exists to bound its tail while long prompts load."""
+        with self._lock:
+            self._intertok_s.append(gap_s)
+
+    def on_prefill_chunk(self, n=1):
+        with self._lock:
+            self._prefill_chunks += n
+
+    def on_prefix(self, hits, misses):
+        """Prompt admission outcome in chunks: ``hits`` resolved to
+        warm prefix-cache pages (no prefill compute), ``misses`` will
+        be prefilled."""
+        with self._lock:
+            self._prefix_hit += hits
+            self._prefix_miss += misses
+
+    def on_page_eviction(self, n=1):
+        with self._lock:
+            self._page_evictions += n
+
+    def on_pages(self, in_use, usable):
+        """Page-pool gauge (sampled by the scheduler each iteration)."""
+        with self._lock:
+            self._pages_in_use = in_use
+            self._pages_usable = usable
 
     def on_complete(self, latency_s):
         with self._lock:
@@ -105,10 +159,14 @@ class ServingMetrics:
         with self._lock:
             lat = list(self._latency_s)
             qt = list(self._queue_s)
+            ttft = list(self._ttft_s)
+            gaps = list(self._intertok_s)
             batches = self._batches
             rows = self._batched_rows
             steps = self._steps
             active = self._active_rows
+            dispatched = self._dispatched_rows
+            util_sum = self._page_util_sum
             out = {
                 'requests': self._requests,
                 'completed': self._completed,
@@ -119,6 +177,11 @@ class ServingMetrics:
                 'padded_rows': self._padded_rows,
                 'steps': steps,
                 'recompiles': self._recompiles,
+                'prefill_chunks': self._prefill_chunks,
+                'prefix_hit': self._prefix_hit,
+                'prefix_miss': self._prefix_miss,
+                'page_evictions': self._page_evictions,
+                'pages_in_use': self._pages_in_use,
             }
         # percentiles off-lock: sorting 2k samples under the leaf lock
         # would stall the scheduler's counter updates
@@ -126,6 +189,10 @@ class ServingMetrics:
                              profiler.percentiles(lat).items()}
         out['queue_ms'] = {q: v * 1e3 for q, v in
                            profiler.percentiles(qt).items()}
+        out['ttft_ms'] = {q: v * 1e3 for q, v in
+                          profiler.percentiles(ttft).items()}
+        out['intertoken_ms'] = {q: v * 1e3 for q, v in
+                                profiler.percentiles(gaps).items()}
         # occupancy: mean real rows per dispatched batch (batcher) or
         # mean active slots per step (decode server)
         if steps:
@@ -134,6 +201,14 @@ class ServingMetrics:
             out['occupancy_avg'] = rows / batches
         else:
             out['occupancy_avg'] = 0.0
+        # honest decode-pool accounting, kept separate (the old single
+        # number conflated them): slot_occupancy is the fraction of
+        # DISPATCHED pool rows that carried a live sequence (idle rows
+        # during drain drag it down — that is the point), and
+        # page_utilization is the per-step mean fraction of usable KV
+        # pages actually held by sequences/prefix entries.
+        out['slot_occupancy'] = active / dispatched if dispatched else 0.0
+        out['page_utilization'] = util_sum / steps if steps else 0.0
         return out
 
 

@@ -1,10 +1,13 @@
-"""mx.serve continuous-batching decode server + the llama bucketed-batch
-generate fix (ISSUE 10).
+"""mx.serve continuous-batching decode server (paged KV cache, chunked
+prefill) + the llama bucketed-batch generate fix.
 
 The decode acceptance criteria live here: a late-arriving sequence
 joins the RUNNING decode batch without retracing, finished sequences
-free their KV slot for queued work, and the slot-pooled output exactly
-matches the reference ``generate()`` greedy decode.
+free their KV slot AND return their pages to the pool for queued work,
+and the paged output exactly matches the reference ``generate()``
+greedy decode — including across slot churn, chunk boundaries and
+prefix-cache reuse. Page-allocator unit tests, the chunked-prefill
+fairness bound and the prefix cache live in test_serve_pages.py.
 """
 
 import threading
@@ -40,7 +43,8 @@ def lm():
 def _server(lm, **kw):
     kw.setdefault('slots', 2)
     kw.setdefault('max_length', 32)
-    kw.setdefault('prompt_buckets', (4, 8))
+    kw.setdefault('page_size', 4)
+    kw.setdefault('prefill_chunk', 8)
     kw.setdefault('start', False)
     return DecodeServer(lm, **kw)
 
@@ -50,7 +54,7 @@ def test_late_join_no_retrace_and_slot_free(lm):
     """A sequence submitted mid-decode joins at the next step boundary
     with ZERO new compiles; finishing frees its KV slot."""
     ds = _server(lm)
-    assert ds.warmup_compiles == 3          # 2 prompt buckets + 1 step
+    assert ds.warmup_compiles == 2          # 1 prefill-chunk fn + 1 step
     base = ds._compiles
     fa = ds.submit([1, 2, 3], max_new_tokens=8)
     ds.step_once()                          # prefill A + first step
@@ -107,6 +111,46 @@ def test_parity_with_reference_generate(lm):
     ds.close()
 
 
+def _reference(lm, prompt, n):
+    out = lm.generate(mx.np.array([prompt]), max_new_tokens=n)
+    return [int(t) for t in out.asnumpy()[0, len(prompt):]]
+
+
+def test_paged_parity_across_joins_and_retires(lm):
+    """Acceptance: paged decode is token-identical to ``generate()``
+    even as sequences join mid-decode, retire, and their pages are
+    recycled into later admissions — multi-chunk prompts included
+    (prompt lengths straddle chunk and page boundaries)."""
+    ds = _server(lm, slots=2)           # page_size=4, prefill_chunk=8
+    jobs = [
+        ([3, 1, 4, 1, 5, 9, 2, 6, 5, 3], 6),   # 2 chunks, ragged tail
+        ([2, 7, 1, 8], 5),                     # 1 chunk, page-aligned
+        ([1, 1, 2, 3, 5, 8, 13, 21], 4),       # exactly 1 full chunk
+        ([9, 9], 7),                           # shorter than a page
+        ([6, 2, 8, 3, 1, 8, 5, 3, 0, 7, 1, 7], 3),  # 2 chunks
+    ]
+    want = [_reference(lm, p, n) for p, n in jobs]
+    futs = []
+    # staggered submissions: a couple join while earlier ones decode
+    futs.append(ds.submit(*jobs[0]))
+    futs.append(ds.submit(*jobs[1]))
+    for _ in range(3):
+        ds.step_once()
+    futs.append(ds.submit(*jobs[2]))        # late join into live batch
+    futs.append(ds.submit(*jobs[3]))
+    futs.append(ds.submit(*jobs[4]))        # waits for a retire
+    for _ in range(60):
+        if all(f.done() for f in futs):
+            break
+        ds.step_once()
+    got = [f.result(1) for f in futs]
+    assert got == want
+    s = ds.stats()
+    assert s['recompiles'] == 0
+    assert s['pages_in_use'] == s['prefix_entries'] * 2  # only cache pins
+    ds.close()
+
+
 # -------------------------------------------------------- admission ctrl
 def test_decode_shed_and_deadline(lm):
     clock = _FakeClock()
@@ -134,10 +178,11 @@ def test_decode_submit_validation(lm):
     ds = _server(lm)
     with pytest.raises(ServeError, match='empty'):
         ds.submit([])
-    with pytest.raises(ServeError, match='prompt bucket'):
-        ds.submit(list(range(9)))           # > largest bucket (8)
     with pytest.raises(ServeError, match='cache length'):
         ds.submit([1, 2], max_new_tokens=31)    # 2 + 31 > 32
+    with pytest.raises(ServeError, match='multiple of page_size'):
+        DecodeServer(lm, slots=2, max_length=32, page_size=4,
+                     prefill_chunk=6, start=False, warmup=False)
     ds.close()
 
 
@@ -177,8 +222,8 @@ def test_threaded_decode_server(lm):
     MXNET_RACE_CHECK=1 via test_serve.py's child-pytest soak."""
     from mxnet_tpu.analysis import race
 
-    ds = DecodeServer(lm, slots=2, max_length=32, prompt_buckets=(4,),
-                      start=True)
+    ds = DecodeServer(lm, slots=2, max_length=32, page_size=4,
+                      prefill_chunk=4, start=True)
     results, errs = [], []
     lock = threading.Lock()
 

@@ -10,19 +10,26 @@ Two workloads, mirroring the two server types:
   throughput, p50/p95/p99 latency, time-in-queue, batch occupancy and
   the shed count.
 * **llama**: llama_tiny behind a :class:`DecodeServer` — continuous
-  batching over mixed prompt lengths, measuring generated tokens/s and
-  step occupancy.
+  batching over mixed prompt lengths on the PAGED KV cache (chunked
+  prefill + prefix cache on), measuring generated tokens/s, TTFT and
+  inter-token percentiles, slot occupancy and page utilization. The
+  full config runs 16 slots on the SAME pool-byte budget the old dense
+  4-slot carve used (``num_pages = 4 * max_length / page_size + 1``) —
+  paging is what makes that head-room real; a duplicated system-prompt
+  prefix exercises the prefix cache under load.
 
 Both sections assert the serving core guarantee — ``recompiles == 0``
-after warmup — and the script exits nonzero if it is violated, so the
-bench doubles as an end-to-end check.
+after warmup (with paging, chunked prefill and prefix reuse all
+active) — and the script exits nonzero if it is violated, so the bench
+doubles as an end-to-end check.
 
 Output: one JSON document (BENCH_* style — ``metric``/``value``/
 ``unit`` plus the stats snapshot) written to ``--out`` (default
-``SERVE_r01.json``) and echoed as a single JSON line on stdout.
+``SERVE_r02.json``; the r01 artifact is the dense pre-paging baseline)
+and echoed as a single JSON line on stdout.
 
 Run:
-  python tools/serve_bench.py                 # full (SERVE_r01.json)
+  python tools/serve_bench.py                 # full (SERVE_r02.json)
   python tools/serve_bench.py --smoke         # tier-1 smoke (seconds)
 """
 
@@ -39,11 +46,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def _percentile_trim(stats):
     """Keep the JSON lean: drop raw sample vectors, round latencies."""
     out = dict(stats)
-    for key in ('latency_ms', 'queue_ms'):
+    for key in ('latency_ms', 'queue_ms', 'ttft_ms', 'intertoken_ms'):
         if key in out:
             out[key] = {str(q): round(v, 3) for q, v in out[key].items()}
-    if 'occupancy_avg' in out:
-        out['occupancy_avg'] = round(out['occupancy_avg'], 3)
+    for key in ('occupancy_avg', 'slot_occupancy', 'page_utilization'):
+        if key in out:
+            out[key] = round(out[key], 3)
     return out
 
 
@@ -108,11 +116,16 @@ def bench_llama(args):
     t0 = time.perf_counter()
     server = serve.DecodeServer(
         net, slots=args.slots, max_length=args.max_length,
-        prompt_buckets=args.prompt_buckets, name='bench-llama')
+        page_size=args.page_size, num_pages=args.num_pages,
+        prefill_chunk=args.prefill_chunk, name='bench-llama')
     warm_s = time.perf_counter() - t0
 
     import random
     rnd = random.Random(0)
+    # a shared system prompt on half the requests drives the prefix
+    # cache: whole chunks of it resolve to warm pages, copy-free
+    sys_prompt = [rnd.randrange(net.cfg.vocab_size)
+                  for _ in range(args.prefill_chunk)]
     futs = []
     interval = 1.0 / args.rate
     start = time.perf_counter()
@@ -121,8 +134,10 @@ def bench_llama(args):
         now = time.perf_counter()
         if target > now:
             time.sleep(target - now)
-        plen = rnd.randint(2, args.prompt_buckets[-1])
+        plen = rnd.randint(2, args.max_prompt)
         prompt = [rnd.randrange(net.cfg.vocab_size) for _ in range(plen)]
+        if i % 2:
+            prompt = (sys_prompt + prompt)[:args.max_prompt]
         futs.append(server.submit(prompt,
                                   max_new_tokens=args.new_tokens))
     toks = sum(len(f.result(300)) for f in futs)
@@ -130,7 +145,7 @@ def bench_llama(args):
     stats = server.stats()
     server.close()
     doc = {
-        'metric': f'llama_tiny_continuous_decode_slots{args.slots}',
+        'metric': f'llama_tiny_paged_decode_slots{args.slots}',
         'value': round(toks / wall, 2),
         'unit': 'tok/s',
         'offered_rate': args.rate,
@@ -147,7 +162,7 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     ap.add_argument('--smoke', action='store_true',
                     help='tiny config for the tier-1 CI smoke')
-    ap.add_argument('--out', default='SERVE_r01.json')
+    ap.add_argument('--out', default='SERVE_r02.json')
     ap.add_argument('--rate', type=float, default=None,
                     help='offered load, requests/s (open loop)')
     ap.add_argument('--requests', type=int, default=None)
@@ -165,7 +180,10 @@ def main():
         args.queue_depth = 64
         args.slots = 2
         args.max_length = 32
-        args.prompt_buckets = (8,)
+        args.page_size = 8
+        args.num_pages = None           # dense-equivalent default
+        args.prefill_chunk = 8
+        args.max_prompt = 16
         args.prompts = 4
         args.new_tokens = 4
     else:
@@ -175,10 +193,15 @@ def main():
         args.rate = args.rate or 400.0
         args.max_wait_us = 5000
         args.queue_depth = 256
-        args.slots = 4
+        # 16 slots on the byte budget the dense 4-slot carve used
+        # (SERVE_r01): paging decouples batch shape from pool bytes
+        args.slots = 16
         args.max_length = 128
-        args.prompt_buckets = (8, 16)
-        args.prompts = 24
+        args.page_size = 16
+        args.num_pages = 4 * (128 // 16) + 1
+        args.prefill_chunk = 32
+        args.max_prompt = 64
+        args.prompts = 48
         args.new_tokens = 16
 
     doc = {'config': 'smoke' if args.smoke else 'full',
@@ -192,7 +215,11 @@ def main():
         'resnet_p99_ms': doc['resnet']['latency_ms'].get('99'),
         'resnet_occupancy': doc['resnet']['occupancy_avg'],
         'llama_tok_s': doc['llama']['value'],
-        'llama_occupancy': doc['llama']['occupancy_avg'],
+        'llama_slot_occupancy': doc['llama']['slot_occupancy'],
+        'llama_page_util': doc['llama']['page_utilization'],
+        'llama_prefix_hit': doc['llama']['prefix_hit'],
+        'llama_ttft_p99_ms': doc['llama']['ttft_ms'].get('99'),
+        'llama_intertok_p99_ms': doc['llama']['intertoken_ms'].get('99'),
         'recompiles': doc['resnet']['recompiles']
         + doc['llama']['recompiles'],
         'out': args.out}))
