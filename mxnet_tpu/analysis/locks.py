@@ -56,6 +56,16 @@ import os
 # a thread holding an ``A``-level lock may acquire a ``B``-level lock,
 # never the reverse. See docs/threading.md for the prose contract.
 LOCK_HIERARCHY = (
+    ('serve.router', 'Router._lock: the routing/health table, request '
+                     'seq and counters; outermost of the serving tier '
+                     'and NEVER held across an RPC — selection snapshots '
+                     'under it, network I/O happens outside '
+                     '(mxnet_tpu/serve/router.py)'),
+    ('serve.replica', 'Replica._lock + its RPC endpoint transport lock: '
+                      'current-version pointer, swap flag, dedup window; '
+                      'released before any DecodeServer call, so it sits '
+                      'above the queue lock '
+                      '(mxnet_tpu/serve/replica.py)'),
     ('serve.queue', 'DynamicBatcher._cv / DecodeServer._cv (Condition): '
                     'the bounded admission queue, batching window and '
                     'drain/close flags; outermost — the scheduler thread '
@@ -106,12 +116,19 @@ LOCK_SITES = {
         '_seq_lock': 'misc.leaf',
         '_SERVERS_LOCK': 'misc.leaf',
     },
+    '*/kvstore/rpc.py': {
+        '_sock_lock': 'kvstore.sock',
+        '_lock': 'kvstore.store',
+        '_conns_lock': 'misc.leaf',
+    },
     '*/kvstore/faults.py': {'_lock': 'misc.leaf'},
     '*/serve/batcher.py': {'_cv': 'serve.queue'},
     '*/serve/decode.py': {'_cv': 'serve.queue', '_slot_lock': 'serve.slots'},
     '*/serve/pages.py': {'_lock': 'serve.pages'},
     '*/serve/metrics.py': {'_lock': 'misc.leaf'},
     '*/serve/faults.py': {'_lock': 'misc.leaf'},
+    '*/serve/router.py': {'_lock': 'serve.router'},
+    '*/serve/replica.py': {'_lock': 'serve.replica'},
     '*/profiler.py': {'_stats_lock': 'misc.leaf'},
     '*/symbol/symbol.py': {'_name_lock': 'misc.leaf'},
     '*/operator.py': {'_lock': 'misc.leaf'},

@@ -28,6 +28,13 @@ servers sid>0 bind the interface their host reaches server 0 through
 Server address: rank 0's host from ``MX_COORDINATOR`` with port offset
 ``MXNET_KVSTORE_ASYNC_PORT`` (default coordinator port + 29).
 
+The transport layer (framing, handler loop, heartbeat table,
+tombstones, (client, seq) dedup window, retrying client channel) lives
+in :mod:`mxnet_tpu.kvstore.rpc` — ``_AsyncServer`` subclasses
+:class:`~mxnet_tpu.kvstore.rpc.RpcServer` and registers the kvstore
+command set; the replicated serving tier (``mxnet_tpu/serve/router.py``)
+registers its own handlers on the same machinery.
+
 Capacity (reference ``kvstore_dist.h:621`` EncodeDefaultKey):
 
 * **Multi-server key sharding** — ``MXNET_KVSTORE_NUM_SERVERS=S``
@@ -62,13 +69,9 @@ Capacity (reference ``kvstore_dist.h:621`` EncodeDefaultKey):
   (``MXNET_KVSTORE_FAULT_SPEC``).
 """
 
-import collections
-import json
 import os
 import pickle
 import socket
-import socketserver
-import struct
 import threading
 
 import numpy as _onp
@@ -76,6 +79,10 @@ import numpy as _onp
 from ..ndarray.ndarray import NDArray
 from . import faults
 from .base import KVStoreBase, register
+# framing helpers re-exported from their historical home: faults-harness
+# docs and older callers name them as dist_async._send_msg etc.
+from .rpc import (RpcClient, RpcServer, _recv_exact,  # noqa: F401
+                  _recv_msg, _send_msg)
 
 # RPCs that change server state: they carry a per-store (client, seq)
 # identity so a retry of an applied-but-reply-lost request is answered
@@ -85,61 +92,25 @@ _MUTATING_CMDS = frozenset(
     {'init', 'push', 'set_optimizer', 'register_server', 'barrier'})
 
 
-def _recv_exact(sock, n):
-    buf = b''
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError('kvstore async peer closed')
-        buf += chunk
-    return buf
-
-
-def _send_msg(sock, header, payload=b''):
-    faults.on_send(header)          # no-op unless a fault plan is armed
-    head = json.dumps(header).encode('utf-8')
-    sock.sendall(struct.pack('!II', len(head), len(payload)))
-    sock.sendall(head)
-    if payload:
-        sock.sendall(payload)
-
-
-def _recv_msg(sock):
-    faults.on_recv(sock)            # no-op unless a fault plan is armed
-    hlen, plen = struct.unpack('!II', _recv_exact(sock, 8))
-    header = json.loads(_recv_exact(sock, hlen).decode('utf-8'))
-    payload = _recv_exact(sock, plen) if plen else b''
-    return header, payload
-
-
-class _AsyncServer(threading.Thread):
+class _AsyncServer(RpcServer):
     """The PS: one instance on rank 0 (reference KVStoreDistServer::Run).
     Every request handler applies immediately under the store lock —
-    the async branch of DataHandleDefault."""
+    the async branch of DataHandleDefault. Transport machinery
+    (handler loop, heartbeat table, dedup window) comes from
+    :class:`~mxnet_tpu.kvstore.rpc.RpcServer`."""
+
+    LOCK_LEVEL = 'kvstore.store'
+    # data-plane commands prove a live store: they lift a tombstone (a
+    # NEW store of a departed rank revives it); ping/bye/queries do not
+    _REVIVING_CMDS = frozenset(
+        {'init', 'push', 'pull', 'barrier', 'set_optimizer'})
 
     def __init__(self, port, bind_host='127.0.0.1', sid=0):
-        super().__init__(daemon=True)
-        self._sid = sid
+        super().__init__(port, bind_host=bind_host, sid=sid)
         self._store = {}
         self._updater = None
-        self._lock = threading.Lock()
-        self._last_seen = {}        # worker rank -> monotonic last beat
         self._server_table = {}     # sid -> 'host:port' (server 0 only)
-        # ranks that sent 'bye': a delayed in-flight ping from a
-        # departed worker must not re-enter it into _last_seen (the
-        # ADVICE r5 heartbeat race) — only a real data RPC (a new store
-        # incarnation of the same rank) lifts the tombstone
-        self._tombstones = set()
-        # (client, seq) -> (reply, rpayload) replay window for retried
-        # mutating RPCs whose reply was lost after the server applied
-        # them: exactly-once pushes under retry (≙ ps-lite's resender
-        # dedup by message timestamp)
-        self._dedup = {}
-        self._dedup_order = collections.deque()
-        self._dedup_window = int(os.environ.get(
-            'MXNET_KVSTORE_DEDUP_WINDOW', '512'))
-        self._counters = {'init_applied': 0, 'push_applied': 0,
-                          'dedup_replays': 0}
+        self._counters.update({'init_applied': 0, 'push_applied': 0})
         self._secret = os.environ.get('MXNET_KVSTORE_SECRET', '')
         # addresses that count as "same host" for the no-secret
         # set_optimizer gate: loopback plus the bind interface itself
@@ -156,109 +127,18 @@ class _AsyncServer(threading.Thread):
         self._race = None
         from ..analysis import race as _race
         if _race.enabled():
-            # declared levels 'kvstore.store' / 'kvstore.barrier'
-            # (analysis/locks.py); every _store mutation must hold
-            # self._lock — handler threads race each other and the
-            # heartbeat reaper
-            self._lock = _race.tracked(self._lock, 'kvstore.store')
+            # self._lock is already tracked at 'kvstore.store' by the
+            # RpcServer base; every _store mutation must hold it —
+            # handler threads race each other and the heartbeat reaper
             self._barrier_cv = _race.tracked_condition(
                 self._barrier_cv, 'kvstore.barrier')
             self._race = _race.shared_state('kvstore._AsyncServer._store',
                                             guard=self._lock)
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                while True:
-                    try:
-                        header, payload = _recv_msg(self.request)
-                    except (ConnectionError, OSError, ValueError):
-                        return
-                    try:
-                        reply, rpayload = outer._dispatch(
-                            header, payload, self.client_address[0])
-                    except Exception as e:    # keep the connection alive
-                        reply, rpayload = {'ok': False,
-                                           'error': repr(e)}, b''
-                    try:
-                        _send_msg(self.request, reply, rpayload)
-                    except (ConnectionError, OSError):
-                        # the peer reset/closed mid-reply (e.g. its
-                        # retrying RPC layer already gave up on this
-                        # socket): it will resend on a fresh
-                        # connection and the dedup window answers —
-                        # nothing to report, no traceback spew
-                        return
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        # bind the coordinator interface (not 0.0.0.0): workers reach us
-        # at this address anyway, and nothing else should
-        try:
-            self._server = Server((bind_host, port), Handler)
-        except OSError:
-            # coordinator hostname may not be a local interface name
-            # (NAT/containers): fall back to all interfaces like ps-lite
-            self._server = Server(('0.0.0.0', port), Handler)
-
-    def run(self):
-        self._server.serve_forever(poll_interval=0.05)
-
-    def stop(self):
-        self._server.shutdown()
 
     # ----------------------------------------------------------- handlers
-    # data-plane commands prove a live store: they lift a tombstone (a
-    # NEW store of a departed rank revives it); ping/bye/queries do not
-    _REVIVING_CMDS = frozenset(
-        {'init', 'push', 'pull', 'barrier', 'set_optimizer'})
-
-    def _dispatch(self, header, payload, peer='127.0.0.1'):
-        """Bookkeeping envelope around :meth:`_handle`: heartbeat
-        refresh (tombstone-gated), then the (client, seq) dedup window
-        — a retried mutating RPC the server already applied gets its
-        cached reply replayed instead of a second apply."""
-        import time as _time
+    def _handle_app(self, header, payload, peer='127.0.0.1'):
         cmd = header['cmd']
         rank = header.get('rank')
-        client, seq = header.get('client'), header.get('seq')
-        with self._lock:
-            if rank is not None:
-                r = int(rank)
-                if r not in self._tombstones:
-                    # every RPC doubles as a heartbeat (plus the
-                    # dedicated ping thread on each worker)
-                    self._last_seen[r] = _time.monotonic()
-                elif cmd in self._REVIVING_CMDS:
-                    self._tombstones.discard(r)
-                    self._last_seen[r] = _time.monotonic()
-            if client is not None and seq is not None:
-                cached = self._dedup.get((client, int(seq)))
-                if cached is not None:
-                    self._counters['dedup_replays'] += 1
-                    return cached
-        reply, rpayload = self._handle(header, payload, peer)
-        if client is not None and seq is not None and reply.get('ok'):
-            # only successful applies enter the window: a failed
-            # attempt must re-execute, not replay its error
-            with self._lock:
-                key = (client, int(seq))
-                if key not in self._dedup:
-                    self._dedup[key] = (reply, rpayload)
-                    self._dedup_order.append(key)
-                    while len(self._dedup_order) > self._dedup_window:
-                        self._dedup.pop(self._dedup_order.popleft(),
-                                        None)
-        return reply, rpayload
-
-    def _handle(self, header, payload, peer='127.0.0.1'):
-        import time as _time
-        cmd = header['cmd']
-        rank = header.get('rank')
-        if cmd == 'ping':
-            return {'ok': True, 'sid': self._sid}, b''
         if cmd == 'register_server':
             with self._lock:
                 self._server_table[int(header['sid'])] = header['addr']
@@ -268,24 +148,6 @@ class _AsyncServer(threading.Thread):
                 return {'ok': True,
                         'table': {str(k): v for k, v
                                   in self._server_table.items()}}, b''
-        if cmd == 'bye':
-            # clean departure: drop the rank from the last-seen table so
-            # get_num_dead_node does not report a finished worker as
-            # dead forever (ADVICE r4), and tombstone it so a delayed
-            # in-flight ping cannot re-add it afterwards (ADVICE r5)
-            with self._lock:
-                self._last_seen.pop(int(rank), None)
-                self._tombstones.add(int(rank))
-            return {'ok': True}, b''
-        if cmd == 'dead_nodes':
-            cutoff = _time.monotonic() - float(header['timeout'])
-            with self._lock:
-                dead = sum(1 for t in self._last_seen.values()
-                           if t < cutoff)
-                departed = len(self._tombstones)
-            # tombstoned ranks left CLEANLY: reported separately, never
-            # counted dead
-            return {'ok': True, 'dead': dead, 'departed': departed}, b''
         if cmd == 'stats':
             with self._lock:
                 return {'ok': True, 'sid': self._sid,
@@ -429,12 +291,12 @@ class KVStoreDistAsync(KVStoreBase):
     def __init__(self):
         self._rank = int(os.environ.get('MX_PROC_ID', '0'))
         self._nproc = int(os.environ.get('MX_NPROC', '1'))
-        self._socks = {}            # sid -> socket (None == needs redial)
-        self._sock_locks = {}       # sid -> Lock (heartbeat vs caller)
-        self._addrs = {}            # sid -> (host, port) for reconnects
+        self._chans = {}            # sid -> RpcClient channel
+        self._addrs = {}            # sid -> (host, port) diagnostics
         self._server = None
         self._port = None
         self._host = ' '
+        self._closed = False
         self._nserv = min(max(1, int(os.environ.get(
             'MXNET_KVSTORE_NUM_SERVERS', '1'))), self._nproc)
         self._big = int(float(os.environ.get(
@@ -462,37 +324,28 @@ class KVStoreDistAsync(KVStoreBase):
                                  'giveups': 0}
 
     # ------------------------------------------------------------ plumbing
-    def _dial(self, host, port, deadline=None):
-        """Connect with bounded patience: the startup path keeps the
-        historical ~10s budget; reconnects inside a retrying RPC pass
-        the caller's remaining ``deadline`` (monotonic timestamp)."""
-        import time
-        last = None
-        for _ in range(100):
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            try:
-                s = socket.create_connection((host, port), timeout=5)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                # per-call timeouts are managed by _rpc_to from its
-                # deadline; an unset timeout here would otherwise cap
-                # every recv (barriers included) at connect's 5s
-                s.settimeout(None)
-                return s
-            except OSError as e:
-                last = e
-                time.sleep(0.1)
-        raise ConnectionError(
-            f'cannot reach dist_async server at {host}:{port}: {last}')
+    def _channel(self, sid, host, port):
+        """Create + eagerly connect the retrying channel to server
+        ``sid`` (all channels share one transport-stats dict)."""
+        chan = RpcClient(host, int(port), label=f'server {sid}',
+                         what='dist_async', retries=self._rpc_retries,
+                         deadline_s=self._rpc_deadline,
+                         backoff_s=self._rpc_backoff,
+                         stats=self._transport_stats)
+        chan.connect()
+        self._addrs[sid] = (host, int(port))
+        self._chans[sid] = chan
+        return chan
 
     def _ensure_connected(self):
-        if self._socks:
+        if self._chans:
             return
         coord = os.environ.get('MX_COORDINATOR', '127.0.0.1:49800')
         host, port = coord.rsplit(':', 1)
         self._port = int(os.environ.get('MXNET_KVSTORE_ASYNC_PORT',
                                         int(port) + 29))
         self._host = host
+        self._closed = False
         local = host in ('127.0.0.1', 'localhost')
         if self._rank == 0 and self._server is None:
             # rank 0 hosts server 0 (reference: the server node group;
@@ -511,9 +364,7 @@ class KVStoreDistAsync(KVStoreBase):
         # coordinator host: the server may be bound to that interface
         # only, so rank 0 dialing loopback would be refused
         target = '127.0.0.1' if local else host
-        self._addrs[0] = (target, self._port)
-        self._socks[0] = self._dial(target, self._port)
-        self._sock_locks[0] = threading.Lock()
+        self._channel(0, target, self._port)
         if self._nserv > 1:
             # server sid>0 starts only AFTER dialing server 0 and binds
             # the exact interface that dial used (getsockname) — the
@@ -522,7 +373,7 @@ class KVStoreDistAsync(KVStoreBase):
             # init/push/pull data plane on every NIC (ADVICE r4).
             if 0 < self._rank < self._nserv:
                 my_port = self._port + self._rank
-                myif = self._socks[0].getsockname()[0]
+                myif = self._chans[0].sock().getsockname()[0]
                 with _SERVERS_LOCK:
                     self._server = _SERVERS.get(my_port)
                     if self._server is None:
@@ -549,10 +400,7 @@ class KVStoreDistAsync(KVStoreBase):
                     'servers registered')
             for sid_s, addr in table.items():
                 h, p = addr.rsplit(':', 1)
-                sid = int(sid_s)
-                self._addrs[sid] = (h, int(p))
-                self._socks[sid] = self._dial(h, int(p))
-                self._sock_locks[sid] = threading.Lock()
+                self._channel(int(sid_s), h, int(p))
         if self._hb_thread is None:
             interval = float(os.environ.get('MXNET_KVSTORE_HEARTBEAT_S',
                                             '2'))
@@ -586,38 +434,49 @@ class KVStoreDistAsync(KVStoreBase):
     def close(self):
         """Stop the heartbeat thread and close this store's server
         connections (the server threads themselves are shared per-port
-        and stay up for other stores in the process). Safe to call more
-        than once; also invoked by __del__ so an abandoned store does
-        not pin sockets and a pinger for the process lifetime."""
+        and stay up for other stores in the process).
+
+        Idempotent and shutdown-safe: a second call (or a __del__ at
+        interpreter teardown racing an already-dead heartbeat thread,
+        or one that runs before _ensure_connected ever did) returns
+        without raising — router+replica teardown tears down many
+        stores at GC time and none of them may throw."""
+        if getattr(self, '_closed', False):
+            return
+        self._closed = True
         hb = getattr(self, '_hb_thread', None)
         if hb is not None:
-            self._hb_stop.set()
-            # join BEFORE the bye RPC: an in-flight ping landing after
-            # the bye would re-add this rank to the server's last-seen
-            # table and resurrect the dead-forever accounting bug.
-            # Deadline-bounded: a pinger stuck in a dying RPC must not
-            # hang close() (the thread is a daemon; leaking it past the
-            # deadline is safe)
-            hb.join(timeout=min(10.0, _kv_deadline_s()))
-            self._hb_thread = None
-        if 0 in self._socks:
             try:
-                # clean departure: deregister from the heartbeat table so
-                # this rank is not counted dead forever (ADVICE r4);
+                self._hb_stop.set()
+                # join BEFORE the bye RPC: an in-flight ping landing
+                # after the bye would re-add this rank to the server's
+                # last-seen table and resurrect the dead-forever
+                # accounting bug. Deadline-bounded: a pinger stuck in a
+                # dying RPC must not hang close() (the thread is a
+                # daemon; leaking it past the deadline is safe). An
+                # already-dead thread joins immediately.
+                hb.join(timeout=min(10.0, _kv_deadline_s()))
+            except Exception:
+                pass              # interpreter shutting down mid-close
+            self._hb_thread = None
+        chans = getattr(self, '_chans', None)
+        if not chans:
+            return
+        if 0 in chans:
+            try:
+                # clean departure: deregister from the heartbeat table
+                # so this rank is not counted dead forever (ADVICE r4);
                 # single short attempt — shutdown must not hang on a
                 # server that is already gone
                 self._rpc_to(0, {'cmd': 'bye'}, attempts=1, deadline_s=5)
             except Exception:
                 pass              # server already gone: nothing to tell
-        for sid, sock in list(self._socks.items()):
-            if sock is None:        # dropped by a failed RPC, no redial
-                continue
+        for chan in list(chans.values()):
             try:
-                sock.close()
-            except OSError:
+                chan.close()
+            except Exception:
                 pass
-        self._socks.clear()
-        self._sock_locks.clear()
+        chans.clear()
         self._addrs.clear()
 
     def __del__(self):                  # pragma: no cover - GC timing
@@ -628,76 +487,23 @@ class KVStoreDistAsync(KVStoreBase):
 
     def _rpc_to(self, sid, header, payload=b'', attempts=None,
                 deadline_s=None):
-        """One RPC with retry/backoff + reconnect.
+        """One RPC with retry/backoff + reconnect (the channel's
+        :meth:`~mxnet_tpu.kvstore.rpc.RpcClient.call` contract).
 
-        Transport failures (``ConnectionError``/``OSError``/socket
-        timeout — including fault-injected ones) close and re-dial the
-        server socket, then resend with exponential backoff + jitter
-        until ``MXNET_KVSTORE_RPC_RETRIES`` attempts or the
-        ``MXNET_KVSTORE_RPC_DEADLINE_S`` per-call deadline run out.
-        Mutating RPCs carry (client, seq) so the server's dedup window
-        makes the resend exactly-once; a half-written request or
-        half-read reply can never desync the stream because the socket
-        is dropped on EVERY failure. Application-level errors
+        This wrapper owns identity: it stamps ``rank`` plus, for
+        mutating RPCs, the per-store ``(client, seq)`` — exactly once,
+        so the identity survives the channel's resends and the server
+        dedup window sees a stable key. Application-level errors
         (``ok: False`` replies) are NOT retried — they surface as
         ``RuntimeError`` exactly as before."""
-        import random
-        import time
         header['rank'] = self._rank
         if header['cmd'] in _MUTATING_CMDS and 'seq' not in header:
             with self._seq_lock:
                 self._seq += 1
                 header['seq'] = self._seq
             header['client'] = self._client
-        deadline = time.monotonic() + (
-            self._rpc_deadline if deadline_s is None else deadline_s)
-        if attempts is None:
-            attempts = max(1, self._rpc_retries + 1)
-        last = None
-        with self._sock_locks[sid]:
-            for attempt in range(attempts):
-                try:
-                    sock = self._socks.get(sid)
-                    if sock is None:
-                        host, port = self._addrs[sid]
-                        sock = self._dial(host, port, deadline=deadline)
-                        self._socks[sid] = sock
-                        self._transport_stats['redials'] += 1
-                    sock.settimeout(
-                        max(0.05, deadline - time.monotonic()))
-                    _send_msg(sock, header, payload)
-                    reply, rpayload = _recv_msg(sock)
-                    sock.settimeout(None)
-                    break
-                except (ConnectionError, TimeoutError, OSError) as e:
-                    last = e
-                    sock = self._socks.get(sid)
-                    if sock is not None:
-                        try:
-                            sock.close()
-                        except OSError:
-                            pass
-                    self._socks[sid] = None
-                    now = time.monotonic()
-                    if attempt + 1 >= attempts or now >= deadline:
-                        self._transport_stats['giveups'] += 1
-                        host, port = self._addrs.get(
-                            sid, (self._host, self._port))
-                        raise ConnectionError(
-                            f'dist_async rpc {header["cmd"]!r} to '
-                            f'server {sid} at {host}:{port} failed '
-                            f'after {attempt + 1} attempt(s) '
-                            f'({type(e).__name__}: {e}); raise '
-                            'MXNET_KVSTORE_RPC_RETRIES / '
-                            'MXNET_KVSTORE_RPC_DEADLINE_S to wait '
-                            'longer') from e
-                    self._transport_stats['retries'] += 1
-                    step = self._rpc_backoff * (2 ** attempt)
-                    step *= 0.5 + random.random() / 2   # jitter
-                    time.sleep(min(step, max(0.0, deadline - now)))
-        if not reply.get('ok'):
-            raise RuntimeError(reply.get('error', 'kvstore rpc failed'))
-        return reply, rpayload
+        return self._chans[sid].call(header, payload, attempts=attempts,
+                                     deadline_s=deadline_s)
 
     def _rpc(self, header, payload=b''):
         self._ensure_connected()
@@ -842,7 +648,7 @@ class KVStoreDistAsync(KVStoreBase):
         self._ensure_connected()
         blob = pickle.dumps(optimizer)
         token = os.environ.get('MXNET_KVSTORE_SECRET', '')
-        for sid in sorted(self._socks):
+        for sid in sorted(self._chans):
             # every server runs the updater for the keys/chunks it owns
             self._rpc_to(sid, {'cmd': 'set_optimizer', 'token': token},
                          blob)
@@ -862,7 +668,7 @@ class KVStoreDistAsync(KVStoreBase):
         for the sharded layout (split chunks appear as 'key#cN')."""
         self._ensure_connected()
         out = {}
-        for sid in sorted(self._socks):
+        for sid in sorted(self._chans):
             reply, _ = self._rpc_to(sid, {'cmd': 'stats'})
             out[sid] = reply['keys']
         return out
@@ -875,7 +681,7 @@ class KVStoreDistAsync(KVStoreBase):
         the ``--kvstore-soak`` bench mode."""
         self._ensure_connected()
         out = {}
-        for sid in sorted(self._socks):
+        for sid in sorted(self._chans):
             reply, _ = self._rpc_to(sid, {'cmd': 'stats'})
             out[sid] = {k: v for k, v in reply.items() if k != 'ok'}
         return out
@@ -908,7 +714,7 @@ class KVStoreDistAsync(KVStoreBase):
         last-seen table."""
         self._ensure_connected()
         dead = 0
-        for sid in sorted(self._socks):
+        for sid in sorted(self._chans):
             try:
                 self._rpc_to(sid, {'cmd': 'ping'})
             except Exception:

@@ -80,6 +80,16 @@ from .pages import PageAllocator
 __all__ = ['DecodeServer']
 
 
+def _drain_deadline_s():
+    """Bound on a draining close: ``MXNET_SERVE_DRAIN_S`` seconds
+    (default 30) before residual requests are force-failed."""
+    import os
+    try:
+        return max(1e-3, float(os.environ.get('MXNET_SERVE_DRAIN_S', '30')))
+    except ValueError:
+        return 30.0
+
+
 class _Seq:
     """One live sequence: its slot, pages, depth and remaining budget."""
 
@@ -580,35 +590,66 @@ class DecodeServer:
                     self._cv.wait(0.05)
 
     # ------------------------------------------------------------- close
-    def close(self, drain=True, timeout=30.0):
-        """Stop admission; drain live sequences or reject everything."""
+    def _abort_residual_locked(self, why):
+        """Fail everything still queued or live (caller holds ``_cv``).
+        Shared by the no-drain teardown and the drain-deadline expiry."""
+        while self._queue:
+            self._queue_state.write()
+            self._fail(self._queue.popleft(), ServerClosed(
+                f'{self.name} {why}'))
+        with self._slot_lock:
+            live = [s for s in self._table if s is not None]
+            for s in live:
+                self._set_slot(s.slot, None)
+        for s in live:      # page release outside serve.slots
+            self._alloc.release(s.pages)
+            s.pages = []
+            self._fail(s.request, ServerClosed(f'{self.name} {why}'))
+
+    def close(self, drain=True, timeout=None):
+        """Stop admission; drain live sequences or reject everything.
+
+        The drain is *bounded*: after ``timeout`` seconds (default
+        ``MXNET_SERVE_DRAIN_S``, 30) any residual queued or live
+        request is failed with :class:`ServerClosed` instead of being
+        leaked as a forever-pending future. A wedged model step can
+        therefore delay shutdown, but never prevent it."""
+        if timeout is None:
+            timeout = _drain_deadline_s()
         with self._cv:
             if self._closed:
                 return
             self._draining = True
             if not drain:
-                while self._queue:
-                    self._queue_state.write()
-                    self._fail(self._queue.popleft(), ServerClosed(
-                        f'{self.name} closed without drain'))
-                with self._slot_lock:
-                    live = [s for s in self._table if s is not None]
-                    for s in live:
-                        self._set_slot(s.slot, None)
-                for s in live:      # page release outside serve.slots
-                    self._alloc.release(s.pages)
-                    s.pages = []
-                    self._fail(s.request, ServerClosed(
-                        f'{self.name} closed without drain'))
+                self._abort_residual_locked('closed without drain')
                 self._closed = True
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                # Drain deadline exceeded: the scheduler is wedged
+                # (stalled step, stuck device call). Force-fail the
+                # residual work so every submitted future resolves;
+                # the wedged thread exits on its next loop iteration.
+                with self._cv:
+                    if not self._closed:
+                        self._abort_residual_locked(
+                            'drain deadline exceeded '
+                            '(MXNET_SERVE_DRAIN_S)')
+                        self._closed = True
+                    self._cv.notify_all()
         else:
+            deadline = time.monotonic() + timeout
             while drain and self.step_once():
-                pass
+                if time.monotonic() > deadline:
+                    break
             with self._cv:
-                self._closed = True
+                if not self._closed:
+                    if drain:
+                        self._abort_residual_locked(
+                            'drain deadline exceeded '
+                            '(MXNET_SERVE_DRAIN_S)')
+                    self._closed = True
         _unregister(self._metrics_name)
 
     @property

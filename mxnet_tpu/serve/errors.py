@@ -10,7 +10,7 @@ catch-all handlers keep working.
 from ..base import MXNetError
 
 __all__ = ['ServeError', 'ServerOverloaded', 'DeadlineExceeded',
-           'ServerClosed', 'PagesExhausted']
+           'ServerClosed', 'PagesExhausted', 'NoHealthyReplicas']
 
 
 class ServeError(MXNetError):
@@ -40,3 +40,10 @@ class DeadlineExceeded(ServeError):
 class ServerClosed(ServeError):
     """The server is draining or closed; no new work is accepted and
     still-queued requests are rejected when ``close(drain=False)``."""
+
+
+class NoHealthyReplicas(ServeError):
+    """The router has no healthy replica left to route to — every
+    replica is ejected (heartbeat deadline exceeded) or failed the
+    request's failover attempts. Terminal for the request; the router
+    keeps heartbeating and re-admits replicas that recover."""

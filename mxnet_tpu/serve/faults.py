@@ -18,10 +18,29 @@ semicolon-separated rules::
     error:STAGE[:N]       raise ``RuntimeError`` on the N-th hit of
                           STAGE (default 1; fires once).
     error_every:STAGE:N   same, every N-th hit (soak mode).
+    crash:STAGE[:N]       raise :class:`CrashInjected` (a
+                          ``ConnectionError``) on the N-th hit, once —
+                          at the router↔replica boundary the replica
+                          responds by killing its whole RPC endpoint,
+                          so peers see a dead process, not an error
+                          reply.
+    partition:STAGE:N:M   raise :class:`PartitionInjected` (a
+                          ``ConnectionError``) on hits N..N+M-1, then
+                          heal — a transient network partition: the
+                          endpoint stays alive but unreachable.
 
 ``STAGE`` is one of the pipeline's hook points — ``dispatch`` (batch
 handed to the model), ``prefill`` (decode-server prompt prefill),
-``step`` (one continuous-batching decode step) — or ``*`` for any.
+``step`` (one continuous-batching decode step) — or, at the
+router↔replica RPC boundary, ``submit`` (request received by a
+replica, BEFORE it is applied), ``reply`` (reply about to be sent,
+AFTER the apply — losing it exercises the dedup window), and
+``heartbeat`` (replica answering a router ping). ``*`` matches any.
+
+A stage may carry a scope suffix ``STAGE@NAME`` targeting one named
+endpoint (``crash:submit@r1:2`` kills only replica ``r1``, on its 2nd
+submit); hooks pass their scope via ``on(stage, scope=...)``.
+Scopeless rules match every scope.
 """
 
 import os
@@ -30,13 +49,27 @@ import threading
 import time as _time
 
 __all__ = ['configure', 'clear', 'active', 'injected', 'on',
-           'FaultSpecError', 'STAGES']
+           'FaultSpecError', 'CrashInjected', 'PartitionInjected',
+           'STAGES']
 
-STAGES = ('dispatch', 'prefill', 'step')
+STAGES = ('dispatch', 'prefill', 'step', 'submit', 'reply', 'heartbeat')
 
 
 class FaultSpecError(ValueError):
     """Malformed ``MXNET_SERVE_FAULT_SPEC`` rule."""
+
+
+class CrashInjected(ConnectionError):
+    """A fault-plan ``crash`` rule fired: the endpoint must die
+    abruptly (sever every connection, no replies) — ConnectionError so
+    the generic RPC handler drops the socket instead of sending an
+    ``ok: False`` reply the client would treat as an application
+    error."""
+
+
+class PartitionInjected(ConnectionError):
+    """A fault-plan ``partition`` rule fired: this message is lost as
+    if the network were cut, but the endpoint lives and later heals."""
 
 
 def _parse_duration(text):
@@ -47,15 +80,26 @@ def _parse_duration(text):
     return val / 1e3 if m.group(2) == 'ms' else val
 
 
+def _parse_stage(token, text):
+    """Split a ``STAGE[@SCOPE]`` token."""
+    stage, sep, scope = token.partition('@')
+    if not stage or (sep and not scope):
+        raise FaultSpecError(f'bad stage {token!r} in rule {text!r}')
+    return stage, (scope or None)
+
+
 class _Rule:
-    def __init__(self, action, stage, **kw):
+    def __init__(self, action, stage, scope=None, **kw):
         self.action = action
         self.stage = stage
+        self.scope = scope
         self.seen = 0
         self.__dict__.update(kw)
 
-    def matches(self, stage):
-        return self.stage in ('*', stage)
+    def matches(self, stage, scope=None):
+        if self.stage not in ('*', stage):
+            return False
+        return self.scope is None or self.scope == scope
 
 
 def _parse_rule(text):
@@ -64,21 +108,34 @@ def _parse_rule(text):
     if action == 'stall':
         if len(parts) != 3:
             raise FaultSpecError(f'stall rule {text!r}: want stall:STAGE:DUR')
-        return _Rule('stall', parts[1], duration=_parse_duration(parts[2]))
-    if action in ('error', 'error_every'):
-        if len(parts) == 2 and action == 'error':
-            stage, n = parts[1], 1
+        stage, scope = _parse_stage(parts[1], text)
+        return _Rule('stall', stage, scope,
+                     duration=_parse_duration(parts[2]))
+    if action in ('error', 'error_every', 'crash'):
+        if len(parts) == 2 and action in ('error', 'crash'):
+            token, n = parts[1], 1
         elif len(parts) == 3:
-            stage, n = parts[1], int(parts[2])
+            token, n = parts[1], int(parts[2])
         else:
             raise FaultSpecError(
                 f'{action} rule {text!r}: want {action}:STAGE[:N]')
         if n < 1:
             raise FaultSpecError(f'{action} count must be >= 1, got {n}')
-        return _Rule(action, stage, n=n)
+        stage, scope = _parse_stage(token, text)
+        return _Rule(action, stage, scope, n=n)
+    if action == 'partition':
+        if len(parts) != 4:
+            raise FaultSpecError(
+                f'partition rule {text!r}: want partition:STAGE:N:M')
+        n, m = int(parts[2]), int(parts[3])
+        if n < 1 or m < 1:
+            raise FaultSpecError(
+                f'partition start/length must be >= 1, got {n}/{m}')
+        stage, scope = _parse_stage(parts[1], text)
+        return _Rule('partition', stage, scope, n=n, m=m)
     raise FaultSpecError(
         f'unknown serve fault action {action!r} in rule {text!r} '
-        "(know: stall, error, error_every)")
+        "(know: stall, error, error_every, crash, partition)")
 
 
 class FaultPlan:
@@ -90,30 +147,45 @@ class FaultPlan:
         if not self.rules:
             raise FaultSpecError(f'empty serve fault spec {spec!r}')
         self.sleep = sleep or _time.sleep
-        self.counts = {'stall': 0, 'error': 0}
+        self.counts = {'stall': 0, 'error': 0, 'crash': 0, 'partition': 0}
         self._lock = threading.Lock()
 
-    def on(self, stage):
+    def on(self, stage, scope=None):
         stall = 0.0
         for rule in self.rules:
-            if not rule.matches(stage):
+            if not rule.matches(stage, scope):
                 continue
             if rule.action == 'stall':
                 with self._lock:
                     self.counts['stall'] += 1
                 stall += rule.duration
-            else:
-                with self._lock:
-                    rule.seen += 1
-                    fire = (rule.seen == rule.n if rule.action == 'error'
-                            else rule.seen % rule.n == 0)
-                    if fire:
-                        self.counts['error'] += 1
+                continue
+            with self._lock:
+                rule.seen += 1
+                if rule.action == 'error':
+                    fire = rule.seen == rule.n
+                elif rule.action == 'error_every':
+                    fire = rule.seen % rule.n == 0
+                elif rule.action == 'crash':
+                    fire = rule.seen == rule.n
+                else:                      # partition: hits n..n+m-1
+                    fire = rule.n <= rule.seen < rule.n + rule.m
                 if fire:
-                    if stall:
-                        self.sleep(stall)
-                    raise RuntimeError(
-                        f'fault-injected error at serve stage {stage!r}')
+                    self.counts['error' if rule.action == 'error_every'
+                                else rule.action] += 1
+            if fire:
+                if stall:
+                    self.sleep(stall)
+                at = f'{stage!r}' if scope is None \
+                    else f'{stage!r}@{scope}'
+                if rule.action == 'crash':
+                    raise CrashInjected(
+                        f'fault-injected crash at serve stage {at}')
+                if rule.action == 'partition':
+                    raise PartitionInjected(
+                        f'fault-injected partition at serve stage {at}')
+                raise RuntimeError(
+                    f'fault-injected error at serve stage {at}')
         if stall:
             self.sleep(stall)
 
@@ -155,10 +227,10 @@ def injected():
     return _PLAN.injected() if _PLAN is not None else {}
 
 
-def on(stage):
+def on(stage, scope=None):
     """Pipeline hook (may sleep or raise). Free when no plan is set."""
     if _PLAN is not None:
-        _PLAN.on(stage)
+        _PLAN.on(stage, scope)
 
 
 if os.environ.get('MXNET_SERVE_FAULT_SPEC'):

@@ -1,0 +1,286 @@
+"""One replica of the replicated serving tier (``mx.serve``).
+
+A :class:`Replica` hosts a :class:`~mxnet_tpu.serve.decode.DecodeServer`
+behind an RPC endpoint speaking the kvstore transport
+(:class:`mxnet_tpu.kvstore.rpc.RpcServer`), so the router's heartbeats,
+``(client, seq)`` exactly-once dedup window and retry semantics are the
+SAME machinery the async parameter server uses — one wire protocol, one
+set of failure semantics, one set of env knobs.
+
+What the replica adds on top of the generic transport:
+
+* ``submit`` — run one generate request on the current model version
+  and reply with its tokens. The serve fault plan's ``submit`` stage
+  fires BEFORE the request is applied (a :class:`faults.CrashInjected`
+  kills the whole endpoint mid-request, exactly like a process kill),
+  and its ``reply`` stage fires after — losing the reply of an apply
+  that stands, which is what drives the dedup window in tests.
+* ``swap`` — zero-downtime hot-swap: build the new version's server,
+  prewarm every bucket (``warmup=True`` — the compiled-step discipline
+  means post-swap traffic must cause ZERO recompiles), atomically cut
+  new submissions over, then drain the old server under the bounded
+  ``MXNET_SERVE_DRAIN_S`` deadline.
+* ``crash()`` / ``restart()`` — chaos controls. ``crash`` severs every
+  live connection unreplied; ``restart`` brings up a NEW endpoint on
+  the same port carrying the replica's durable state (dedup window,
+  apply counters, heartbeat table) — the in-memory stand-in for the
+  persisted dedup log a real deployment keeps so exactly-once survives
+  a frontend restart.
+
+Locking: ``Replica._lock`` and the endpoint's transport lock are both
+level ``serve.router``-adjacent ``serve.replica`` in the lint hierarchy;
+neither is ever held across a model call or a socket write.
+"""
+
+import threading
+from concurrent.futures import TimeoutError as _FutTimeout
+
+from ..analysis import race as _race
+from ..kvstore.rpc import RpcServer
+from . import faults as _faults
+from .decode import DecodeServer
+from .errors import ServeError
+
+__all__ = ['Replica']
+
+
+class _ReplicaServer(RpcServer):
+    """The RPC endpoint: transport state machine from the base class,
+    serving semantics delegated to the owning :class:`Replica` (which
+    survives ``crash()``/``restart()`` cycles; this object does not)."""
+
+    LOCK_LEVEL = 'serve.replica'
+
+    def __init__(self, replica, port, bind_host='127.0.0.1'):
+        super().__init__(port, bind_host=bind_host)
+        self._replica = replica
+        self._counters.update({'applied': 0, 'swaps': 0})
+
+    # ------------------------------------------------------------- hooks
+    def _ping_extra(self):
+        # heartbeats double as the router's routing feed: piggyback the
+        # load snapshot so "least loaded" costs zero extra RPCs
+        _faults.on('heartbeat', scope=self._replica.name)
+        return self._replica.load()
+
+    def _pre_reply(self, header):
+        # reply-loss chaos only for applies — losing a ping reply tests
+        # nothing the transport doesn't already cover
+        if header.get('cmd') in ('submit', 'swap'):
+            _faults.on('reply', scope=self._replica.name)
+
+    # ---------------------------------------------------------- commands
+    def _handle_app(self, header, payload, peer):
+        cmd = header['cmd']
+        rep = self._replica
+        if cmd == 'submit':
+            try:
+                # fires BEFORE the apply: a crashed replica never
+                # half-applies, so failover to a peer stays exactly-once
+                _faults.on('submit', scope=rep.name)
+            except _faults.CrashInjected:
+                # a crash rule kills the whole endpoint, not just this
+                # request: sever every connection, die unreplied
+                self.crash()
+                raise
+            try:
+                tokens, version = rep.apply_submit(
+                    header['prompt'], int(header.get('max_new', 32)),
+                    header.get('deadline_ms'),
+                    float(header.get('timeout_s', 60.0)))
+            except ServeError as e:
+                # typed rejection: the router rehydrates the same
+                # ServeError subclass client-side from 'kind'
+                return {'ok': False, 'error': str(e),
+                        'kind': type(e).__name__}, b''
+            with self._lock:
+                self._counters['applied'] += 1
+            return {'ok': True, 'tokens': tokens,
+                    'version': version}, b''
+        if cmd == 'swap':
+            try:
+                info = rep.swap(header['version'])
+            except ServeError as e:
+                return {'ok': False, 'error': str(e),
+                        'kind': type(e).__name__}, b''
+            with self._lock:
+                self._counters['swaps'] += 1
+            reply = {'ok': True}
+            reply.update(info)
+            return reply, b''
+        if cmd == 'stats':
+            return {'ok': True, 'stats': rep.stats()}, b''
+        return super()._handle_app(header, payload, peer)
+
+
+class Replica:
+    """A named serving replica: one :class:`DecodeServer` per model
+    version behind a restartable RPC endpoint.
+
+    ``factory(version)`` builds the network for a version string — the
+    replica owns server construction (and therefore prewarming) so
+    :meth:`swap` can stage v2 completely before the cutover.
+    """
+
+    def __init__(self, name, factory, version='v1', host='127.0.0.1',
+                 port=0, server_kw=None, start=True):
+        self.name = name
+        self._factory = factory
+        self._host = host
+        self._server_kw = dict(server_kw or {})
+        self._lock = threading.Lock()
+        if _race.enabled():
+            self._lock = _race.tracked(self._lock, 'serve.replica')
+        self._version = version
+        self._swapping = False
+        self._ds = self._make_server(version)
+        self._rpc = _ReplicaServer(self, port, bind_host=host)
+        self._port = self._rpc.port     # stable across restart()
+        if start:
+            self._rpc.start()
+
+    def _make_server(self, version):
+        net = self._factory(version)
+        return DecodeServer(net, name=f'{self.name}:{version}',
+                            **self._server_kw)
+
+    # -------------------------------------------------------- properties
+    @property
+    def addr(self):
+        return (self._host, self._port)
+
+    @property
+    def port(self):
+        return self._port
+
+    @property
+    def server(self):
+        """The DecodeServer currently taking submissions."""
+        with self._lock:
+            return self._ds
+
+    @property
+    def version(self):
+        with self._lock:
+            return self._version
+
+    # ------------------------------------------------------------- serve
+    def apply_submit(self, prompt, max_new, deadline_ms, timeout_s):
+        """Apply one generate request on the current version; returns
+        ``(tokens, version)``. Blocking — runs on the per-connection
+        handler thread, never on the scheduler."""
+        from .errors import ServerClosed
+        with self._lock:
+            ds, version = self._ds, self._version
+        try:
+            fut = ds.submit(list(prompt), max_new_tokens=max_new,
+                            deadline_ms=deadline_ms)
+        except ServerClosed:
+            # lost the cutover race: the server snapshotted above began
+            # draining between snapshot and submit. The new version is
+            # already installed — retry there once (zero-downtime means
+            # no request may fail BECAUSE of a swap)
+            with self._lock:
+                ds2, version = self._ds, self._version
+            if ds2 is ds:
+                raise
+            ds = ds2
+            fut = ds.submit(list(prompt), max_new_tokens=max_new,
+                            deadline_ms=deadline_ms)
+        try:
+            tokens = fut.result(timeout=timeout_s)
+        except (_FutTimeout, TimeoutError):
+            raise ServeError(
+                f'{self.name}: request still pending after '
+                f'{timeout_s:g}s') from None
+        return [int(t) for t in tokens], version
+
+    def load(self):
+        """Cheap load snapshot piggybacked on every heartbeat reply."""
+        with self._lock:
+            ds, version, swapping = self._ds, self._version, self._swapping
+        st = ds.stats()
+        return {'load': st['queued'] + st['active_slots'],
+                'queued': st['queued'],
+                'active_slots': st['active_slots'],
+                'slots': st['slots'],
+                'version': version,
+                'swapping': swapping}
+
+    # ---------------------------------------------------------- hot-swap
+    def swap(self, version):
+        """Zero-downtime cutover to ``version``: stage the new server
+        fully prewarmed, atomically redirect submissions, drain the old
+        server under the bounded ``MXNET_SERVE_DRAIN_S`` deadline.
+        Requests in flight on the old version finish there; post-swap
+        traffic hits only prewarmed buckets (zero recompiles)."""
+        with self._lock:
+            if self._swapping:
+                raise ServeError(
+                    f'{self.name}: swap already in progress')
+            if version == self._version:
+                return {'version': version, 'swapped': False}
+            self._swapping = True
+        try:
+            # stage: build + prewarm OUTSIDE the lock (compiles are
+            # slow; v1 keeps serving the whole time)
+            new = self._make_server(version)
+            with self._lock:
+                old, self._ds = self._ds, new
+                self._version = version
+            # drain: bounded — a wedged v1 step cannot block the swap
+            old.close(drain=True)
+            return {'version': version, 'swapped': True,
+                    'prewarm_compiles': new.compile_baseline}
+        finally:
+            with self._lock:
+                self._swapping = False
+
+    # ------------------------------------------------------------- chaos
+    def crash(self):
+        """Kill the RPC endpoint abruptly: connections severed
+        unreplied, port released. Peers see a dead process."""
+        self._rpc.crash()
+
+    def restart(self):
+        """New endpoint on the same port, carrying the replica's
+        durable state — the dedup window, apply counters, heartbeat
+        table and tombstones are object-shared with the dead server
+        (in-memory analog of the persisted dedup log that makes
+        exactly-once survive a real restart)."""
+        old = self._rpc
+        new = _ReplicaServer(self, self._port, bind_host=self._host)
+        new._dedup = old._dedup
+        new._dedup_order = old._dedup_order
+        new._counters = old._counters
+        new._last_seen = old._last_seen
+        new._tombstones = old._tombstones
+        self._rpc = new
+        new.start()
+        return self
+
+    # ------------------------------------------------------------- admin
+    def stats(self):
+        with self._lock:
+            ds, version = self._ds, self._version
+        srv = self._rpc
+        with srv._lock:
+            counters = dict(srv._counters)
+        return {'name': self.name, 'version': version,
+                'addr': list(self.addr), 'counters': counters,
+                'server': ds.stats()}
+
+    def close(self, drain=True):
+        self._rpc.stop()
+        self.server.close(drain=drain)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=exc[0] is None)
+        return False
+
+    def __repr__(self):
+        return (f'Replica({self.name!r}, version={self.version!r}, '
+                f'addr={self._host}:{self._port})')

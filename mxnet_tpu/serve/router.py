@@ -1,0 +1,412 @@
+"""Self-healing router over N serving replicas (``mx.serve``).
+
+The router is the client side of the replicated tier: it spreads
+generate traffic over :class:`~mxnet_tpu.serve.replica.Replica`
+endpoints and keeps serving through replica death, network partitions
+and rolling model upgrades. It is built entirely on the kvstore
+transport (:class:`mxnet_tpu.kvstore.rpc.RpcClient`), so its failure
+semantics are the ones ``dist_async`` already proved out:
+
+* **Heartbeat ejection** — :meth:`heartbeat_once` pings every replica;
+  a replica unseen for ``MXNET_KVSTORE_DEADLINE_S`` seconds (the same
+  liveness deadline the parameter server uses) is ejected from
+  routing. A later successful ping re-admits it automatically — chaos
+  recovery needs no operator.
+* **Exactly-once failover** — every request carries one stable
+  ``(client, seq)`` identity for its whole life. Retries to the same
+  replica whose reply was lost hit the server's dedup window and get
+  the cached reply (apply count stays 1). On failover the SAME
+  identity goes to the next-best replica; the fault plan's ``crash``
+  stage fires before the apply, so a crashed replica never
+  half-applied and the cluster-wide apply count stays exactly N.
+  (A replica that applied but became unreachable re-executes on a
+  peer — duplicate *compute*, never duplicate state, since replicas
+  share no mutable state and dedup is per-endpoint.)
+* **Least-loaded routing** — replicas piggyback ``queued +
+  active_slots`` on every heartbeat reply, so routing pressure follows
+  real occupancy with zero extra RPCs.
+* **Hedged retry** — with ``MXNET_SERVE_HEDGE_MS`` set, the first
+  attempt is given only that budget; on expiry the request fails over
+  (same identity) without ejecting the slow replica. Tail latency is
+  bounded by the hedge, not by the slowest replica.
+* **Zero-downtime hot-swap** — :meth:`hot_swap` upgrades replicas one
+  at a time (the rest keep serving); each stages and prewarms the new
+  version before its atomic cutover, so the swap causes zero dropped
+  requests and zero post-swap recompiles.
+
+Locking: the single router lock (level ``serve.router``, above the
+per-replica levels) guards the health table and counters and is NEVER
+held across an RPC — selection snapshots under the lock, network I/O
+happens outside it. ``clock`` is injectable so ejection deadlines are
+driven by fake clocks in tests, not wall-time sleeps.
+"""
+
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+from ..analysis import race as _race
+from ..kvstore.dist_async import _kv_deadline_s
+from ..kvstore.rpc import RpcClient
+from .errors import (DeadlineExceeded, NoHealthyReplicas, PagesExhausted,
+                     ServeError, ServerClosed, ServerOverloaded)
+
+__all__ = ['Router']
+
+# replica-side 'kind' -> client-side exception class (typed rejections
+# survive the wire)
+_KINDS = {c.__name__: c for c in
+          (ServeError, ServerOverloaded, PagesExhausted,
+           DeadlineExceeded, ServerClosed)}
+
+_POOL_MAX = 4       # idle channels kept per replica
+
+
+def _hedge_s(override_ms=None):
+    """Hedge budget in seconds; 0 disables (``MXNET_SERVE_HEDGE_MS``)."""
+    if override_ms is None:
+        try:
+            override_ms = float(os.environ.get('MXNET_SERVE_HEDGE_MS',
+                                               '0'))
+        except ValueError:
+            override_ms = 0.0
+    return max(0.0, float(override_ms)) / 1e3
+
+
+class _ReplicaState:
+    """Router-side view of one replica (guarded by the router lock)."""
+
+    __slots__ = ('name', 'host', 'port', 'healthy', 'last_seen', 'load',
+                 'version', 'swapping', 'pool', 'routed', 'ejections',
+                 'readmissions')
+
+    def __init__(self, name, host, port, now):
+        self.name = name
+        self.host, self.port = host, int(port)
+        self.healthy = True
+        self.last_seen = now
+        self.load = 0
+        self.version = None
+        self.swapping = False
+        self.pool = []              # idle RpcClient channels
+        self.routed = 0
+        self.ejections = 0
+        self.readmissions = 0
+
+
+class Router:
+    """Route generate requests over replicas; heal around failures.
+
+    ``replicas`` is a mapping ``name -> (host, port)`` or an iterable
+    of :class:`Replica` objects (their ``name``/``addr`` are read once;
+    the router holds addresses, never replica references — it must work
+    across process boundaries).
+    """
+
+    def __init__(self, replicas, client=None, rank=0,
+                 clock=time.monotonic, deadline_s=None, hedge_ms=None,
+                 rpc_deadline_s=None, ping_timeout_s=0.5,
+                 heartbeat_s=None, start=True):
+        if not isinstance(replicas, dict):
+            replicas = {r.name: r.addr for r in replicas}
+        if not replicas:
+            raise ValueError('Router needs at least one replica')
+        self._clock = clock
+        self._rank = int(rank)
+        self._client = client if client is not None \
+            else f'router-{os.getpid()}-{id(self):x}'
+        self._deadline = float(_kv_deadline_s()
+                               if deadline_s is None else deadline_s)
+        self._hedge = _hedge_s(hedge_ms)
+        self._rpc_deadline = float(os.environ.get(
+            'MXNET_KVSTORE_RPC_DEADLINE_S', '60')) \
+            if rpc_deadline_s is None else float(rpc_deadline_s)
+        self._ping_timeout = float(ping_timeout_s)
+        self._lock = threading.Lock()
+        if _race.enabled():
+            self._lock = _race.tracked(self._lock, 'serve.router')
+        now = clock()
+        self._replicas = {name: _ReplicaState(name, host, port, now)
+                          for name, (host, port) in replicas.items()}
+        self._seq = 0
+        self._counters = {'requests': 0, 'completed': 0, 'rejected': 0,
+                          'failovers': 0, 'hedges': 0, 'ejections': 0,
+                          'readmissions': 0, 'swaps': 0}
+        self._transport_stats = {'retries': 0, 'redials': 0,
+                                 'giveups': 0}
+        self._closed = False
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        if start:
+            interval = heartbeat_s if heartbeat_s is not None \
+                else max(0.05, min(1.0, self._deadline / 3.0))
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, args=(float(interval),),
+                daemon=True, name='serve-router-heartbeat')
+            self._hb_thread.start()
+
+    # ---------------------------------------------------------- channels
+    def _borrow(self, st):
+        with self._lock:
+            if st.pool:
+                return st.pool.pop()
+        return RpcClient(st.host, st.port, label=f'replica {st.name}',
+                         what='serve', stats=self._transport_stats)
+
+    def _return(self, st, chan):
+        with self._lock:
+            if not self._closed and len(st.pool) < _POOL_MAX:
+                st.pool.append(chan)
+                return
+        chan.close()
+
+    def _states(self):
+        with self._lock:
+            return list(self._replicas.values())
+
+    # --------------------------------------------------------- heartbeat
+    def heartbeat_once(self):
+        """One sweep: ping every replica, refresh loads, eject the
+        unseen, re-admit the recovered. Returns the list of
+        ``('eject'|'readmit', name)`` events — deterministic when
+        driven manually with an injectable clock."""
+        events = []
+        for st in self._states():
+            chan = self._borrow(st)
+            reply = None
+            try:
+                # attempts=2: a pooled channel whose socket died with
+                # the replica must get one redial before the ping
+                # counts as a miss
+                reply, _ = chan.call(
+                    {'cmd': 'ping', 'rank': self._rank},
+                    attempts=2, deadline_s=self._ping_timeout)
+            except (ConnectionError, RuntimeError, OSError):
+                chan.close()
+                chan = None
+            if chan is not None:
+                self._return(st, chan)
+            now = self._clock()
+            with self._lock:
+                if reply is not None:
+                    st.last_seen = now
+                    st.load = int(reply.get('load', 0))
+                    st.version = reply.get('version', st.version)
+                    st.swapping = bool(reply.get('swapping', False))
+                    if not st.healthy:
+                        st.healthy = True
+                        st.readmissions += 1
+                        self._counters['readmissions'] += 1
+                        events.append(('readmit', st.name))
+                elif st.healthy and now - st.last_seen > self._deadline:
+                    st.healthy = False
+                    st.ejections += 1
+                    self._counters['ejections'] += 1
+                    events.append(('eject', st.name))
+        return events
+
+    def _hb_loop(self, interval):
+        while not self._hb_stop.wait(interval):
+            try:
+                self.heartbeat_once()
+            except Exception:
+                # heartbeats must never kill the router; the next
+                # sweep retries
+                pass
+
+    # ----------------------------------------------------------- routing
+    def _pick(self, exclude):
+        with self._lock:
+            if self._closed:
+                raise ServerClosed('router closed')
+            cands = [st for st in self._replicas.values()
+                     if st.healthy and st.name not in exclude]
+            if not cands:
+                raise NoHealthyReplicas(
+                    f'no healthy replica to route to '
+                    f'(cluster size {len(self._replicas)}, '
+                    f'tried {sorted(exclude) or "none"})')
+            return min(cands, key=lambda st: (st.load, st.name))
+
+    def generate(self, prompt, max_new_tokens=32, deadline_ms=None):
+        """Route one generate request; blocking; returns its tokens.
+
+        The ``(client, seq)`` identity is allocated once and reused
+        verbatim across every retry, hedge and failover attempt — that
+        is what makes the replicas' dedup windows see retried work as
+        the same request."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._counters['requests'] += 1
+        header = {'cmd': 'submit',
+                  'prompt': [int(t) for t in prompt],
+                  'max_new': int(max_new_tokens),
+                  'client': self._client, 'seq': seq,
+                  'rank': self._rank,
+                  'timeout_s': self._rpc_deadline}
+        if deadline_ms is not None:
+            header['deadline_ms'] = float(deadline_ms)
+        tried = set()
+        hedging = self._hedge > 0
+        retried_full = False
+        last_exc = None
+        while True:
+            try:
+                st = self._pick(tried)
+            except NoHealthyReplicas:
+                if hedging and not retried_full:
+                    # everything was tried on the short hedge leash;
+                    # one more pass at full deadline before giving up
+                    # (a slow-but-alive cluster must not look dead)
+                    retried_full = True
+                    hedging = False
+                    tried = set()
+                    continue
+                if last_exc is not None:
+                    raise NoHealthyReplicas(
+                        f'request (client={self._client!r}, seq={seq}) '
+                        f'exhausted every healthy replica; last '
+                        f'transport error: {last_exc}') from last_exc
+                raise
+            chan = self._borrow(st)
+            hedged = hedging and not tried
+            try:
+                if hedged:
+                    # first attempt on a short leash: a slow replica
+                    # costs hedge_ms, then the SAME identity fails
+                    # over — the dedup window absorbs any late apply
+                    reply, _ = chan.call(header, attempts=1,
+                                         deadline_s=self._hedge)
+                else:
+                    reply, _ = chan.call(
+                        header, deadline_s=self._rpc_deadline)
+            except ConnectionError as e:
+                chan.close()
+                last_exc = e
+                tried.add(st.name)
+                with self._lock:
+                    if hedged:
+                        self._counters['hedges'] += 1
+                    else:
+                        self._counters['failovers'] += 1
+                        # data-path giveup: stop routing new work here
+                        # until a heartbeat proves the replica back
+                        if st.healthy:
+                            st.healthy = False
+                            st.ejections += 1
+                            self._counters['ejections'] += 1
+                continue
+            except RuntimeError as e:
+                # typed application rejection — not a replica failure:
+                # no failover (the request itself was refused)
+                self._return(st, chan)
+                with self._lock:
+                    self._counters['rejected'] += 1
+                kind = getattr(e, 'reply', {}).get('kind')
+                raise _KINDS.get(kind, ServeError)(str(e)) from None
+            self._return(st, chan)
+            with self._lock:
+                st.routed += 1
+                self._counters['completed'] += 1
+            return reply['tokens']
+
+    def submit(self, prompt, **kw):
+        """Async :meth:`generate`: returns a Future resolving to the
+        token list (mirrors ``DecodeServer.submit``)."""
+        fut = Future()
+
+        def run():
+            if not fut.set_running_or_notify_cancel():
+                return
+            try:
+                fut.set_result(self.generate(prompt, **kw))
+            except BaseException as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True,
+                         name='serve-router-submit').start()
+        return fut
+
+    # ---------------------------------------------------------- hot-swap
+    def hot_swap(self, version, deadline_s=None):
+        """Rolling zero-downtime upgrade: swap one replica at a time so
+        the rest keep serving. Returns ``name -> swap info`` (or the
+        error for replicas that could not swap). Swaps are slow (full
+        prewarm) — the per-call deadline defaults high."""
+        budget = float(deadline_s) if deadline_s is not None \
+            else max(self._rpc_deadline, 600.0)
+        results = {}
+        for st in self._states():
+            chan = self._borrow(st)
+            try:
+                reply, _ = chan.call(
+                    {'cmd': 'swap', 'version': version,
+                     'rank': self._rank},
+                    deadline_s=budget)
+            except (ConnectionError, RuntimeError) as e:
+                if isinstance(e, RuntimeError) \
+                        and not isinstance(e, ConnectionError):
+                    self._return(st, chan)
+                else:
+                    chan.close()
+                results[st.name] = {'ok': False, 'error': str(e)}
+                continue
+            self._return(st, chan)
+            with self._lock:
+                st.version = reply.get('version', version)
+                self._counters['swaps'] += 1
+            results[st.name] = {k: v for k, v in reply.items()
+                                if k != 'ok'}
+        return results
+
+    # ------------------------------------------------------------- admin
+    def health(self):
+        """Snapshot of the routing table: name -> liveness + load."""
+        now = self._clock()
+        with self._lock:
+            return {st.name: {'healthy': st.healthy,
+                              'age_s': max(0.0, now - st.last_seen),
+                              'load': st.load,
+                              'version': st.version,
+                              'swapping': st.swapping,
+                              'routed': st.routed,
+                              'ejections': st.ejections,
+                              'readmissions': st.readmissions}
+                    for st in self._replicas.values()}
+
+    def stats(self):
+        with self._lock:
+            out = dict(self._counters)
+            out['replicas'] = len(self._replicas)
+            out['healthy'] = sum(1 for st in self._replicas.values()
+                                 if st.healthy)
+            out['transport'] = dict(self._transport_stats)
+        return out
+
+    def close(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+        with self._lock:
+            self._closed = True
+            chans = [c for st in self._replicas.values()
+                     for c in st.pool]
+            for st in self._replicas.values():
+                st.pool = []
+        for c in chans:
+            c.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __repr__(self):
+        with self._lock:
+            n = len(self._replicas)
+            h = sum(1 for st in self._replicas.values() if st.healthy)
+        return f'Router({h}/{n} healthy, client={self._client!r})'

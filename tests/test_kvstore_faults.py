@@ -387,3 +387,28 @@ def test_barrier_deadline_bounds_missing_peer(async_store):
     # rather than sailing through on the stale count
     with pytest.raises(RuntimeError, match='barrier timeout'):
         kv.barrier()
+
+
+def test_close_idempotent_and_gc_safe(async_store):
+    """Satellite (ISSUE 12): ``KVStoreDistAsync.close`` is idempotent
+    and shutdown-safe — a second close, a close racing an already-dead
+    heartbeat thread, and a ``__del__`` after close must all return
+    quietly (router/replica teardown closes many stores at GC time and
+    none may throw)."""
+    kv = async_store()
+    kv.init('w', mx.np.zeros((2,)))
+    # kill the heartbeat pinger out from under close() — the GC-timing
+    # stand-in for interpreter teardown reaping daemon threads first
+    hb = kv._hb_thread
+    if hb is not None:
+        kv._hb_stop.set()
+        hb.join(timeout=10)
+        assert not hb.is_alive()
+    kv.close()
+    assert kv._closed
+    kv.close()                  # second close: no-op, no raise
+    kv.__del__()                # GC after close: no raise
+    # and a store that never connected closes cleanly too
+    kv2 = async_store()
+    kv2.close()
+    kv2.close()

@@ -15,10 +15,12 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # The ISSUE-named threaded suites: bulked-eager cross-thread settles,
-# thread-safe hybridized inference, and the fault-injected dist_async
-# transport (PR 4 harness supplies deterministic scheduling pressure).
+# thread-safe hybridized inference, the fault-injected dist_async
+# transport (PR 4 harness supplies deterministic scheduling pressure),
+# and the replicated serving tier (router/replica locks + the RPC
+# endpoint's handler threads, ISSUE 12).
 SUITES = ('test_bulk.py', 'test_threadsafe_inference.py',
-          'test_kvstore_faults.py')
+          'test_kvstore_faults.py', 'test_serve_router.py')
 
 
 @pytest.mark.parametrize('suite', SUITES)

@@ -217,6 +217,34 @@ def test_decode_close_without_drain(lm):
         ds.submit([1], max_new_tokens=1)
 
 
+def test_close_drain_deadline_force_fails_residual(lm):
+    """Satellite (ISSUE 12): a wedged model step cannot block shutdown
+    forever — ``close(drain=True)`` force-fails residual requests with
+    ``ServerClosed`` once the ``MXNET_SERVE_DRAIN_S`` deadline expires,
+    so every submitted future resolves. The wedge is an Event-driven
+    injected sleeper (no wall-clock races)."""
+    entered, wedge = threading.Event(), threading.Event()
+
+    def sleeper(_d):
+        entered.set()
+        wedge.wait(30)
+
+    ds = _server(lm, start=True)
+    serve.faults.configure('stall:step:5s', sleep=sleeper)
+    try:
+        fut = ds.submit([1, 2, 3], max_new_tokens=8)
+        assert entered.wait(30)     # scheduler wedged inside its step
+        ds.close(drain=True, timeout=0.2)
+        assert ds.closed
+        with pytest.raises(ServerClosed,
+                           match='drain deadline exceeded'):
+            fut.result(timeout=1)
+    finally:
+        wedge.set()                 # release the wedged scheduler
+        serve.faults.clear()
+        ds.close()
+
+
 def test_threaded_decode_server(lm):
     """Real scheduler thread, concurrent submitters — rerun under
     MXNET_RACE_CHECK=1 via test_serve.py's child-pytest soak."""

@@ -1,0 +1,268 @@
+"""Replicated serving tier: router over N replicas (ISSUE 12).
+
+The acceptance criteria live here, driven deterministically — fault
+rules fire on exact hit counts, ejection deadlines run on a fake clock
+with manually-driven heartbeat sweeps, and every cross-thread wait is
+a Future/Event, never a sleep:
+
+* chaos: 3 replicas, one crashed mid-run by a count-based fault rule —
+  zero failed requests, token parity with an unfaulted reference, the
+  cluster-wide apply count exactly N (the crash fires BEFORE the
+  apply), ejection within the liveness deadline, re-admission after
+  restart;
+* exactly-once: lost replies force same-identity retries into the
+  replica's dedup window — applies stay N while replays climb;
+* hedged retry: a stalled replica costs the hedge budget, not the full
+  deadline, and is NOT ejected for being slow;
+* hot-swap under load: rolling upgrade drops zero requests and causes
+  zero post-prewarm recompiles.
+"""
+
+import threading
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo.llama import llama_tiny
+from mxnet_tpu.serve import NoHealthyReplicas, Replica, Router, ServeError
+from mxnet_tpu.serve import faults as sfaults
+
+SERVER_KW = dict(slots=2, max_length=32, page_size=4, prefill_chunk=8)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _factory(version):
+    """Seeded per version: every replica of a version holds IDENTICAL
+    weights, so token parity across failover is a hard assertion."""
+    mx.random.seed({'v1': 7, 'v2': 11}.get(version, 13))
+    net = llama_tiny()
+    net.initialize()
+    net(mx.np.zeros((1, 2)))
+    return net
+
+
+@pytest.fixture(scope='module')
+def replicas():
+    reps = [Replica(f'r{i}', _factory, server_kw=SERVER_KW)
+            for i in range(3)]
+    yield reps
+    sfaults.clear()
+    for rep in reps:
+        try:
+            rep.close(drain=False)
+        except Exception:
+            pass
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    sfaults.clear()
+
+
+def _router(replicas, **kw):
+    kw.setdefault('start', False)
+    kw.setdefault('rpc_deadline_s', 20.0)
+    return Router(replicas, **kw)
+
+
+def _applied(replicas):
+    return sum(r.stats()['counters']['applied'] for r in replicas)
+
+
+# ------------------------------------------------------- basic routing
+def test_least_loaded_routing_and_load_feed(replicas):
+    """Heartbeats piggyback load; routing follows it."""
+    with _router(replicas) as r:
+        assert r.heartbeat_once() == []          # all healthy, no events
+        h = r.health()
+        assert set(h) == {'r0', 'r1', 'r2'}
+        assert all(v['healthy'] and v['load'] == 0 for v in h.values())
+        toks = r.generate([1, 2, 3], max_new_tokens=4)
+        assert len(toks) == 4
+        assert r.stats()['completed'] == 1
+
+
+def test_typed_rejection_no_failover(replicas):
+    """An application-level rejection surfaces as the SAME typed error
+    client-side (rehydrated from reply['kind']) and is never treated
+    as a replica failure — no failover, no ejection."""
+    with _router(replicas) as r:
+        before = r.stats()
+        with pytest.raises(ServeError, match='exceeds the cache length'):
+            r.generate(list(range(1, 41)), max_new_tokens=4)
+        st = r.stats()
+        assert st['rejected'] == before['rejected'] + 1
+        assert st['failovers'] == before['failovers']
+        assert st['healthy'] == 3                # nobody ejected
+
+
+# ------------------------------------------------- chaos: crash + heal
+def test_crash_midrun_exactly_once_and_readmission(replicas):
+    """THE chaos acceptance test: r0 is killed by a count-based fault
+    rule mid-run. Zero failed requests, token parity with the
+    unfaulted reference, applies sum to exactly N, r0 is ejected and
+    then re-admitted after restart."""
+    n = 12
+    prompts = [[1 + i % 3, 2 + i % 5, 3] for i in range(n)]
+    # unfaulted reference tokens straight from one replica's server
+    # (all replicas hold identical v1 weights)
+    ref = [replicas[1].server.generate_sync(p, max_new_tokens=4)
+           for p in prompts]
+    base_applied = _applied(replicas)
+    clock = _FakeClock()
+    # ties in the load table break by name -> r0 takes traffic until
+    # its 3rd submit, where the rule kills the endpoint BEFORE apply
+    sfaults.configure('crash:submit@r0:3')
+    with _router(replicas, clock=clock, deadline_s=10.0,
+                 rpc_deadline_s=3.0) as r:
+        got = [r.generate(p, max_new_tokens=4) for p in prompts]
+        assert got == ref                        # zero failed, parity
+        st = r.stats()
+        assert st['completed'] == n
+        assert st['failovers'] == 1              # exactly the crashed one
+        assert st['ejections'] == 1
+        assert not r.health()['r0']['healthy']   # data-path ejection
+        # the crashed submit never applied; its failover applied once
+        assert _applied(replicas) - base_applied == n
+        assert sfaults.injected()['crash'] == 1
+        # heartbeat-based accounting on the fake clock: r0 stays
+        # ejected while dead, within-deadline sweeps emit no events
+        assert r.heartbeat_once() == []
+        clock.advance(11.0)
+        assert r.heartbeat_once() == []          # already ejected
+        # recovery: restart -> the NEXT sweep re-admits, no operator
+        replicas[0].restart()
+        assert r.heartbeat_once() == [('readmit', 'r0')]
+        assert r.health()['r0']['healthy']
+        assert r.stats()['readmissions'] == 1
+        # the revived replica serves again (durable counters intact)
+        assert r.generate(prompts[0], max_new_tokens=4) == ref[0]
+
+
+def test_heartbeat_ejection_within_deadline_fake_clock(replicas):
+    """Ejection is driven purely by last-seen age vs the liveness
+    deadline — deterministic under a fake clock, no wall-time."""
+    clock = _FakeClock()
+    with _router(replicas, clock=clock, deadline_s=5.0) as r:
+        assert r.heartbeat_once() == []
+        replicas[2].crash()
+        clock.advance(4.0)
+        assert r.heartbeat_once() == []          # unseen, within deadline
+        assert r.health()['r2']['healthy']
+        clock.advance(1.5)                       # age 5.5 > 5.0
+        assert r.heartbeat_once() == [('eject', 'r2')]
+        assert not r.health()['r2']['healthy']
+        replicas[2].restart()
+        assert r.heartbeat_once() == [('readmit', 'r2')]
+
+
+def test_all_replicas_down_raises_no_healthy(replicas):
+    """With nothing to route to, the request fails with the typed
+    terminal error (and quickly — bounded by the RPC deadline)."""
+    # a router over one address nobody listens on
+    import socket
+    from contextlib import closing
+    with closing(socket.socket()) as s:
+        s.bind(('127.0.0.1', 0))
+        dead_port = s.getsockname()[1]
+    r = Router({'ghost': ('127.0.0.1', dead_port)}, start=False,
+               rpc_deadline_s=0.5)
+    with pytest.raises(NoHealthyReplicas):
+        r.generate([1, 2], max_new_tokens=2)
+    r.close()
+
+
+# --------------------------------------------- exactly-once dedup path
+def test_lost_reply_retry_hits_dedup_window(replicas):
+    """Satellite (3): replies are lost AFTER the apply; the channel's
+    same-identity retries land in the replica's (client, seq) dedup
+    window. Applies stay exactly N while replays climb — and the
+    replayed replies carry the original tokens (parity)."""
+    rep = replicas[1]
+    prompts = [[5, 6 + i] for i in range(4)]
+    ref = [rep.server.generate_sync(p, max_new_tokens=3)
+           for p in prompts]
+    base = rep.stats()['counters']
+    sfaults.configure('error_every:reply@r1:2')  # every 2nd reply lost
+    with _router([rep]) as r:
+        got = [r.generate(p, max_new_tokens=3) for p in prompts]
+    sfaults.clear()
+    assert got == ref                            # parity incl. replays
+    after = rep.stats()['counters']
+    assert after['applied'] - base['applied'] == len(prompts)
+    # reply events on r1: req1 ok, req2 LOST, replay ok, req3 LOST,
+    # replay ok, req4 LOST, replay ok -> 3 lost replies, 3 replays,
+    # and STILL only 4 applies: that is the dedup window working
+    assert after['dedup_replays'] - base['dedup_replays'] == 3
+    assert sfaults.injected() == {}              # plan cleared
+
+
+# ---------------------------------------------------------- hedged retry
+def test_hedged_retry_bounds_tail_without_ejection(replicas):
+    """A stalled replica costs the hedge budget; the request fails
+    over with the same identity and the slow replica is NOT ejected
+    (slow is not dead)."""
+    sfaults.configure('stall:submit@r0:1s')      # r0 slow, not down
+    with _router(replicas, hedge_ms=200.0) as r:
+        r.heartbeat_once()
+        toks = r.generate([9, 8, 7], max_new_tokens=3)
+        assert len(toks) == 3
+        st = r.stats()
+        assert st['hedges'] == 1
+        assert st['failovers'] == 0
+        assert st['ejections'] == 0
+        assert r.health()['r0']['healthy']       # hedging never ejects
+
+
+# ------------------------------------------------------------- hot-swap
+def test_hot_swap_under_load_zero_drops_zero_recompiles(replicas):
+    """Tentpole (d): rolling v1->v2 upgrade under live traffic. Every
+    in-flight and during-swap request completes (zero drops), each
+    replica prewarmed v2 before cutover, and post-swap traffic causes
+    ZERO recompiles."""
+    stop = threading.Event()
+    futs, lock = [], threading.Lock()
+    with _router(replicas) as r:
+
+        def pump():
+            while not stop.is_set():
+                f = r.submit([2, 4, 6], max_new_tokens=3)
+                with lock:
+                    futs.append(f)
+                f.result(timeout=60)             # pace: one in flight
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        info = r.hot_swap('v2')
+        stop.set()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert all(v.get('swapped') for v in info.values()), info
+        # zero drops: every submitted request resolved with tokens
+        with lock:
+            results = [f.result(timeout=60) for f in futs]
+        assert results and all(len(toks) == 3 for toks in results)
+        # every replica cut over; v2 serves with identical weights
+        # everywhere, so post-swap outputs agree across replicas
+        v2ref = replicas[0].server.generate_sync([2, 4, 6],
+                                                 max_new_tokens=3)
+        for rep in replicas:
+            assert rep.version == 'v2'
+            s = rep.stats()['server']
+            assert s['recompiles'] == 0          # prewarm covered all
+            baseline = s['compile_count']
+            assert r.generate([2, 4, 6], max_new_tokens=3) == v2ref
+            assert rep.stats()['server']['compile_count'] == baseline
+        r.heartbeat_once()                       # refresh piggyback info
+        assert all(v['version'] == 'v2' for v in r.health().values())

@@ -28,9 +28,21 @@ Output: one JSON document (BENCH_* style — ``metric``/``value``/
 ``SERVE_r02.json``; the r01 artifact is the dense pre-paging baseline)
 and echoed as a single JSON line on stdout.
 
+A third mode benches the replicated tier (``--replicas N``): a
+:class:`Router` over N :class:`Replica` endpoints runs the llama decode
+workload three times — one replica (the scaling baseline), all N, and
+all N under chaos (``--chaos``, default: a count-based fault rule kills
+one replica's endpoint mid-run; the router ejects it, fails the
+in-flight request over with its original ``(client, seq)`` identity,
+and re-admits the replica after restart). The artifact
+(``SERVE_r03.json``) states throughput scaling, the chaos p99 bound and
+the hard invariant ``failed == 0``.
+
 Run:
   python tools/serve_bench.py                 # full (SERVE_r02.json)
   python tools/serve_bench.py --smoke         # tier-1 smoke (seconds)
+  python tools/serve_bench.py --replicas 3    # replicated (SERVE_r03)
+  python tools/serve_bench.py --smoke --replicas 2   # tier-1 smoke
 """
 
 import argparse
@@ -158,18 +170,171 @@ def bench_llama(args):
     return doc
 
 
+def bench_replicated(args):
+    import random
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler, serve
+    from mxnet_tpu.serve import faults as sfaults
+    from mxnet_tpu.gluon.model_zoo.llama import llama_tiny
+
+    def factory(version):
+        mx.random.seed(0)               # identical weights per replica
+        net = llama_tiny()
+        net.initialize()
+        net(mx.np.zeros((1, 2)))
+        return net
+
+    kw = dict(slots=args.slots, max_length=args.max_length,
+              page_size=args.page_size, num_pages=args.num_pages,
+              prefill_chunk=args.prefill_chunk)
+    t0 = time.perf_counter()
+    reps = [serve.Replica(f'r{i}', factory, server_kw=kw)
+            for i in range(args.replicas)]
+    warm_s = time.perf_counter() - t0
+
+    vocab = llama_tiny().cfg.vocab_size
+    rnd = random.Random(0)
+    prompts = []
+    for _ in range(args.prompts):
+        plen = rnd.randint(2, args.max_prompt)
+        prompts.append([rnd.randrange(vocab) for _ in range(plen)])
+
+    def drive(router, tag):
+        """Closed-loop load: C workers each issue sequential requests
+        until the prompt list drains. Returns throughput + latency
+        percentiles + the FAILED count (the invariant is 0)."""
+        lat, errs, ntok = [], [], [0]
+        nxt = [0]
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    if nxt[0] >= len(prompts):
+                        return
+                    p = prompts[nxt[0]]
+                    nxt[0] += 1
+                t1 = time.perf_counter()
+                try:
+                    toks = router.generate(
+                        p, max_new_tokens=args.new_tokens)
+                except Exception as e:   # noqa: BLE001 - counted
+                    with lock:
+                        errs.append(repr(e))
+                    continue
+                with lock:
+                    lat.append(time.perf_counter() - t1)
+                    ntok[0] += len(toks)
+
+        start = time.perf_counter()
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        wall = time.perf_counter() - start
+        pct = profiler.percentiles(lat)
+        return {'phase': tag,
+                'tok_s': round(ntok[0] / wall, 2),
+                'completed': len(lat),
+                'failed': len(errs),
+                'errors': errs[:3],
+                'wall_s': round(wall, 2),
+                'latency_ms': {str(q): round(v * 1e3, 1)
+                               for q, v in pct.items()}}
+
+    # phase 1 — one replica: the scaling baseline
+    with serve.Router([reps[0]], start=False) as router:
+        single = drive(router, 'single')
+
+    # phase 2 — all N replicas, fault-free
+    with serve.Router(reps, start=False) as router:
+        router.heartbeat_once()
+        replicated = drive(router, f'replicated_x{args.replicas}')
+
+    # phase 3 — all N, one replica killed mid-run by a count-based
+    # fault rule (deterministic, not a timer race); heartbeats run so
+    # ejection and re-admission happen the production way
+    victim = 'r0'
+    kill_at = max(2, (args.prompts // max(1, args.replicas)) // 2)
+    spec = args.chaos or f'crash:submit@{victim}:{kill_at}'
+    chaos = None
+    if spec != 'none':
+        sfaults.configure(spec)
+        # rpc_deadline bounds the failover tail: the one request caught
+        # on the dying replica costs at most this before it re-routes
+        with serve.Router(reps, heartbeat_s=0.2,
+                          rpc_deadline_s=3.0) as router:
+            chaos = drive(router, 'chaos')
+            chaos['injected'] = sfaults.injected()
+            sfaults.clear()
+            st = router.stats()
+            chaos['ejections'] = st['ejections']
+            chaos['failovers'] = st['failovers']
+            reps[0].restart()
+            router.heartbeat_once()
+            chaos['readmitted'] = router.health()[victim]['healthy']
+            chaos['spec'] = spec
+
+    recompiles = sum(r.stats()['server']['recompiles'] for r in reps)
+    doc = {
+        'metric': f'llama_tiny_replicated_decode_x{args.replicas}',
+        'value': replicated['tok_s'],
+        'unit': 'tok/s',
+        'replicas': args.replicas,
+        'concurrency': args.concurrency,
+        'prompts': args.prompts,
+        'new_tokens_each': args.new_tokens,
+        'warmup_s': round(warm_s, 2),
+        'recompiles': recompiles,
+        'single': single,
+        'replicated': replicated,
+        'chaos': chaos,
+        'scaling_x': round(replicated['tok_s'] /
+                           max(single['tok_s'], 1e-9), 2),
+    }
+    if chaos is not None:
+        p99 = float(replicated['latency_ms'].get('99') or 0) or 1e-9
+        c99 = float(chaos['latency_ms'].get('99') or 0)
+        doc['chaos_p99_ratio'] = round(c99 / p99, 2)
+        doc['p99_bound'] = (
+            f"with one of {args.replicas} replicas killed mid-run: "
+            f"0 failed requests (completed {chaos['completed']}/"
+            f"{args.prompts}), p99 {c99:.0f}ms = "
+            f"{doc['chaos_p99_ratio']}x the fault-free p99 "
+            f"{p99:.0f}ms — the tail absorbs one RPC-deadline "
+            f"failover, never an error")
+    for rep in reps:
+        rep.close(drain=False)
+    return doc
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     ap.add_argument('--smoke', action='store_true',
                     help='tiny config for the tier-1 CI smoke')
-    ap.add_argument('--out', default='SERVE_r02.json')
+    ap.add_argument('--out', default=None)
     ap.add_argument('--rate', type=float, default=None,
                     help='offered load, requests/s (open loop)')
     ap.add_argument('--requests', type=int, default=None)
+    ap.add_argument('--replicas', type=int,
+                    default=int(os.environ.get('MXNET_SERVE_REPLICAS',
+                                               '0')) or None,
+                    help='bench the replicated tier: a Router over N '
+                         'Replica endpoints (emits SERVE_r03.json)')
+    ap.add_argument('--chaos', default=None,
+                    help='serve fault spec for the chaos phase '
+                         '(default: a count-based mid-run crash of '
+                         'replica r0; "none" skips the phase)')
     ap.add_argument('--cpu', action='store_true')
     args = ap.parse_args()
     if args.cpu:
         os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    if args.out is None:
+        args.out = 'SERVE_r03.json' if args.replicas else 'SERVE_r02.json'
 
     if args.smoke:
         args.image_size = 32
@@ -186,6 +351,9 @@ def main():
         args.max_prompt = 16
         args.prompts = 4
         args.new_tokens = 4
+        args.concurrency = 2
+        if args.replicas:
+            args.prompts = 8
     else:
         args.image_size = 64
         args.buckets = (1, 2, 4, 8)
@@ -203,6 +371,38 @@ def main():
         args.max_prompt = 64
         args.prompts = 48
         args.new_tokens = 16
+        args.concurrency = 6
+
+    if args.replicas:
+        doc = {'config': 'smoke' if args.smoke else 'full',
+               'baseline_r02_tok_s': 762.91,
+               'replicated': bench_replicated(args)}
+        with open(args.out, 'w') as f:
+            json.dump(doc, f, indent=1)
+            f.write('\n')
+        r = doc['replicated']
+        chaos = r['chaos'] or {}
+        print(json.dumps({
+            'replicas': r['replicas'],
+            'single_tok_s': r['single']['tok_s'],
+            'replicated_tok_s': r['replicated']['tok_s'],
+            'scaling_x': r['scaling_x'],
+            'chaos_tok_s': chaos.get('tok_s'),
+            'chaos_failed': chaos.get('failed'),
+            'chaos_p99_ratio': r.get('chaos_p99_ratio'),
+            'readmitted': chaos.get('readmitted'),
+            'recompiles': r['recompiles'],
+            'out': args.out}))
+        failed = (r['single']['failed'] + r['replicated']['failed']
+                  + (chaos.get('failed') or 0))
+        if failed:
+            print(f'FAIL: {failed} failed request(s) in the '
+                  'replicated bench', file=sys.stderr)
+            return 1
+        if r['recompiles']:
+            print('FAIL: recompiles after warmup', file=sys.stderr)
+            return 1
+        return 0
 
     doc = {'config': 'smoke' if args.smoke else 'full',
            'resnet': bench_resnet(args),
