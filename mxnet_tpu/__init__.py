@@ -91,6 +91,7 @@ from . import parallel
 from . import amp
 from . import analysis
 from . import serve
+from . import train
 from . import quantization
 from . import contrib
 from . import test_utils

@@ -98,3 +98,28 @@ def current_numpy_rng():
     if not hasattr(_state, 'np_rng'):
         _state.np_rng = _np.random.default_rng()
     return _state.np_rng
+
+
+def get_state():
+    """Snapshot every RNG stream a training step consumes, as plain
+    host data (picklable, checkpointable).
+
+    Covers the eager PRNG key (dropout & friends via :func:`next_key`),
+    the host-side numpy Generator (initializers / data augmentation),
+    and numpy's legacy global stream (data-pipeline shuffles). Restoring
+    the snapshot with :func:`set_state` makes a resumed run draw the
+    exact same sequences as the uninterrupted one.
+    """
+    return {
+        'key': _np.asarray(_global()).copy(),
+        'np_rng': current_numpy_rng().bit_generator.state,
+        'np_global': _np.random.get_state(),
+    }
+
+
+def set_state(state):
+    """Restore a snapshot taken by :func:`get_state` (this thread)."""
+    import jax.numpy as jnp
+    _state.key = jnp.asarray(state['key'])
+    current_numpy_rng().bit_generator.state = state['np_rng']
+    _np.random.set_state(state['np_global'])

@@ -80,6 +80,12 @@ LOCK_HIERARCHY = (
                     'table and per-slot sequence state; taken after the '
                     'queue lock when admitting, never across a compiled '
                     'step (mxnet_tpu/serve/decode.py)'),
+    ('train.ckpt', '_CheckpointDaemon._cv (Condition): the pending-'
+                   'snapshot slot, busy flag and stop flag of the async '
+                   'checkpoint thread; the daemon releases it before the '
+                   'orbax serialize, so a slow save never blocks the '
+                   'step loop handing off the next snapshot '
+                   '(mxnet_tpu/train/elastic.py)'),
     ('bulk.segment', '_Segment.lock (RLock): per-thread bulked-eager '
                      'segment; foreign threads take it only to settle '
                      '(mxnet_tpu/_bulk.py)'),
@@ -113,8 +119,13 @@ LOCK_SITES = {
         '_sock_locks': 'kvstore.sock',
         '_lock': 'kvstore.store',
         '_barrier_cv': 'kvstore.barrier',
+        '_elastic_cv': 'kvstore.barrier',
         '_seq_lock': 'misc.leaf',
         '_SERVERS_LOCK': 'misc.leaf',
+    },
+    '*/train/elastic.py': {
+        '_cv': 'train.ckpt',
+        '_stats_lock': 'misc.leaf',
     },
     '*/kvstore/rpc.py': {
         '_sock_lock': 'kvstore.sock',

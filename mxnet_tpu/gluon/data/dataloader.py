@@ -74,6 +74,14 @@ class DataLoader:
         self._pin_memory = pin_memory
         self._thread_pool = thread_pool
         self._timeout = timeout
+        # remembered for resumable(): the checkpointable iterator rebuilds
+        # the per-epoch plan itself from (batch_size, shuffle, last_batch)
+        self._batch_size = batch_size
+        self._shuffle = bool(shuffle)
+        self._last_batch = last_batch or 'keep'
+        self._resumable_ok = (batch_sampler is None and sampler is None
+                              and (last_batch or 'keep') in
+                              ('keep', 'discard'))
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError('batch_size must be specified unless '
@@ -145,6 +153,113 @@ class DataLoader:
     def __len__(self):
         return len(self._batch_sampler)
 
+    def resumable(self, shuffle_seed=0, state=None):
+        """Checkpointable iterator over this loader's dataset.
+
+        Returns a :class:`_ResumableIter` — an infinite epoch-rolling
+        iterator whose position is a tiny state dict
+        ``{'epoch', 'batch_index', 'shuffle_seed'}`` (see
+        ``state_dict()`` / ``load_state_dict()``). Shuffle order is a
+        pure function of ``(shuffle_seed, epoch)``, so restoring the
+        state reproduces the exact batch sequence, and the skip to the
+        saved position is index arithmetic — no dataset reads for the
+        replayed batches.
+
+        Only the default-sampler configuration is resumable (custom
+        ``sampler``/``batch_sampler`` objects hold opaque state;
+        ``last_batch='rollover'`` carries leftovers across epochs).
+        """
+        if not self._resumable_ok:
+            raise ValueError(
+                'resumable() requires the default sampler configuration '
+                "(no custom sampler/batch_sampler, last_batch in "
+                "('keep', 'discard'))")
+        it = _ResumableIter(self._dataset, self._batch_size,
+                            self._shuffle, self._last_batch,
+                            self._batchify_fn, shuffle_seed)
+        if it.batches_per_epoch() == 0:
+            raise ValueError(
+                f'resumable() would yield no batches: '
+                f'len(dataset)={len(self._dataset)} with '
+                f'batch_size={self._batch_size} and '
+                f'last_batch={self._last_batch!r}')
+        if state is not None:
+            it.load_state_dict(state)
+        return it
+
     def __del__(self):
         if self._pool is not None:
             self._pool.terminate()
+
+
+class _ResumableIter:
+    """Infinite batch iterator with an explicit, restorable position.
+
+    The epoch-``e`` batch plan is ``default_rng([seed, e])``'s
+    permutation (or ``arange`` unshuffled) chunked by ``batch_size`` —
+    derived from nothing but ``(seed, e)``, never from the global numpy
+    stream, so data-augmentation RNG and shuffle order cannot perturb
+    each other across a resume.
+    """
+
+    def __init__(self, dataset, batch_size, shuffle, last_batch,
+                 batchify_fn, shuffle_seed):
+        self._dataset = dataset
+        self._batch_size = int(batch_size)
+        self._shuffle = shuffle
+        self._last_batch = last_batch
+        self._batchify_fn = batchify_fn
+        self._seed = int(shuffle_seed)
+        self._epoch = 0
+        self._batch_index = 0
+        self._plan = None          # lazily built per epoch
+
+    # ------------------------------------------------------------- position
+    def state_dict(self):
+        return {'epoch': self._epoch, 'batch_index': self._batch_index,
+                'shuffle_seed': self._seed}
+
+    def load_state_dict(self, state):
+        self._seed = int(state['shuffle_seed'])
+        self._epoch = int(state['epoch'])
+        self._batch_index = int(state['batch_index'])
+        self._plan = None
+        return self
+
+    # ------------------------------------------------------------- iteration
+    def _epoch_plan(self):
+        n = len(self._dataset)
+        if self._shuffle:
+            order = _np.random.default_rng(
+                [self._seed, self._epoch]).permutation(n)
+        else:
+            order = _np.arange(n)
+        bs = self._batch_size
+        stop = n - n % bs if self._last_batch == 'discard' else n
+        return [order[i:i + bs] for i in range(0, stop, bs)]
+
+    def batches_per_epoch(self):
+        n = len(self._dataset)
+        if self._last_batch == 'discard':
+            return n // self._batch_size
+        return -(-n // self._batch_size)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.batches_per_epoch() == 0:
+            raise ValueError(
+                f'resumable iterator yields no batches: '
+                f'len(dataset)={len(self._dataset)} with '
+                f'batch_size={self._batch_size} and '
+                f'last_batch={self._last_batch!r}')
+        if self._plan is None:
+            self._plan = self._epoch_plan()
+        while self._batch_index >= len(self._plan):
+            self._epoch += 1
+            self._batch_index = 0
+            self._plan = self._epoch_plan()
+        batch = self._plan[self._batch_index]
+        self._batch_index += 1
+        return self._batchify_fn([self._dataset[int(i)] for i in batch])

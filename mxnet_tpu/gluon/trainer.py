@@ -411,6 +411,51 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     # ------------------------------------------------------------ save / load
+    def state_dict(self):
+        """Full trainer state as host data (picklable, checkpointable).
+
+        Beyond the optimizer slot states this captures everything the
+        update *schedule* depends on: the global update counter, the
+        per-index update counts (adam's bias-correction ``t``, per-param
+        lr/wd schedules) and the lr-scheduler's mutable attributes —
+        omitting any of them makes a restored trainer's next step drift
+        from the uninterrupted run.
+        """
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        sd = {
+            'states': {i: _state_to_host(s)
+                       for i, s in self._states.items()},
+            'num_update': int(self._optimizer.num_update),
+            'index_update_count': {
+                int(i): int(c) for i, c in
+                self._optimizer._index_update_count.items()},
+        }
+        sch = getattr(self._optimizer, 'lr_scheduler', None)
+        if sch is not None:
+            import copy
+            sd['lr_scheduler'] = copy.deepcopy(sch.__dict__)
+        return sd
+
+    def load_state_dict(self, sd):
+        """Restore state captured by :meth:`state_dict` — the next
+        ``step`` is bit-identical to the uninterrupted trainer's."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._states = {int(i): _state_from_host(s)
+                        for i, s in sd['states'].items()}
+        self._optimizer.num_update = int(sd['num_update'])
+        self._optimizer._index_update_count = {
+            int(i): int(c)
+            for i, c in sd.get('index_update_count', {}).items()}
+        sch = getattr(self._optimizer, 'lr_scheduler', None)
+        if sch is not None and 'lr_scheduler' in sd:
+            sch.__dict__.update(sd['lr_scheduler'])
+        # drop device-side caches keyed on the old counters/hypers
+        self._t_cache = None
+        self._hyper_cache = None
+
     def save_states(self, fname):
         """Reference trainer.py:482 (pickled updater states)."""
         import pickle
@@ -423,8 +468,7 @@ class Trainer:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=False)
             return
         with open(fname, 'wb') as f:
-            states = {i: _state_to_host(s) for i, s in self._states.items()}
-            pickle.dump((states, self._optimizer.num_update), f)
+            pickle.dump({'version': 2, **self.state_dict()}, f)
 
     def load_states(self, fname):
         """Reference trainer.py:511."""
@@ -435,9 +479,16 @@ class Trainer:
             self._kvstore.load_optimizer_states(fname)
             return
         with open(fname, 'rb') as f:
-            states, num_update = pickle.load(f)
+            payload = pickle.load(f)
+        if isinstance(payload, dict):
+            self.load_state_dict(payload)
+            return
+        # legacy format: (states, num_update) tuple — no schedule state
+        states, num_update = payload
         self._states = {i: _state_from_host(s) for i, s in states.items()}
         self._optimizer.num_update = num_update
+        self._t_cache = None
+        self._hyper_cache = None
 
 
 def _state_to_host(state):

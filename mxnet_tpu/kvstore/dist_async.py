@@ -89,7 +89,9 @@ from .rpc import (RpcClient, RpcServer, _recv_exact,  # noqa: F401
 # from the server's dedup window instead of re-applied (pull/ping/stats
 # are idempotent and need no window)
 _MUTATING_CMDS = frozenset(
-    {'init', 'push', 'set_optimizer', 'register_server', 'barrier'})
+    {'init', 'push', 'set_optimizer', 'register_server', 'barrier',
+     'put', 'elastic_join', 'elastic_leave', 'elastic_commit',
+     'elastic_barrier'})
 
 
 class _AsyncServer(RpcServer):
@@ -103,7 +105,8 @@ class _AsyncServer(RpcServer):
     # data-plane commands prove a live store: they lift a tombstone (a
     # NEW store of a departed rank revives it); ping/bye/queries do not
     _REVIVING_CMDS = frozenset(
-        {'init', 'push', 'pull', 'barrier', 'set_optimizer'})
+        {'init', 'push', 'pull', 'barrier', 'set_optimizer', 'put',
+         'elastic_join', 'elastic_barrier', 'elastic_commit'})
 
     def __init__(self, port, bind_host='127.0.0.1', sid=0):
         super().__init__(port, bind_host=bind_host, sid=sid)
@@ -124,6 +127,19 @@ class _AsyncServer(RpcServer):
         self._barrier_gen = 0
         self._barrier_arrivals = set()   # (client, seq) this generation
         self._barrier_cv = threading.Condition()
+        # ------- elastic membership (train.elastic worker-loss recovery)
+        # rank -> {'joined': clock time, 'start': first step this member
+        # participates in} — a late joiner must not be counted at a
+        # barrier for a step already in flight (its gradient would be
+        # scaled for a world it was never part of)
+        self._elastic_members = {}
+        self._elastic_gen = 0            # bumps on every join/ejection
+        self._elastic_committed = -1     # last checkpoint-committed step
+        self._elastic_step = -1          # max step whose barrier released
+        self._elastic_arrivals = {}      # (phase, step) -> set of ranks
+        self._elastic_rel = {}           # (phase, step) -> release count
+        self._elastic_reply = {}         # (phase, step) -> last release reply
+        self._elastic_cv = threading.Condition()
         self._race = None
         from ..analysis import race as _race
         if _race.enabled():
@@ -132,6 +148,8 @@ class _AsyncServer(RpcServer):
             # handler threads race each other and the heartbeat reaper
             self._barrier_cv = _race.tracked_condition(
                 self._barrier_cv, 'kvstore.barrier')
+            self._elastic_cv = _race.tracked_condition(
+                self._elastic_cv, 'kvstore.barrier')
             self._race = _race.shared_state('kvstore._AsyncServer._store',
                                             guard=self._lock)
 
@@ -150,11 +168,18 @@ class _AsyncServer(RpcServer):
                                   in self._server_table.items()}}, b''
         if cmd == 'stats':
             with self._lock:
-                return {'ok': True, 'sid': self._sid,
-                        'keys': sorted(map(str, self._store)),
-                        'counters': dict(self._counters),
-                        'tombstones': sorted(self._tombstones),
-                        'faults': faults.injected()}, b''
+                reply = {'ok': True, 'sid': self._sid,
+                         'keys': sorted(map(str, self._store)),
+                         'counters': dict(self._counters),
+                         'tombstones': sorted(self._tombstones),
+                         'faults': faults.injected()}
+            with self._elastic_cv:
+                reply['elastic'] = {
+                    'gen': self._elastic_gen,
+                    'live': sorted(self._elastic_members),
+                    'committed': self._elastic_committed,
+                    'step': self._elastic_step}
+            return reply, b''
         if cmd == 'init':
             arr = _onp.frombuffer(payload, header['dtype']).reshape(
                 header['shape']).copy()
@@ -164,6 +189,17 @@ class _AsyncServer(RpcServer):
                 # first init wins (reference: rank 0 authoritative)
                 self._store.setdefault(header['key'], arr)
                 self._counters['init_applied'] += 1
+            return {'ok': True}, b''
+        if cmd == 'put':
+            # unconditional overwrite — the rollback/recovery primitive:
+            # init's first-write-wins would keep the value being rolled
+            # back, and push routes through the updater
+            arr = _onp.frombuffer(payload, header['dtype']).reshape(
+                header['shape']).copy()
+            with self._lock:
+                if self._race is not None:
+                    self._race.write()
+                self._store[header['key']] = arr
             return {'ok': True}, b''
         if cmd == 'push':
             grad = _onp.frombuffer(payload, header['dtype']).reshape(
@@ -261,7 +297,139 @@ class _AsyncServer(RpcServer):
                                          f'(MXNET_KVSTORE_DEADLINE_S): '
                                          f'not all workers arrived'}, b''
             return {'ok': True}, b''
+        if cmd == 'elastic_join':
+            r = int(rank)
+            with self._elastic_cv:
+                # a (re)joining worker participates from the first step
+                # whose barrier has not released yet: the in-flight step
+                # keeps the world it started with
+                start = max(self._elastic_step,
+                            self._elastic_committed) + 1
+                if r not in self._elastic_members:
+                    self._elastic_members[r] = {'joined': self._clock(),
+                                                'start': start}
+                    self._elastic_gen += 1
+                    self._elastic_cv.notify_all()
+                return {'ok': True, 'gen': self._elastic_gen,
+                        'live': sorted(self._elastic_members),
+                        'committed': self._elastic_committed,
+                        'resume': self._elastic_members[r]['start']}, b''
+        if cmd == 'elastic_leave':
+            r = int(rank)
+            with self._elastic_cv:
+                if self._elastic_members.pop(r, None) is not None:
+                    self._elastic_gen += 1
+                    self._elastic_cv.notify_all()
+                return {'ok': True, 'gen': self._elastic_gen,
+                        'live': sorted(self._elastic_members)}, b''
+        if cmd == 'elastic_commit':
+            step = int(header['step'])
+            with self._elastic_cv:
+                self._elastic_committed = max(self._elastic_committed,
+                                              step)
+                # prune barrier bookkeeping for steps that can never be
+                # revisited (rollback never goes behind the commit)
+                for k in [k for k in self._elastic_arrivals
+                          if k[1] < self._elastic_committed - 2]:
+                    self._elastic_arrivals.pop(k, None)
+                    self._elastic_rel.pop(k, None)
+                    self._elastic_reply.pop(k, None)
+                self._elastic_cv.notify_all()
+                return {'ok': True,
+                        'committed': self._elastic_committed}, b''
+        if cmd == 'elastic_barrier':
+            return self._elastic_barrier(header)
         return {'ok': False, 'error': f'unknown cmd {cmd!r}'}, b''
+
+    def _elastic_barrier(self, header):
+        """Membership-aware barrier for the elastic step protocol.
+
+        Release condition: every *expected* member (live, and whose
+        ``start`` step is <= this barrier's step) has arrived. While
+        waiting, each waiter re-evaluates liveness from the heartbeat
+        table against the injectable clock and EJECTS silent members —
+        only non-arrived ones: an arrived member is a live handler
+        thread by construction, no matter how stale its fake-clock
+        heartbeat looks. Barriers are re-runnable: a release clears the
+        arrivals set and caches the reply, so a rollback-redo of the
+        same (phase, step) forms a fresh barrier instead of releasing
+        instantly off stale arrivals.
+
+        Lock order: the heartbeat snapshot is taken under ``self._lock``
+        (kvstore.store) and RELEASED before ``_elastic_cv``
+        (kvstore.barrier) is acquired — store before barrier, matching
+        the declared hierarchy.
+        """
+        import time as _time
+        rank = int(header['rank'])
+        phase = header['phase']
+        step = int(header['step'])
+        key = (phase, step)
+        deadline = _kv_deadline_s()
+        wall_deadline = _time.monotonic() + deadline
+        entry_gen = None
+        entry_rel = None
+        while True:
+            with self._lock:
+                seen = {r: t for r, t in self._last_seen.items()}
+                tombs = set(self._tombstones)
+            now = self._clock()
+            with self._elastic_cv:
+                if rank not in self._elastic_members:
+                    return {'ok': False,
+                            'error': f'rank {rank} is not an elastic '
+                                     'member (call elastic_join '
+                                     'first)'}, b''
+                if entry_gen is None:
+                    entry_gen = self._elastic_gen
+                    entry_rel = self._elastic_rel.get(key, 0)
+                elif self._elastic_rel.get(key, 0) > entry_rel:
+                    # another waiter released this barrier round: join
+                    # its verdict so the whole group acts uniformly.
+                    # Checked BEFORE registering arrival — a woken
+                    # waiter must not seed the next run of this
+                    # (phase, step) barrier with its stale rank
+                    return dict(self._elastic_reply[key]), b''
+                arr = self._elastic_arrivals.setdefault(key, set())
+                arr.add(rank)
+                dead = []
+                for r, m in self._elastic_members.items():
+                    if r in arr:
+                        continue
+                    if r in tombs or \
+                            now - seen.get(r, m['joined']) > deadline:
+                        dead.append(r)
+                for r in dead:
+                    del self._elastic_members[r]
+                if dead:
+                    self._elastic_gen += 1
+                    self._elastic_cv.notify_all()
+                expected = {r for r, m in self._elastic_members.items()
+                            if m['start'] <= step}
+                if expected and expected <= arr:
+                    self._elastic_step = max(self._elastic_step, step)
+                    reply = {'ok': True, 'gen': self._elastic_gen,
+                             'live': sorted(self._elastic_members),
+                             'count': len(expected),
+                             'committed': self._elastic_committed,
+                             'changed': self._elastic_gen != entry_gen}
+                    self._elastic_rel[key] = \
+                        self._elastic_rel.get(key, 0) + 1
+                    self._elastic_reply[key] = reply
+                    self._elastic_arrivals[key] = set()
+                    self._elastic_cv.notify_all()
+                    return dict(reply), b''
+                if _time.monotonic() >= wall_deadline:
+                    arr.discard(rank)
+                    return {'ok': False,
+                            'error': f'elastic barrier ({phase}, {step}) '
+                                     f'timeout after {deadline:g}s '
+                                     '(MXNET_KVSTORE_DEADLINE_S)'}, b''
+                # short slices, not one long wait: fake-clock liveness
+                # (self._clock) can advance without any notify, and the
+                # per-iteration re-snapshot is what turns that into a
+                # deterministic ejection
+                self._elastic_cv.wait(timeout=0.05)
 
 
 _SERVERS = {}
@@ -704,6 +872,62 @@ class KVStoreDistAsync(KVStoreBase):
         """Explicit rendezvous (reference ps::Postoffice::Barrier) —
         NOT implied by push/pull, which never wait for other workers."""
         self._rpc({'cmd': 'barrier', 'nproc': self._nproc})
+
+    # ------------------------------------------------- elastic membership
+    def put(self, key, value):
+        """Unconditionally overwrite ``key`` on its server(s) — the
+        rollback/recovery primitive (``init`` is first-write-wins and
+        would keep exactly the value being rolled back)."""
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, vals):
+            a = self._to_host(v)
+            for sid, sub, rng in self._plan(k, a.shape, a.nbytes):
+                part = a if rng is None else \
+                    _onp.ascontiguousarray(a[rng[0]:rng[1]])
+                self._rpc_to(sid, {'cmd': 'put', 'key': sub,
+                                   'dtype': str(part.dtype),
+                                   'shape': part.shape}, part.tobytes())
+
+    def elastic_join(self):
+        """Enter (or re-enter after a restart) the elastic membership
+        group on server 0. Returns the join reply: ``live`` ranks,
+        membership ``gen``, last ``committed`` step and the ``resume``
+        step this worker participates from (a late joiner sits out any
+        in-flight step)."""
+        reply, _ = self._rpc({'cmd': 'elastic_join'})
+        return {k: v for k, v in reply.items() if k != 'ok'}
+
+    def elastic_leave(self):
+        """Cleanly exit the elastic group (planned scale-down)."""
+        reply, _ = self._rpc({'cmd': 'elastic_leave'})
+        return {k: v for k, v in reply.items() if k != 'ok'}
+
+    def elastic_commit(self, step):
+        """Record that the checkpoint for ``step`` is durably committed
+        — the step the group re-forms at after a failure."""
+        reply, _ = self._rpc({'cmd': 'elastic_commit', 'step': int(step)})
+        return int(reply['committed'])
+
+    def elastic_barrier(self, phase, step):
+        """Membership-aware rendezvous of the live elastic members for
+        ``(phase, step)``. Blocks until every live member expected at
+        this step arrives — silently dead members are ejected from the
+        group within ``MXNET_KVSTORE_DEADLINE_S`` instead of hanging
+        the barrier. Returns the release verdict: ``count`` (the world
+        size this step runs at), ``live``, ``gen``, ``committed`` and
+        ``changed`` (membership changed since this barrier formed —
+        the caller's cue to roll back to the committed step)."""
+        self._ensure_connected()
+        # the handler legitimately blocks up to the liveness deadline;
+        # give the transport room on top of it so a full barrier wait is
+        # not misread as a dead server
+        budget = _kv_deadline_s() + max(5.0, self._rpc_deadline)
+        reply, _ = self._rpc_to(0, {'cmd': 'elastic_barrier',
+                                    'phase': str(phase),
+                                    'step': int(step)},
+                                deadline_s=budget)
+        return {k: v for k, v in reply.items() if k != 'ok'}
 
     def get_num_dead_node(self, node_id=0, timeout=60):
         """A real failure-detection answer (reference ps-lite

@@ -29,17 +29,27 @@ Spec grammar — ``MXNET_KVSTORE_FAULT_SPEC`` or
                             absorb. Fires once.
     reset_every[:CMD]:N     same, but every N-th matching request
                             (soak mode).
+    die_after[:CMD]:N       the N-th matching request raises
+                            :class:`InjectedWorkerDeath` BEFORE any
+                            byte leaves — the worker-process-kill
+                            case. Deliberately NOT a transport error,
+                            so the retry loop does not absorb it: it
+                            propagates to the training loop, which
+                            "dies" (elastic chaos tests). Fires once.
 
 ``CMD`` filters on the wire command (``push``, ``pull``, ``init``,
 ``ping``, ``barrier``, ...); ``*`` matches any worker request. Server
 replies carry no ``cmd`` field and only match the literal filter
 ``reply``, so a cmd-less rule can never fire on the server's side of
-an in-process test.
+an in-process test. Any rule takes a ``rank=R`` option restricting it
+to requests stamped with that worker rank (e.g.
+``die_after:push:3:rank=1`` kills worker 1 on its 3rd push) — requests
+without a rank stamp never match a ranked rule.
 
 Counters from :func:`injected` (``{'drop': n, 'delay': n, 'reset': n,
-'total': n}``) are folded into the server's ``stats`` RPC reply by
-``_AsyncServer``, so assertions can read injection and apply counts
-through one call (``KVStoreDistAsync.server_health``).
+'die': n, 'total': n}``) are folded into the server's ``stats`` RPC
+reply by ``_AsyncServer``, so assertions can read injection and apply
+counts through one call (``KVStoreDistAsync.server_health``).
 
 The plan is process-global (both ends of an in-process loopback pair
 see it) but rules target the worker side via the ``cmd`` filter; the
@@ -54,11 +64,18 @@ import threading
 import time
 
 __all__ = ['configure', 'clear', 'active', 'injected',
-           'on_send', 'on_recv', 'FaultSpecError']
+           'on_send', 'on_recv', 'FaultSpecError', 'InjectedWorkerDeath']
 
 
 class FaultSpecError(ValueError):
     """Malformed ``MXNET_KVSTORE_FAULT_SPEC`` rule."""
+
+
+class InjectedWorkerDeath(RuntimeError):
+    """Raised by a ``die_after`` rule: simulates the worker process
+    dying at this exact send. A RuntimeError (not ConnectionError /
+    OSError) on purpose — the RPC retry loop must NOT catch it, the
+    worker's training loop must."""
 
 
 def _parse_duration(text):
@@ -73,10 +90,13 @@ class _Rule:
     def __init__(self, action, cmd, **kw):
         self.action = action
         self.cmd = cmd            # None == any worker request
+        self.rank = None          # None == any rank
         self.seen = 0             # matching sends so far (reset_* counting)
         self.__dict__.update(kw)
 
-    def matches(self, cmd):
+    def matches(self, cmd, rank=None):
+        if self.rank is not None and rank != self.rank:
+            return False
         if self.cmd is None or self.cmd == '*':
             # wildcard: any worker REQUEST, never a server reply
             return cmd != 'reply'
@@ -90,19 +110,20 @@ def _parse_rule(text):
     while parts and '=' in parts[-1]:
         k, v = parts.pop().split('=', 1)
         opts[k.strip()] = v.strip()
+    rule = None
     if action == 'drop':
         if len(parts) != 3:
             raise FaultSpecError(f'drop rule {text!r}: want drop:CMD:P')
         p = float(parts[2])
         if not 0.0 <= p <= 1.0:
             raise FaultSpecError(f'drop probability {p} outside [0, 1]')
-        return _Rule('drop', parts[1], p=p,
+        rule = _Rule('drop', parts[1], p=p,
                      rng=random.Random(int(opts.get('seed', 0))))
-    if action == 'delay':
+    elif action == 'delay':
         if len(parts) != 3:
             raise FaultSpecError(f'delay rule {text!r}: want delay:CMD:DUR')
-        return _Rule('delay', parts[1], duration=_parse_duration(parts[2]))
-    if action in ('reset_after', 'reset_every'):
+        rule = _Rule('delay', parts[1], duration=_parse_duration(parts[2]))
+    elif action in ('reset_after', 'reset_every', 'die_after'):
         if len(parts) == 2:          # reset_after:N — any worker request
             cmd, n = None, parts[1]
         elif len(parts) == 3:        # reset_after:CMD:N
@@ -113,10 +134,19 @@ def _parse_rule(text):
         n = int(n)
         if n < 1:
             raise FaultSpecError(f'{action} count must be >= 1, got {n}')
-        return _Rule(action, cmd, n=n)
-    raise FaultSpecError(
-        f'unknown fault action {action!r} in rule {text!r} '
-        "(know: drop, delay, reset_after, reset_every)")
+        rule = _Rule(action, cmd, n=n)
+    else:
+        raise FaultSpecError(
+            f'unknown fault action {action!r} in rule {text!r} '
+            "(know: drop, delay, reset_after, reset_every, die_after)")
+    if 'rank' in opts:
+        try:
+            rule.rank = int(opts['rank'])
+        except ValueError:
+            raise FaultSpecError(
+                f'rule {text!r}: rank= wants an integer, '
+                f'got {opts["rank"]!r}')
+    return rule
 
 
 class FaultPlan:
@@ -128,18 +158,30 @@ class FaultPlan:
                       if r.strip()]
         if not self.rules:
             raise FaultSpecError(f'empty fault spec {spec!r}')
-        self.counts = {'drop': 0, 'delay': 0, 'reset': 0}
+        self.counts = {'drop': 0, 'delay': 0, 'reset': 0, 'die': 0}
         self._lock = threading.Lock()
         self._tls = threading.local()
 
     # ------------------------------------------------------------- hooks
     def on_send(self, header):
         cmd = header.get('cmd', 'reply')
+        rank = header.get('rank')
+        rank = int(rank) if rank is not None else None
         delay = 0.0
         for rule in self.rules:
-            if not rule.matches(cmd):
+            if not rule.matches(cmd, rank):
                 continue
-            if rule.action == 'delay':
+            if rule.action == 'die_after':
+                with self._lock:
+                    rule.seen += 1
+                    fire = rule.seen == rule.n
+                    if fire:
+                        self.counts['die'] += 1
+                if fire:
+                    raise InjectedWorkerDeath(
+                        f'fault-injected worker death on {cmd!r} rpc'
+                        + (f' (rank {rank})' if rank is not None else ''))
+            elif rule.action == 'delay':
                 with self._lock:
                     self.counts['delay'] += 1
                 delay += rule.duration

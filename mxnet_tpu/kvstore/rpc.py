@@ -36,6 +36,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time as _time
 
 from . import faults
 
@@ -94,6 +95,11 @@ class RpcServer(threading.Thread):
         super().__init__(daemon=True)
         self._sid = sid
         self._lock = threading.Lock()
+        # injectable clock: every liveness decision (heartbeat stamps,
+        # dead_nodes cutoff, elastic ejection) reads THIS, so chaos
+        # tests advance a fake clock deterministically instead of
+        # sleeping past real deadlines
+        self._clock = _time.monotonic
         self._last_seen = {}        # peer rank -> monotonic last beat
         self._tombstones = set()    # ranks that sent 'bye'
         # (client, seq) -> (reply, rpayload) replay window for retried
@@ -177,6 +183,12 @@ class RpcServer(threading.Thread):
         """The actually-bound port (useful with ``port=0`` ephemerals)."""
         return self._server.server_address[1]
 
+    def set_clock(self, fn):
+        """Swap the liveness clock (tests: a fake monotonic source).
+        Returns the previous clock."""
+        prev, self._clock = self._clock, fn
+        return prev
+
     def run(self):
         self._server.serve_forever(poll_interval=0.05)
 
@@ -225,7 +237,6 @@ class RpcServer(threading.Thread):
         refresh (tombstone-gated), then the (client, seq) dedup window
         — a retried mutating RPC the server already applied gets its
         cached reply replayed instead of a second apply."""
-        import time as _time
         cmd = header['cmd']
         rank = header.get('rank')
         client, seq = header.get('client'), header.get('seq')
@@ -235,10 +246,10 @@ class RpcServer(threading.Thread):
                 if r not in self._tombstones:
                     # every RPC doubles as a heartbeat (plus any
                     # dedicated ping thread on the peer)
-                    self._last_seen[r] = _time.monotonic()
+                    self._last_seen[r] = self._clock()
                 elif cmd in self._REVIVING_CMDS:
                     self._tombstones.discard(r)
-                    self._last_seen[r] = _time.monotonic()
+                    self._last_seen[r] = self._clock()
             if client is not None and seq is not None:
                 cached = self._dedup.get((client, int(seq)))
                 if cached is not None:
@@ -259,7 +270,6 @@ class RpcServer(threading.Thread):
         return reply, rpayload
 
     def _handle(self, header, payload, peer='127.0.0.1'):
-        import time as _time
         cmd = header['cmd']
         if cmd == 'ping':
             reply = {'ok': True, 'sid': self._sid}
@@ -277,7 +287,7 @@ class RpcServer(threading.Thread):
                 self._tombstones.add(int(header['rank']))
             return {'ok': True}, b''
         if cmd == 'dead_nodes':
-            cutoff = _time.monotonic() - float(header['timeout'])
+            cutoff = self._clock() - float(header['timeout'])
             with self._lock:
                 dead = sum(1 for t in self._last_seen.values()
                            if t < cutoff)

@@ -15,13 +15,38 @@ whole-file format, and ``save_params_sharded``/``load_params_sharded`` below
 bridge a Gluon Block onto the collective path.
 """
 
+import json as _json
 import os as _os
+import shutil as _shutil
 
 import jax
 import jax.numpy as jnp
 import numpy as _np
 
 from ..ndarray.ndarray import NDArray
+
+
+# Test-only crash hook: ``install_crash_hook(fn)`` makes the commit
+# protocol call ``fn(point)`` at named points inside ``save`` —
+# ``'ckpt.staged'`` (data written, nothing committed), ``'ckpt.renamed'``
+# (step directory in place, manifest not yet rewritten) and
+# ``'ckpt.committed'`` (manifest durable, pruning not yet done). A hook
+# that raises simulates a kill at exactly that point, so crash-atomicity
+# is testable deterministically instead of with timed SIGKILLs.
+_CRASH_HOOK = None
+
+
+def install_crash_hook(fn):
+    """Install (or with ``None`` remove) the crash-point hook; returns
+    the previously installed hook."""
+    global _CRASH_HOOK
+    prev, _CRASH_HOOK = _CRASH_HOOK, fn
+    return prev
+
+
+def _crash_point(name):
+    if _CRASH_HOOK is not None:
+        _CRASH_HOOK(name)
 
 try:
     import orbax.checkpoint as _ocp
@@ -158,45 +183,150 @@ def restore_sharded(directory, template=None, mesh=None, specs=None):
 
 class SharedCheckpointManager:
     """Step-based checkpoint rotation (reference CheckpointHandler's
-    periodic/max-keep behavior, event_handler.py — but collective/sharded).
+    periodic/max-keep behavior, event_handler.py — but collective/sharded)
+    with a crash-atomic commit protocol.
 
     save(step, tree) keeps at most ``max_to_keep`` checkpoints; restore()
     loads the latest (or a given step).
+
+    Commit protocol (a kill at ANY point leaves ``latest_step()`` on the
+    previous complete checkpoint — never a torn one):
+
+    1. collective write to ``<dir>/.staging-<step>`` (orbax),
+    2. atomic ``os.replace`` → ``<dir>/<step>``,
+    3. manifest rewrite: ``.MANIFEST.tmp`` + ``fsync`` + ``os.replace``
+       → ``MANIFEST.json``, then a directory fsync so the rename itself
+       is durable,
+    4. prune step directories already dropped from the manifest.
+
+    ``latest_step()``/``all_steps()`` read ONLY the manifest, so a step
+    becomes visible exactly when (3) lands; leftover staging directories
+    from a crashed save are swept on the next construction. On
+    multi-process meshes the write in (1) is collective, steps (2)–(4)
+    run on process 0 alone; peers observe the new step after process 0
+    commits (the shared-filesystem contract orbax itself has).
     """
 
-    def __init__(self, directory, max_to_keep=5):
-        ocp = _require_orbax()
-        self._dir = _os.path.abspath(directory)
-        self._mgr = ocp.CheckpointManager(
-            self._dir,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+    MANIFEST = 'MANIFEST.json'
 
+    def __init__(self, directory, max_to_keep=5):
+        _require_orbax()
+        self._dir = _os.path.abspath(directory)
+        self._keep = int(max_to_keep) if max_to_keep else 0
+        _os.makedirs(self._dir, exist_ok=True)
+        if jax.process_index() == 0:
+            # sweep staging left by a save that died before commit
+            try:
+                names = _os.listdir(self._dir)
+            except OSError:
+                names = []
+            for n in names:
+                if n.startswith('.staging-') or n == '.MANIFEST.tmp':
+                    _shutil.rmtree(_os.path.join(self._dir, n),
+                                   ignore_errors=True) \
+                        if _os.path.isdir(_os.path.join(self._dir, n)) \
+                        else _os.unlink(_os.path.join(self._dir, n))
+
+    # ------------------------------------------------------- manifest I/O
+    def _manifest_steps(self):
+        path = _os.path.join(self._dir, self.MANIFEST)
+        try:
+            with open(path, encoding='utf-8') as f:
+                return sorted(int(s) for s in _json.load(f)['steps'])
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+        if _os.path.exists(path):
+            return []
+        # legacy layout (pre-manifest orbax CheckpointManager): adopt
+        # committed integer step directories
+        try:
+            names = _os.listdir(self._dir)
+        except OSError:
+            return []
+        return sorted(int(n) for n in names if n.isdigit()
+                      and _os.path.isdir(_os.path.join(self._dir, n)))
+
+    def _write_manifest(self, steps):
+        tmp = _os.path.join(self._dir, '.MANIFEST.tmp')
+        blob = _json.dumps({'steps': sorted(steps),
+                            'latest': max(steps) if steps else None})
+        with open(tmp, 'w', encoding='utf-8') as f:
+            f.write(blob)
+            f.flush()
+            _os.fsync(f.fileno())
+        _os.replace(tmp, _os.path.join(self._dir, self.MANIFEST))
+        try:
+            dfd = _os.open(self._dir, _os.O_RDONLY)
+            try:
+                _os.fsync(dfd)
+            finally:
+                _os.close(dfd)
+        except OSError:                               # pragma: no cover
+            pass                # platform without directory fsync
+
+    def _step_path(self, step):
+        p = _os.path.join(self._dir, str(step))
+        legacy = _os.path.join(p, 'default')
+        return legacy if _os.path.isdir(legacy) else p
+
+    # --------------------------------------------------------- save/restore
     def save(self, step, tree):
-        ocp = _ocp
-        self._mgr.save(step, args=ocp.args.StandardSave(
-            _globalize(_to_raw(tree))))
-        self._mgr.wait_until_finished()
+        step = int(step)
+        staging = _os.path.join(self._dir, f'.staging-{step}')
+        final = _os.path.join(self._dir, str(step))
+        raw = _globalize(_to_raw(tree))
+        primary = jax.process_index() == 0
+        if primary:
+            _shutil.rmtree(staging, ignore_errors=True)
+        with _ocp.StandardCheckpointer() as ck:
+            ck.save(staging, raw, force=True)
+        _crash_point('ckpt.staged')
+        if not primary:
+            return
+        committed = self._manifest_steps()
+        if step in committed:
+            # re-saving an already-committed step (e.g. the restored
+            # step after a rollback): un-commit it in the manifest
+            # FIRST, so a crash between the rmtree and the replace
+            # below can never leave latest_step() pointing at a
+            # deleted directory
+            self._write_manifest([s for s in committed if s != step])
+        _shutil.rmtree(final, ignore_errors=True)
+        _crash_point('ckpt.cleared')
+        _os.replace(staging, final)
+        _crash_point('ckpt.renamed')
+        steps = [s for s in self._manifest_steps() if s != step] + [step]
+        steps.sort()
+        dropped = steps[:-self._keep] if self._keep else []
+        kept = steps[-self._keep:] if self._keep else steps
+        self._write_manifest(kept)
+        _crash_point('ckpt.committed')
+        for s in dropped:
+            _shutil.rmtree(_os.path.join(self._dir, str(s)),
+                           ignore_errors=True)
 
     def restore(self, step=None, template=None):
-        ocp = _ocp
         if step is None:
-            step = self._mgr.latest_step()
-        if template is not None:
-            return _localize(self._mgr.restore(
-                step, args=ocp.args.StandardRestore(
-                    _globalize(_to_raw(template)))))
-        if jax.process_count() > 1:
-            # scale-change resume: restore against a template built from
-            # the checkpoint's METADATA with the LIVE world's replicated
-            # sharding, so a checkpoint written at a different world
-            # size reshards on load. (A plain restore would try to
-            # rebuild the writer's sharding, whose process set no
-            # longer exists.)
-            tmpl = self._replicated_template(step)
-            if tmpl is not None:
-                return _localize(self._mgr.restore(
-                    step, args=ocp.args.StandardRestore(tmpl)))
-        return _localize(self._mgr.restore(step))
+            step = self.latest_step()
+        if step is None:
+            raise ValueError(
+                f'no committed checkpoint under {self._dir}')
+        path = self._step_path(int(step))
+        with _ocp.StandardCheckpointer() as ck:
+            if template is not None:
+                return _localize(ck.restore(
+                    path, _globalize(_to_raw(template))))
+            if jax.process_count() > 1:
+                # scale-change resume: restore against a template built
+                # from the checkpoint's METADATA with the LIVE world's
+                # replicated sharding, so a checkpoint written at a
+                # different world size reshards on load. (A plain
+                # restore would try to rebuild the writer's sharding,
+                # whose process set no longer exists.)
+                tmpl = self._replicated_template(int(step))
+                if tmpl is not None:
+                    return _localize(ck.restore(path, tmpl))
+            return _localize(ck.restore(path))
 
     def _replicated_template(self, step):
         """ShapeDtypeStruct tree (from checkpoint metadata) carrying the
@@ -208,8 +338,7 @@ class SharedCheckpointManager:
             # manager's item_metadata needs a handler registry primed by
             # a prior save, which a freshly-restarted job doesn't have
             with _ocp.StandardCheckpointer() as ck:
-                meta = ck.metadata(
-                    _os.path.join(self._dir, str(step), 'default'))
+                meta = ck.metadata(self._step_path(step))
             tree = meta.item_metadata.tree \
                 if hasattr(meta, 'item_metadata') else meta.tree
         except Exception:                             # pragma: no cover
@@ -240,13 +369,17 @@ class SharedCheckpointManager:
         return out if ok else None
 
     def latest_step(self):
-        return self._mgr.latest_step()
+        """Newest committed step — read from the fsynced manifest only,
+        so a crash mid-save can never surface a torn checkpoint."""
+        steps = self._manifest_steps()
+        return steps[-1] if steps else None
 
     def all_steps(self):
-        return list(self._mgr.all_steps())
+        return self._manifest_steps()
 
     def close(self):
-        self._mgr.close()
+        """Kept for API compatibility: the manager holds no background
+        machinery (each save opens/closes its own checkpointer)."""
 
 
 def save_params_sharded(directory, block):

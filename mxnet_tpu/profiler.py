@@ -22,6 +22,7 @@ _OP_SAMPLES = 512
 _mem_stats = {'peak_live_bytes': 0}
 _analysis_reports = {}   # graph name -> mx.analysis.AnalysisReport
 _serving = {}            # server name -> stats-snapshot provider (mx.serve)
+_checkpoint = {}         # trainer name -> stats-snapshot provider (mx.train)
 
 
 def percentiles(samples, qs=(50, 95, 99)):
@@ -142,6 +143,22 @@ def detach_serving(name):
         _serving.pop(name, None)
 
 
+def attach_checkpoint(name, provider):
+    """Register a checkpoint-stats snapshot provider
+    (``mx.train.ElasticTrainer`` calls this at construction) so
+    ``dumps()`` shows a Checkpoint section — most importantly the
+    per-step blocking time of the async snapshot path, the number the
+    CheckFreq-style pipeline exists to keep small."""
+    with _stats_lock:
+        _checkpoint[name] = provider
+
+
+def detach_checkpoint(name):
+    """Drop a checkpoint provider (called from ``ElasticTrainer.close()``)."""
+    with _stats_lock:
+        _checkpoint.pop(name, None)
+
+
 def attach_analysis(name, report):
     """Attach a graph-sanitizer report (``mx.analysis``) so ``dumps()``
     shows static findings next to the runtime numbers —
@@ -206,6 +223,26 @@ def dumps(reset=False):
                     f'{lat.get(99, 0.0):.3f}   queue_ms p50/p95/p99: '
                     f'{qt.get(50, 0.0):.3f}/{qt.get(95, 0.0):.3f}/'
                     f'{qt.get(99, 0.0):.3f}')
+    if _checkpoint:
+        lines.append('Checkpoint (mx.train):')
+        for name, provider in sorted(_checkpoint.items()):
+            try:
+                snap = provider()
+            except Exception:   # a closed trainer must not kill dumps
+                continue
+            lines.append(
+                f'  {name}: saves={snap.get("saves", 0)} '
+                f'async={snap.get("async_saves", 0)} '
+                f'coalesced={snap.get("coalesced", 0)} '
+                f'errors={snap.get("errors", 0)} '
+                f'last_step={snap.get("last_step", -1)}')
+            lines.append(
+                f'    blocked_ms avg/max: '
+                f'{snap.get("blocked_ms_avg", 0.0):.3f}/'
+                f'{snap.get("blocked_ms_max", 0.0):.3f}   '
+                f'serialize_ms avg/max: '
+                f'{snap.get("serialize_ms_avg", 0.0):.3f}/'
+                f'{snap.get("serialize_ms_max", 0.0):.3f}')
     if _analysis_reports:
         lines.append('Graph analysis (mx.analysis):')
         for name, report in sorted(_analysis_reports.items()):

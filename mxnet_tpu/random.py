@@ -2,6 +2,7 @@
 
 import sys as _sys
 
+from ._rng import get_state, set_state  # noqa: F401
 from .ops import registry as _reg
 from .ops.random_ops import seed  # noqa: F401
 

@@ -1,0 +1,7 @@
+"""``mx.train`` — training supervision: elastic, preemption-tolerant
+loops (async crash-consistent checkpoints, bit-exact resume, worker-loss
+recovery). See ``docs/fault-tolerance.md`` ("Elastic training")."""
+
+from .elastic import ElasticGroup, ElasticHalted, ElasticTrainer
+
+__all__ = ['ElasticGroup', 'ElasticHalted', 'ElasticTrainer']

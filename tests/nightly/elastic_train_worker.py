@@ -1,0 +1,119 @@
+"""Kill/resume parity worker for ``mx.train.ElasticTrainer``.
+
+Three modes driven by ``tests/test_elastic_train.py``:
+
+* ``straight`` — train ``--steps`` steps uninterrupted, dump final
+  weights (+ update counter) to ``--out``.
+* ``crash`` — train ``--kill-at`` steps, checkpoint (async daemon +
+  explicit flush, so the commit is durable), then die by SIGKILL —
+  the hard-preemption case: no atexit, no flushes, no goodbyes.
+* ``resume`` — rebuild the identical program, restore the latest
+  checkpoint (parameters, optimizer state, update counter, lr
+  schedule, RNG streams, data-iterator position) and train the
+  remaining steps; dump final weights.
+
+``straight`` and ``crash``+``resume`` must produce bit-identical
+weights: the model has Dropout (consumes the PRNG stream every step),
+the loader is shuffled (position + shuffle seed must survive), the
+optimizer is adam with a FactorScheduler (slots + num_update + lr
+state must survive).
+"""
+
+import argparse
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import _cpu_guard  # noqa: E402
+_cpu_guard.force_cpu()
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, parallel  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+from mxnet_tpu.train import ElasticTrainer  # noqa: E402
+
+
+def build(ckpt_dir):
+    mx.random.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=4, activation='relu'))
+    net.add(nn.Dropout(0.5))
+    net.add(nn.Dense(2))
+    net.initialize()
+
+    rng = onp.random.default_rng(0)
+    X = rng.standard_normal((32, 4)).astype('float32')
+    Y = rng.standard_normal((32, 2)).astype('float32')
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X, Y),
+                                   batch_size=8, shuffle=True)
+    it = loader.resumable(shuffle_seed=5)
+
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.7,
+                                            base_lr=0.01)
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.01, 'lr_scheduler': sched})
+    mgr = parallel.SharedCheckpointManager(ckpt_dir, max_to_keep=2)
+    et = ElasticTrainer(dict(net.collect_params()), trainer, mgr,
+                        data_iter=it, name='parity')
+    return net, trainer, it, et
+
+
+def train_step(net, trainer, it):
+    x, y = next(it)
+    with autograd.record():
+        out = net(x)
+        loss = ((out - y) ** 2).mean()
+    loss.backward()
+    trainer.step(1)
+
+
+def dump(path, net, trainer):
+    arrs = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    arrs['num_update'] = onp.array(trainer._optimizer.num_update)
+    onp.savez(path, **arrs)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--mode', choices=('straight', 'crash', 'resume'),
+                    required=True)
+    ap.add_argument('--ckpt-dir', required=True)
+    ap.add_argument('--out', required=True)
+    ap.add_argument('--steps', type=int, default=6)
+    ap.add_argument('--kill-at', type=int, default=3)
+    args = ap.parse_args()
+
+    net, trainer, it, et = build(args.ckpt_dir)
+
+    if args.mode == 'straight':
+        for _ in range(args.steps):
+            train_step(net, trainer, it)
+        dump(args.out, net, trainer)
+        print(f'straight: {args.steps} steps done')
+        return
+
+    if args.mode == 'crash':
+        for _ in range(args.kill_at):
+            train_step(net, trainer, it)
+        et.save(args.kill_at - 1, block=True)
+        assert et.flush(timeout=60)
+        print(f'crash: checkpoint at step {args.kill_at - 1} durable, '
+              'dying now', flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+        raise AssertionError('unreachable')
+
+    # resume
+    start = et.restore()
+    assert start == args.kill_at - 1, start
+    for _ in range(start + 1, args.steps):
+        train_step(net, trainer, it)
+    dump(args.out, net, trainer)
+    print(f'resume: restored step {start}, trained to {args.steps}')
+
+
+if __name__ == '__main__':
+    main()

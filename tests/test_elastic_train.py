@@ -1,0 +1,403 @@
+"""Elastic training supervisor (``mx.train``): async crash-consistent
+checkpoints, bit-exact resume, RNG/iterator state capture.
+
+The three resume ingredients are each pinned in isolation (RNG streams,
+Trainer counters+scheduler, DataLoader position) and then end to end:
+``test_sigkill_resume_parity`` trains a dropout net in a subprocess,
+SIGKILLs it mid-run, resumes from the crash-consistent checkpoint and
+demands the final weights be bit-identical to a run that never died.
+The async-save leg is pinned by a measured stall bound: the step-loop
+blocked time must be well under a synchronous save.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.train import ElasticTrainer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- RNG streams
+
+def test_random_get_set_state_roundtrip():
+    """mx.random.get_state/set_state must capture every stream: the
+    eager PRNGKey, the module numpy Generator, and the legacy global
+    numpy stream."""
+    mx.random.seed(7)
+    st = mx.random.get_state()
+    a1 = mx.np.random.uniform(size=(8,)).asnumpy()
+    b1 = onp.random.rand(4)
+    mx.random.set_state(st)
+    a2 = mx.np.random.uniform(size=(8,)).asnumpy()
+    b2 = onp.random.rand(4)
+    assert a1.tobytes() == a2.tobytes()
+    assert b1.tobytes() == b2.tobytes()
+    # and the restored state is a snapshot, not an alias: draws after
+    # the snapshot do not perturb it
+    mx.random.set_state(st)
+    a3 = mx.np.random.uniform(size=(8,)).asnumpy()
+    assert a3.tobytes() == a1.tobytes()
+
+
+def test_rng_state_restores_dropout_masks():
+    """The train-mode dropout mask sequence — the thing a resumed run
+    must replay exactly — is a pure function of the restored state."""
+    net = nn.Dropout(0.5)
+    x = mx.np.ones((16, 16))
+    mx.random.seed(3)
+    st = mx.random.get_state()
+    with autograd.record():
+        y1 = net(x).asnumpy()
+        y2 = net(x).asnumpy()
+    mx.random.set_state(st)
+    with autograd.record():
+        z1 = net(x).asnumpy()
+        z2 = net(x).asnumpy()
+    assert y1.tobytes() == z1.tobytes()
+    assert y2.tobytes() == z2.tobytes()
+    assert y1.tobytes() != y2.tobytes()   # masks do advance
+
+
+# ---------------------------------------------------- resumable DataLoader
+
+class _CountingDataset(gluon.data.dataset.Dataset):
+    def __init__(self, n):
+        self._n = n
+        self.reads = 0
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        self.reads += 1
+        return onp.float32(idx)
+
+
+def test_resumable_iter_state_roundtrip():
+    ds = _CountingDataset(10)
+    loader = gluon.data.DataLoader(ds, batch_size=3, shuffle=True)
+    it = loader.resumable(shuffle_seed=5)
+    first = [next(it).asnumpy() for _ in range(2)]
+    st = it.state_dict()
+    assert st == {'epoch': 0, 'batch_index': 2, 'shuffle_seed': 5}
+    rest = [next(it).asnumpy() for _ in range(4)]   # rolls into epoch 1
+
+    it2 = loader.resumable(state=st)
+    rest2 = [next(it2).asnumpy() for _ in range(4)]
+    for a, b in zip(rest, rest2):
+        assert a.tobytes() == b.tobytes()
+    # the two epochs shuffle differently, and deterministically
+    it3 = loader.resumable(shuffle_seed=5)
+    again = [next(it3).asnumpy() for _ in range(2)]
+    for a, b in zip(first, again):
+        assert a.tobytes() == b.tobytes()
+
+
+def test_resumable_iter_skips_replayed_batches_without_reading():
+    """Restoring a mid-epoch position must be index arithmetic: the
+    replayed batches' records are never fetched from the dataset."""
+    ds = _CountingDataset(12)
+    loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=True)
+    it = loader.resumable(shuffle_seed=1)
+    next(it)
+    next(it)
+    st = it.state_dict()
+    want = next(it).asnumpy()
+
+    ds2 = _CountingDataset(12)
+    loader2 = gluon.data.DataLoader(ds2, batch_size=4, shuffle=True)
+    it2 = loader2.resumable(state=st)
+    got = next(it2).asnumpy()
+    assert got.tobytes() == want.tobytes()
+    assert ds2.reads == 4          # one batch read, zero replay reads
+
+
+def test_resumable_requires_default_sampler_config():
+    ds = _CountingDataset(10)
+    with pytest.raises(ValueError, match='resumable'):
+        gluon.data.DataLoader(ds, batch_size=3,
+                              last_batch='rollover').resumable()
+    with pytest.raises(ValueError, match='resumable'):
+        gluon.data.DataLoader(
+            ds, sampler=gluon.data.sampler.SequentialSampler(10),
+            batch_size=2).resumable()
+
+
+def test_resumable_empty_plan_raises_instead_of_spinning():
+    """An epoch plan with zero batches (empty dataset, or a dataset
+    smaller than one batch with last_batch='discard') must raise, not
+    loop forever rebuilding empty epochs."""
+    with pytest.raises(ValueError, match='no batches'):
+        gluon.data.DataLoader(_CountingDataset(0),
+                              batch_size=3).resumable()
+    with pytest.raises(ValueError, match='no batches'):
+        gluon.data.DataLoader(_CountingDataset(2), batch_size=4,
+                              last_batch='discard').resumable()
+
+
+def test_resumable_last_batch_discard():
+    ds = _CountingDataset(10)
+    loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False,
+                                   last_batch='discard')
+    it = loader.resumable()
+    assert it.batches_per_epoch() == 2
+    b1, b2, b3 = next(it), next(it), next(it)
+    assert b1.shape == (4,) and b2.shape == (4,)
+    assert b3.shape == (4,)        # epoch rolled, no 2-element tail
+    assert it.state_dict()['epoch'] == 1
+
+
+# ------------------------------------------------- ElasticTrainer: daemon
+
+class _GatedManager:
+    """Fake manager whose save blocks on an event — deterministic
+    control over when the daemon is busy."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.saved = []
+
+    def save(self, step, tree):
+        self.entered.set()
+        assert self.release.wait(20)
+        self.saved.append(int(step))
+
+
+def test_async_daemon_coalesces_latest_wins():
+    mgr = _GatedManager()
+    et = ElasticTrainer({}, None, mgr, async_save=True, name='coal0')
+    try:
+        assert et.save(0)
+        assert mgr.entered.wait(20)     # daemon busy inside save(0)
+        assert et.save(1)
+        assert et.save(2)               # overwrites pending 1
+        mgr.release.set()
+        assert et.flush(timeout=20)
+        assert mgr.saved == [0, 2]      # 1 was coalesced away
+        s = et.stats()
+        assert s['saves'] == 2 and s['async_saves'] == 2
+        assert s['coalesced'] == 1 and s['errors'] == 0
+        assert s['last_step'] == 2
+    finally:
+        mgr.release.set()
+        et.close()
+
+
+class _FlakyManager:
+    def __init__(self, fail_steps):
+        self._fail = set(fail_steps)
+        self.saved = []
+
+    def save(self, step, tree):
+        if step in self._fail:
+            raise RuntimeError(f'disk full at step {step}')
+        self.saved.append(int(step))
+
+
+def test_async_daemon_survives_save_errors():
+    """A failed background save is counted and reported — and the
+    daemon keeps draining later snapshots instead of dying."""
+    mgr = _FlakyManager({0})
+    et = ElasticTrainer({}, None, mgr, async_save=True, name='flaky0')
+    try:
+        et.save(0, block=True)
+        et.save(1, block=True)
+        s = et.stats()
+        assert mgr.saved == [1]
+        assert s['errors'] == 1 and 'disk full' in s['last_error']
+        assert s['saves'] == 1 and s['last_step'] == 1
+    finally:
+        et.close()
+
+
+def test_every_s_throttle_and_block_bypass():
+    clk = [100.0]
+    mgr = _FlakyManager(())
+    et = ElasticTrainer({}, None, mgr, async_save=False, every_s=10,
+                        clock=lambda: clk[0], name='thr0')
+    try:
+        assert et.save(0)
+        assert not et.save(1)           # inside the window
+        assert et.save(2, block=True)   # block bypasses the throttle
+        clk[0] += 11
+        assert et.save(3)
+        assert mgr.saved == [0, 2, 3]
+        assert et.stats()['throttled'] == 1
+    finally:
+        et.close()
+
+
+# ------------------------------------- ElasticTrainer: save/restore cycle
+
+def _dropout_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation='relu'))
+    net.add(nn.Dropout(0.5))
+    net.add(nn.Dense(2))
+    net.initialize()
+    return net
+
+
+def _train_step(net, trainer, step):
+    x = mx.np.array(onp.random.default_rng(step).standard_normal(
+        (4, 4)).astype('float32'))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    trainer.step(1)
+
+
+def _weights(net):
+    return {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+
+
+def test_elastic_trainer_restore_is_bit_exact(tmp_path):
+    """Train 6 straight vs train 3 + checkpoint + fresh process-state +
+    restore + train 3: same weights, bit for bit (dropout net + adam,
+    so parameters, optimizer slots, update counter and RNG streams all
+    have to survive the round trip)."""
+    def build():
+        mx.random.seed(11)
+        net = _dropout_net()
+        trainer = gluon.Trainer(net.collect_params(), 'adam',
+                                {'learning_rate': 0.05})
+        return net, trainer
+
+    net, trainer = build()
+    for s in range(6):
+        _train_step(net, trainer, s)
+    straight = _weights(net)
+
+    net, trainer = build()
+    mgr = parallel.SharedCheckpointManager(str(tmp_path / 'ck'))
+    et = ElasticTrainer(dict(net.collect_params()), trainer, mgr,
+                        name='bit0')
+    try:
+        for s in range(3):
+            _train_step(net, trainer, s)
+        et.save(2, block=True)
+    finally:
+        et.close()
+
+    net2, trainer2 = build()               # fresh init, same seed
+    mgr2 = parallel.SharedCheckpointManager(str(tmp_path / 'ck'))
+    et2 = ElasticTrainer(dict(net2.collect_params()), trainer2, mgr2,
+                         name='bit1')
+    try:
+        assert et2.restore() == 2
+        for s in range(3, 6):
+            _train_step(net2, trainer2, s)
+    finally:
+        et2.close()
+    resumed = _weights(net2)
+    assert straight.keys() == resumed.keys()
+    for k in straight:
+        assert straight[k].tobytes() == resumed[k].tobytes(), k
+
+
+def test_restore_cold_start_returns_minus_one(tmp_path):
+    mgr = parallel.SharedCheckpointManager(str(tmp_path / 'empty'))
+    et = ElasticTrainer({}, None, mgr, name='cold0')
+    try:
+        assert et.restore() == -1
+    finally:
+        et.close()
+
+
+# --------------------------------------------- async stall + profiler
+
+def test_async_save_stall_well_under_sync_save(tmp_path):
+    """The acceptance bound: with MXNET_CKPT_ASYNC the step loop pays
+    only the host-snapshot copy — measured ``blocked_ms`` must be well
+    under a synchronous save of the same tree — and the profiler gains
+    a Checkpoint section reporting it."""
+    net = nn.Dense(1024, in_units=1024)    # ~4 MB of parameters
+    net.initialize()
+    params = dict(net.collect_params())
+
+    sync_dir = parallel.SharedCheckpointManager(str(tmp_path / 'sync'))
+    et_sync = ElasticTrainer(params, None, sync_dir, async_save=False,
+                             name='stall_sync')
+    try:
+        for s in range(3):
+            et_sync.save(s, block=True)
+        sync_ms = et_sync.stats()
+    finally:
+        et_sync.close()
+    min_sync = min(sync_ms['serialize_ms_avg'], sync_ms['serialize_ms_max'])
+
+    async_dir = parallel.SharedCheckpointManager(str(tmp_path / 'async'))
+    et = ElasticTrainer(params, None, async_dir, async_save=True,
+                        name='stall_async')
+    try:
+        for s in range(3):
+            et.save(s)
+            assert et.flush(timeout=60)
+        dump = mx.profiler.dumps()
+        assert 'Checkpoint (mx.train):' in dump
+        assert 'stall_async' in dump and 'blocked_ms' in dump
+        s = et.stats()
+    finally:
+        et.close()
+    assert s['async_saves'] == 3
+    assert s['blocked_ms_max'] > 0.0
+    assert s['blocked_ms_max'] < 0.5 * min_sync, \
+        (s['blocked_ms_max'], min_sync)
+    # detached after close: the section disappears
+    assert 'stall_async' not in mx.profiler.dumps()
+
+
+# --------------------------------------------------- SIGKILL parity
+
+def _run_worker(mode, ckpt, out, extra_env=None, expect_kill=False):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('XLA_FLAGS', None)
+    env.update(extra_env or {})
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, 'tests', 'nightly', 'elastic_train_worker.py'),
+         '--mode', mode, '--ckpt-dir', ckpt, '--out', out,
+         '--steps', '6', '--kill-at', '3'],
+        capture_output=True, text=True, timeout=240, cwd=ROOT, env=env)
+    tail = (res.stdout + res.stderr)[-4000:]
+    if expect_kill:
+        assert res.returncode == -signal.SIGKILL, tail
+    else:
+        assert res.returncode == 0, tail
+    return res
+
+
+@pytest.mark.timeout(600)
+def test_sigkill_resume_parity(tmp_path):
+    """The tentpole parity check, with a REAL ``SIGKILL``: train 6
+    steps straight; train 3 steps, checkpoint, die by SIGKILL; resume
+    from the checkpoint and train the remaining 3. Dropout + shuffled
+    resumable loader + adam + lr schedule — final weights bit-exact."""
+    straight = str(tmp_path / 'straight.npz')
+    resumed = str(tmp_path / 'resumed.npz')
+    ckpt = str(tmp_path / 'ckpt')
+
+    _run_worker('straight', str(tmp_path / 'unused'), straight)
+    _run_worker('crash', ckpt, str(tmp_path / 'crash.npz'),
+                extra_env={'MXNET_CKPT_ASYNC': '1'}, expect_kill=True)
+    # the kill left a committed, uncorrupted checkpoint at step 2
+    mgr = parallel.SharedCheckpointManager(ckpt)
+    assert mgr.latest_step() == 2
+    _run_worker('resume', ckpt, resumed)
+
+    a, b = onp.load(straight), onp.load(resumed)
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        assert a[k].tobytes() == b[k].tobytes(), k

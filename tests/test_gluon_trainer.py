@@ -74,6 +74,76 @@ def test_trainer_states_roundtrip(tmp_path):
     assert trainer2._optimizer.num_update == trainer._optimizer.num_update
 
 
+def test_trainer_states_roundtrip_bit_identical_next_update(tmp_path):
+    """load_states must restore EVERYTHING the next update depends on —
+    adam slots, the global update counter, per-param counts, and the
+    lr-scheduler's mutable state — so the restored trainer's next step
+    is bit-identical to the original's (the elastic-resume contract;
+    a lost num_update would silently reset adam bias correction and the
+    lr schedule)."""
+    def build():
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                                base_lr=0.1)
+        trainer = gluon.Trainer(net.collect_params(), 'adam',
+                                {'learning_rate': 0.1,
+                                 'lr_scheduler': sched})
+        return net, trainer
+
+    def step(net, trainer, s):
+        x = mx.np.array(np.full((2, 3), 0.5 + s, dtype='float32'))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(2)
+
+    net1, tr1 = build()
+    for s in range(4):                       # crosses a scheduler factor
+        step(net1, tr1, s)
+    f = str(tmp_path / 'tr.states')
+    tr1.save_states(f)
+    w_ckpt = {k: v.data().asnumpy().copy()
+              for k, v in net1.collect_params().items()}
+
+    net2, tr2 = build()
+    for k, p in net2.collect_params().items():
+        p.set_data(mx.np.array(w_ckpt[k]))
+    tr2.load_states(f)
+    assert tr2._optimizer.num_update == tr1._optimizer.num_update
+    sch1 = tr1._optimizer.lr_scheduler
+    sch2 = tr2._optimizer.lr_scheduler
+    assert sch2.count == sch1.count
+    assert sch2.base_lr == pytest.approx(sch1.base_lr)
+
+    step(net1, tr1, 4)
+    step(net2, tr2, 4)
+    for k in w_ckpt:
+        a = net1.collect_params()[k].data().asnumpy()
+        b = net2.collect_params()[k].data().asnumpy()
+        assert a.tobytes() == b.tobytes(), k
+
+
+def test_trainer_load_states_accepts_legacy_tuple(tmp_path):
+    """Pre-elastic state files pickled (states, num_update) — they must
+    still load."""
+    import pickle
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), 'adam')
+    x = mx.np.ones((2, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    sd = trainer.state_dict()
+    f = str(tmp_path / 'legacy.states')
+    with open(f, 'wb') as fh:
+        pickle.dump((sd['states'], sd['num_update']), fh)
+    trainer2 = gluon.Trainer(net.collect_params(), 'adam')
+    trainer2.load_states(f)
+    assert trainer2._optimizer.num_update == trainer._optimizer.num_update
+
+
 def test_trainer_with_kvstore_types():
     for kv in ('local', 'device', 'dist_sync'):
         net = _make_net()

@@ -1,0 +1,496 @@
+"""Elastic membership + worker-loss recovery for ``dist_async``.
+
+Two tiers:
+
+* **Server units** — drive ``_AsyncServer``'s elastic handlers
+  (``elastic_join`` / ``elastic_leave`` / ``elastic_commit`` /
+  ``elastic_barrier``) directly through ``_dispatch`` with an
+  injectable clock (``RpcServer.set_clock``): join/gen accounting,
+  deadline ejection of silent members, re-runnable barriers, the
+  late-joiner start-step rule.
+* **Chaos smoke** — ``test_chaos_two_worker_training``: two worker
+  stores in one process run the full elastic step protocol
+  (:class:`mx.train.ElasticGroup`); worker 1 is killed mid-push by a
+  deterministic ``die_after`` fault (no ``bye`` — a preempted VM);
+  the survivor ejects it within ``MXNET_KVSTORE_DEADLINE_S`` (fake
+  clock, zero wall-clock sleeps), rolls back to the last committed
+  step, continues at world size 1, re-admits the restarted worker,
+  and the final weights match the unfaulted reference with zero lost
+  committed steps.
+"""
+
+import socket
+import threading
+import time
+from contextlib import closing
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+from mxnet_tpu.kvstore import dist_async, faults
+from mxnet_tpu.kvstore.dist_async import _AsyncServer
+from mxnet_tpu.train import ElasticGroup, ElasticHalted
+
+
+def _free_port():
+    with closing(socket.socket()) as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------ server units
+
+@pytest.fixture
+def elastic_server(monkeypatch):
+    """A bare server (never start()ed) on a fake clock with a short
+    liveness deadline — every 'second' in these tests is a fake one."""
+    monkeypatch.setenv('MXNET_KVSTORE_DEADLINE_S', '5')
+    srv = _AsyncServer(0, bind_host='127.0.0.1', sid=0)
+    clk = [1000.0]
+    srv.set_clock(lambda: clk[0])
+    yield srv, clk
+    srv._server.server_close()
+
+
+def _join(srv, rank):
+    reply, _ = srv._dispatch({'cmd': 'elastic_join', 'rank': rank}, b'')
+    return reply
+
+
+def _barrier_async(srv, rank, phase, step, out):
+    def run():
+        reply, _ = srv._dispatch({'cmd': 'elastic_barrier', 'rank': rank,
+                                  'phase': phase, 'step': step}, b'')
+        out.append(reply)
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_join_gen_and_resume_step(elastic_server):
+    srv, _clk = elastic_server
+    r0 = _join(srv, 0)
+    assert r0['ok'] and r0['gen'] == 1 and r0['live'] == [0]
+    assert r0['committed'] == -1 and r0['resume'] == 0
+    r1 = _join(srv, 1)
+    assert r1['gen'] == 2 and r1['live'] == [0, 1]
+    # idempotent re-join of a still-live member: no gen churn
+    again = _join(srv, 0)
+    assert again['gen'] == 2 and again['resume'] == 0
+
+
+def test_leave_pops_member_and_bumps_gen(elastic_server):
+    srv, _clk = elastic_server
+    _join(srv, 0)
+    _join(srv, 1)
+    reply, _ = srv._dispatch({'cmd': 'elastic_leave', 'rank': 1}, b'')
+    assert reply['live'] == [0] and reply['gen'] == 3
+    # double leave is a no-op
+    reply, _ = srv._dispatch({'cmd': 'elastic_leave', 'rank': 1}, b'')
+    assert reply['gen'] == 3
+
+
+def test_commit_is_monotonic(elastic_server):
+    srv, _clk = elastic_server
+    reply, _ = srv._dispatch({'cmd': 'elastic_commit', 'step': 5}, b'')
+    assert reply['committed'] == 5
+    reply, _ = srv._dispatch({'cmd': 'elastic_commit', 'step': 3}, b'')
+    assert reply['committed'] == 5          # a stale commit never rewinds
+
+
+def test_barrier_releases_when_all_expected_arrive(elastic_server):
+    srv, _clk = elastic_server
+    _join(srv, 0)
+    _join(srv, 1)
+    out = []
+    t0 = _barrier_async(srv, 0, 'pre', 0, out)
+    time.sleep(0.15)
+    assert t0.is_alive() and not out        # one arrival: still waiting
+    t1 = _barrier_async(srv, 1, 'pre', 0, out)
+    t0.join(10)
+    t1.join(10)
+    assert len(out) == 2
+    for v in out:
+        assert v['ok'] and v['count'] == 2 and v['live'] == [0, 1]
+        assert v['changed'] is False
+
+
+def test_barrier_ejects_silent_member_within_deadline(elastic_server):
+    """Worker 1 joined, then went silent (no heartbeat, no arrival).
+    Once the fake clock passes MXNET_KVSTORE_DEADLINE_S the waiting
+    worker 0 ejects it and releases with changed=True — and worker 0
+    itself, equally heartbeat-stale but ARRIVED, is not ejected."""
+    srv, clk = elastic_server
+    _join(srv, 0)
+    _join(srv, 1)
+    out = []
+    t = _barrier_async(srv, 0, 'pre', 0, out)
+    time.sleep(0.15)
+    assert t.is_alive()                     # deadline not reached: waits
+    clk[0] += 5.1                           # past the 5s fake deadline
+    t.join(10)
+    assert not t.is_alive()
+    v = out[0]
+    assert v['ok'] and v['live'] == [0] and v['count'] == 1
+    assert v['changed'] is True
+    assert _join(srv, 0)['gen'] == 3        # ejection bumped the gen
+
+
+def test_barrier_is_rerunnable_after_release(elastic_server):
+    """Rollback-redo of the SAME (phase, step): the release must have
+    cleared the arrivals, so the redo forms a fresh barrier instead of
+    sailing through on stale arrivals before the leader's rollback."""
+    srv, _clk = elastic_server
+    _join(srv, 0)
+    _join(srv, 1)
+    out = []
+    t0 = _barrier_async(srv, 0, 'pre', 7, out)
+    t1 = _barrier_async(srv, 1, 'pre', 7, out)
+    t0.join(10)
+    t1.join(10)
+    assert len(out) == 2                    # round 1 released
+    redo = []
+    r0 = _barrier_async(srv, 0, 'pre', 7, redo)
+    time.sleep(0.15)
+    assert r0.is_alive() and not redo       # fresh round: waits for 1
+    r1 = _barrier_async(srv, 1, 'pre', 7, redo)
+    r0.join(10)
+    r1.join(10)
+    assert len(redo) == 2 and all(v['ok'] for v in redo)
+
+
+def test_release_leaves_no_stale_arrivals(elastic_server):
+    """The waiter woken by a release must NOT re-register its arrival
+    before joining the cached verdict: rank 0 was blocked in the
+    barrier when rank 1 completed it, and after both return the
+    arrivals set for (phase, step) must be empty — a stale rank left
+    behind would let the next run of the same barrier release with the
+    wrong world count."""
+    srv, _clk = elastic_server
+    _join(srv, 0)
+    _join(srv, 1)
+    out = []
+    t0 = _barrier_async(srv, 0, 'pre', 7, out)
+    time.sleep(0.15)                        # rank 0 is parked inside
+    t1 = _barrier_async(srv, 1, 'pre', 7, out)
+    t0.join(10)
+    t1.join(10)
+    assert len(out) == 2 and all(v['ok'] for v in out)
+    with srv._elastic_cv:
+        assert srv._elastic_arrivals.get(('pre', 7), set()) == set()
+
+
+def test_late_joiner_sits_out_inflight_steps(elastic_server):
+    """A worker (re)joining while step 3 is in flight gets resume=4:
+    it is NOT expected at step-3 barriers (its gradient would be scaled
+    for a world it wasn't part of) and cannot deadlock them."""
+    srv, _clk = elastic_server
+    _join(srv, 0)
+    out = []
+    _barrier_async(srv, 0, 'pre', 3, out).join(10)
+    assert out[0]['count'] == 1
+    r1 = _join(srv, 1)
+    assert r1['resume'] == 4
+    # the in-flight step's post barrier releases solo around the joiner
+    post = []
+    _barrier_async(srv, 0, 'post', 3, post).join(10)
+    assert post[0]['ok'] and post[0]['count'] == 1
+    assert post[0]['live'] == [0, 1]
+    # from its start step on, the joiner is required
+    pre4 = []
+    t0 = _barrier_async(srv, 0, 'pre', 4, pre4)
+    time.sleep(0.15)
+    assert t0.is_alive()
+    t1 = _barrier_async(srv, 1, 'pre', 4, pre4)
+    t0.join(10)
+    t1.join(10)
+    assert [v['count'] for v in pre4] == [2, 2]
+
+
+def test_barrier_rejects_nonmember(elastic_server):
+    srv, _clk = elastic_server
+    reply, _ = srv._dispatch({'cmd': 'elastic_barrier', 'rank': 9,
+                              'phase': 'pre', 'step': 0}, b'')
+    assert not reply['ok'] and 'not an elastic member' in reply['error']
+
+
+def test_barrier_wall_timeout_rolls_back_arrival(monkeypatch):
+    """A live-but-never-arriving peer (fresh heartbeats, so no
+    ejection) bounds the wait at the wall deadline with a clear error,
+    and the timed-out arrival is rolled back."""
+    monkeypatch.setenv('MXNET_KVSTORE_DEADLINE_S', '0.3')
+    srv = _AsyncServer(0, bind_host='127.0.0.1', sid=0)
+    try:
+        _join(srv, 0)
+        _join(srv, 1)
+        stop = threading.Event()
+
+        def keep_fresh():               # rank 1 heartbeats but never arrives
+            while not stop.wait(0.05):
+                srv._dispatch({'cmd': 'ping', 'rank': 1}, b'')
+
+        hb = threading.Thread(target=keep_fresh, daemon=True)
+        hb.start()
+        try:
+            reply, _ = srv._dispatch({'cmd': 'elastic_barrier', 'rank': 0,
+                                      'phase': 'pre', 'step': 0}, b'')
+        finally:
+            stop.set()
+            hb.join(5)
+        assert not reply['ok'] and 'timeout' in reply['error']
+        with srv._elastic_cv:
+            assert 0 not in srv._elastic_arrivals.get(('pre', 0), set())
+    finally:
+        srv._server.server_close()
+
+
+# --------------------------------------------------------- group over RPC
+
+@pytest.fixture
+def async_store(monkeypatch):
+    created = []
+
+    def make(rank=0, **env):
+        port = int(env.pop('_port', 0)) or _free_port()
+        monkeypatch.setenv('MX_COORDINATOR', f'127.0.0.1:{_free_port()}')
+        monkeypatch.setenv('MXNET_KVSTORE_ASYNC_PORT', str(port))
+        monkeypatch.setenv('MXNET_KVSTORE_HEARTBEAT_S', '3600')
+        monkeypatch.setenv('MX_PROC_ID', str(rank))
+        monkeypatch.setenv('MX_NPROC', '1')
+        for k, v in env.items():
+            monkeypatch.setenv(k, str(v))
+        kv = kvstore.create('dist_async')
+        created.append((kv, port))
+        return kv, port
+
+    yield make
+    faults.clear()
+    for kv, port in created:
+        try:
+            kv.close()
+        except Exception:
+            pass
+    for _, port in created:
+        srv = dist_async._SERVERS.pop(port, None)
+        if srv is not None:
+            srv.stop()
+
+
+def test_put_overwrites_unlike_init_and_push(async_store):
+    """``put`` is the rollback primitive: unconditional overwrite,
+    where init is first-write-wins and push routes through addition."""
+    kv, _ = async_store()
+    kv.init('w', mx.np.ones((4,)))
+    kv.init('w', mx.np.full((4,), 9.0))       # first write wins
+    onp.testing.assert_allclose(kv.pull('w').asnumpy(), 1.0)
+    kv.push('w', mx.np.ones((4,)))            # additive
+    onp.testing.assert_allclose(kv.pull('w').asnumpy(), 2.0)
+    kv.put('w', mx.np.full((4,), 7.0))        # overwrite
+    onp.testing.assert_allclose(kv.pull('w').asnumpy(), 7.0)
+
+
+def test_elastic_group_single_worker_cycle(async_store):
+    kv, _ = async_store()
+    group = ElasticGroup(kv)
+    assert group.rank == 0 and group.resume_step == 0
+    assert group.committed == -1
+    pre = group.pre_step(0)
+    assert pre['count'] == 1 and pre['live'] == [0]
+    assert group.is_leader(pre)
+    post = group.post_step(0)
+    assert post['changed'] is False
+    assert group.commit(0) == 0 and group.committed == 0
+    group.leave()
+
+
+def test_elastic_group_halts_below_min_workers(async_store):
+    kv, _ = async_store()
+    group = ElasticGroup(kv, min_workers=2)
+    with pytest.raises(ElasticHalted, match='MXNET_ELASTIC_MIN_WORKERS'):
+        group.pre_step(0)
+
+
+def test_server_stats_report_elastic_state(async_store):
+    kv, _ = async_store()
+    group = ElasticGroup(kv)
+    group.pre_step(0)
+    group.post_step(0)
+    group.commit(0)
+    health = kv.server_health()[0]['elastic']
+    assert health['live'] == [0] and health['committed'] == 0
+    assert health['step'] == 0 and health['gen'] >= 1
+
+
+# ------------------------------------------------------------ chaos smoke
+
+DIM = 8
+LR = 0.1
+N_STEPS = 8
+DIE_ON_PUSH = 2       # worker 1's 2nd push == its step-1 gradient
+
+
+def _grad(step):
+    # step-determined gradient: the aggregate update per step is
+    # -LR*_grad(step) at ANY world size (each live worker pushes its
+    # 1/count share), so the faulted run must land exactly where the
+    # unfaulted reference does
+    return onp.full((DIM,), 0.01 * (step + 1), 'f')
+
+
+def _reference_weights():
+    w = onp.zeros((DIM,), 'f')
+    for s in range(N_STEPS):
+        w = w - LR * _grad(s)
+    return w
+
+
+def _worker_loop(kv, group, log, ckpt, stop_at=N_STEPS):
+    """The elastic step protocol from the ElasticGroup docstring."""
+    step = max(group.resume_step, group.committed + 1)
+    while step < stop_at:
+        pre = group.pre_step(step)
+        kv.pull('w')                        # what a real step trains on
+        kv.push('w', mx.np.array(-LR * _grad(step) / pre['count']))
+        post = group.post_step(step)
+        log.append({'step': step, 'count': post['count'],
+                    'live': list(post['live']),
+                    'changed': post['changed']})
+        if post['changed']:
+            if group.is_leader(post):
+                # roll the store back to the last committed checkpoint
+                kv.put('w', mx.np.array(ckpt[group.committed]))
+            step = group.committed + 1
+            continue
+        if group.is_leader(post):
+            ckpt[step] = kv.pull('w').asnumpy().copy()
+            group.commit(step)
+        step += 1
+
+
+@pytest.mark.timeout(180)
+def test_chaos_two_worker_training(monkeypatch):
+    """The tier-1 chaos training smoke (ISSUE 13 acceptance): worker 1
+    is killed mid-push by ``die_after`` (dirty death, no bye), the
+    survivor ejects it only once the (fake) clock passes the liveness
+    deadline, rolls back the half-applied step, continues solo, then
+    re-admits worker 1's restarted incarnation — final weights match
+    the unfaulted reference and every step 0..N-1 was committed."""
+    port = _free_port()
+    monkeypatch.setenv('MX_COORDINATOR', f'127.0.0.1:{_free_port()}')
+    monkeypatch.setenv('MXNET_KVSTORE_ASYNC_PORT', str(port))
+    monkeypatch.setenv('MXNET_KVSTORE_HEARTBEAT_S', '3600')
+    monkeypatch.setenv('MXNET_KVSTORE_DEADLINE_S', '30')
+    monkeypatch.setenv('MX_NPROC', '2')
+    stores = []
+
+    def make_store(rank):
+        monkeypatch.setenv('MX_PROC_ID', str(rank))
+        kv = kvstore.create('dist_async')
+        stores.append(kv)
+        return kv
+
+    errors = []
+    try:
+        kv0 = make_store(0)
+        kv0.init('w', mx.np.zeros((DIM,)))
+        srv = dist_async._SERVERS[port]
+        # fake clock, anchored at real monotonic so pre-hook stamps mix
+        # safely; liveness from here on advances only when WE say so
+        clk = [time.monotonic()]
+        srv.set_clock(lambda: clk[0])
+
+        faults.configure(f'die_after:push:{DIE_ON_PUSH}:rank=1')
+        ckpt = {}                      # leader's committed checkpoints
+        log0, log1a, log1b = [], [], []
+        died = threading.Event()
+
+        def run0():
+            try:
+                group = ElasticGroup(kv0)
+                _worker_loop(kv0, group, log0, ckpt)
+            except BaseException as e:   # noqa: BLE001 - surfaced below
+                errors.append(('w0', e))
+
+        def run1_doomed():
+            kv1 = make_store(1)
+            try:
+                group = ElasticGroup(kv1)
+                _worker_loop(kv1, group, log1a, ckpt)
+            except faults.InjectedWorkerDeath:
+                died.set()             # dirty death: no bye, no leave
+            except BaseException as e:
+                errors.append(('w1', e))
+
+        t0 = threading.Thread(target=run0, daemon=True)
+        t1 = threading.Thread(target=run1_doomed, daemon=True)
+        t0.start()
+        t1.start()
+        assert died.wait(60), 'fault never fired'
+        t1.join(30)
+
+        # the dead worker is still a member until the deadline passes:
+        # ejection is deadline-driven, not arrival-driven
+        with srv._elastic_cv:
+            assert 1 in srv._elastic_members
+        clk[0] += 31                   # past MXNET_KVSTORE_DEADLINE_S
+
+        # restart gate: survivor must have ejected + committed past the
+        # faulted step before the new incarnation joins
+        with srv._elastic_cv:
+            assert srv._elastic_cv.wait_for(
+                lambda: srv._elastic_committed >= 2, timeout=60)
+
+        def run1_restarted():
+            kv1b = make_store(1)
+            try:
+                group = ElasticGroup(kv1b)
+                assert group.committed >= 2
+                _worker_loop(kv1b, group, log1b, ckpt)
+            except BaseException as e:
+                errors.append(('w1b', e))
+
+        t1b = threading.Thread(target=run1_restarted, daemon=True)
+        t1b.start()
+        t0.join(120)
+        t1b.join(120)
+        assert not t0.is_alive() and not t1b.is_alive()
+        assert errors == []
+
+        # --- chaos actually happened, and recovery actually recovered
+        assert faults.injected()['die'] == 1
+        solo = [e for e in log0 if e['live'] == [0]]
+        assert solo, 'worker 1 was never ejected'
+        readmitted = [e for e in log0
+                      if e['live'] == [0, 1] and e['count'] == 2
+                      and e['step'] > solo[0]['step']]
+        assert readmitted, 'restarted worker 1 was never re-admitted'
+        rolled_back = [e for e in log0 if e['changed']]
+        assert rolled_back, 'membership changes never triggered rollback'
+
+        # --- zero lost committed steps, exactly-once per step
+        assert sorted(ckpt) == list(range(N_STEPS))
+        health = kv0.server_health()[0]['elastic']
+        assert health['committed'] == N_STEPS - 1
+        assert health['live'] == [0, 1]
+
+        # --- parity with the unfaulted reference
+        final = kv0.pull('w').asnumpy()
+        onp.testing.assert_allclose(final, _reference_weights(),
+                                    rtol=1e-6, atol=1e-7)
+        # the restarted worker resumed from the committed checkpoint,
+        # not from scratch: its first participating step is after the
+        # step it was ejected from
+        if log1b:
+            assert log1b[0]['step'] > log1a[-1]['step']
+    finally:
+        faults.clear()
+        for kv in stores:
+            try:
+                kv.close()
+            except Exception:
+                pass
+        srv = dist_async._SERVERS.pop(port, None)
+        if srv is not None:
+            srv.stop()

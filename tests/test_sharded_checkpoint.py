@@ -96,6 +96,142 @@ def test_block_params_sharded_roundtrip(tmp_path):
         assert_almost_equal(after[k], before[k])
 
 
+# ------------------------------------------------- crash-atomic commit
+
+class _Killed(BaseException):
+    """Stands in for SIGKILL: aborts the save at an exact commit-protocol
+    point, leaving the filesystem exactly as a real kill would."""
+
+
+def _kill_at(point):
+    from mxnet_tpu.parallel import checkpoint as C
+
+    def hook(name):
+        if name == point:
+            raise _Killed(point)
+    return C.install_crash_hook(hook)
+
+
+def _saved_tree(v):
+    return {'w': jnp.full((2,), float(v))}
+
+
+@pytest.mark.parametrize('point', ['ckpt.staged', 'ckpt.renamed'])
+def test_kill_mid_save_keeps_previous_checkpoint(tmp_path, point):
+    """A kill after the staging write, or even after the atomic rename
+    but before the manifest commit, must leave ``latest_step()`` on the
+    previous complete checkpoint — the manifest is the only source of
+    truth, and it is written last."""
+    from mxnet_tpu.parallel import checkpoint as C
+    d = str(tmp_path / 'crash')
+    mgr = parallel.SharedCheckpointManager(d, max_to_keep=3)
+    mgr.save(0, _saved_tree(0))
+    prev = _kill_at(point)
+    try:
+        with pytest.raises(_Killed):
+            mgr.save(1, _saved_tree(1))
+    finally:
+        C.install_crash_hook(prev)
+    assert mgr.latest_step() == 0
+    # the "restarted process": a fresh manager sweeps staging debris
+    # and still restores the previous complete checkpoint
+    mgr2 = parallel.SharedCheckpointManager(d, max_to_keep=3)
+    assert mgr2.latest_step() == 0
+    assert not any(n.startswith('.staging-') or n == '.MANIFEST.tmp'
+                   for n in __import__('os').listdir(d))
+    assert_almost_equal(np.asarray(mgr2.restore()['w']), np.zeros(2))
+    # and the interrupted step can be re-saved cleanly
+    mgr2.save(1, _saved_tree(1))
+    assert mgr2.latest_step() == 1
+    assert_almost_equal(np.asarray(mgr2.restore()['w']), np.ones(2))
+
+
+def test_kill_after_manifest_commit_keeps_new_checkpoint(tmp_path):
+    """Past the manifest rename the checkpoint IS committed: a kill in
+    the cleanup tail (pruning old steps) must not lose it."""
+    from mxnet_tpu.parallel import checkpoint as C
+    d = str(tmp_path / 'crash2')
+    mgr = parallel.SharedCheckpointManager(d, max_to_keep=3)
+    mgr.save(0, _saved_tree(0))
+    prev = _kill_at('ckpt.committed')
+    try:
+        with pytest.raises(_Killed):
+            mgr.save(1, _saved_tree(1))
+    finally:
+        C.install_crash_hook(prev)
+    mgr2 = parallel.SharedCheckpointManager(d, max_to_keep=3)
+    assert mgr2.latest_step() == 1
+    assert_almost_equal(np.asarray(mgr2.restore()['w']), np.ones(2))
+
+
+def test_kill_at_every_point_never_corrupts_latest(tmp_path):
+    """The acceptance sweep: kill the save at EVERY protocol point in
+    turn; after each, ``latest_step()`` must be either the previous or
+    the new complete checkpoint and must restore cleanly."""
+    from mxnet_tpu.parallel import checkpoint as C
+    d = str(tmp_path / 'sweep')
+    mgr = parallel.SharedCheckpointManager(d, max_to_keep=2)
+    mgr.save(0, _saved_tree(0))
+    committed = 0
+    for step, point in enumerate(
+            ['ckpt.staged', 'ckpt.renamed', 'ckpt.committed'], start=1):
+        prev = _kill_at(point)
+        try:
+            with pytest.raises(_Killed):
+                mgr.save(step, _saved_tree(step))
+        finally:
+            C.install_crash_hook(prev)
+        if point == 'ckpt.committed':
+            committed = step
+        fresh = parallel.SharedCheckpointManager(d, max_to_keep=2)
+        assert fresh.latest_step() == committed
+        got = np.asarray(fresh.restore()['w'])
+        assert_almost_equal(got, np.full((2,), float(committed)))
+        mgr = fresh
+
+
+def test_kill_while_resaving_committed_step_never_tears_manifest(tmp_path):
+    """Re-saving a step that is ALREADY committed (the restored step
+    after a rollback) deletes the existing step directory before the
+    rename. A kill in that window must not leave the manifest pointing
+    at the deleted directory: the step is un-committed from the
+    manifest first, so ``latest_step()`` falls back to the previous
+    complete checkpoint and restores cleanly."""
+    from mxnet_tpu.parallel import checkpoint as C
+    d = str(tmp_path / 'resave')
+    mgr = parallel.SharedCheckpointManager(d, max_to_keep=3)
+    mgr.save(0, _saved_tree(0))
+    mgr.save(1, _saved_tree(1))
+    prev = _kill_at('ckpt.cleared')
+    try:
+        with pytest.raises(_Killed):
+            mgr.save(1, _saved_tree(41))        # re-save committed step
+    finally:
+        C.install_crash_hook(prev)
+    mgr2 = parallel.SharedCheckpointManager(d, max_to_keep=3)
+    assert mgr2.latest_step() == 0              # never the torn step 1
+    assert_almost_equal(np.asarray(mgr2.restore()['w']), np.zeros(2))
+    # and the re-save goes through cleanly on retry
+    mgr2.save(1, _saved_tree(41))
+    assert mgr2.latest_step() == 1
+    assert_almost_equal(np.asarray(mgr2.restore()['w']),
+                        np.full((2,), 41.0))
+
+
+def test_manifest_missing_falls_back_to_legacy_scan(tmp_path):
+    """Checkpoint dirs written before the manifest protocol (no
+    MANIFEST.json) are still discovered by the integer-dir scan."""
+    import os as _os
+    d = str(tmp_path / 'legacy')
+    mgr = parallel.SharedCheckpointManager(d)
+    mgr.save(3, _saved_tree(3))
+    _os.remove(_os.path.join(d, 'MANIFEST.json'))
+    mgr2 = parallel.SharedCheckpointManager(d)
+    assert mgr2.latest_step() == 3
+    assert_almost_equal(np.asarray(mgr2.restore()['w']),
+                        np.full((2,), 3.0))
+
+
 def test_restore_or_init(tmp_path):
     from mxnet_tpu.parallel.checkpoint import restore_or_init
     mgr = parallel.SharedCheckpointManager(str(tmp_path / 'el'),

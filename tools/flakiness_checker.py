@@ -21,6 +21,14 @@ logger = logging.getLogger('flakiness_checker')
 
 DEFAULT_NUM_TRIALS = 30
 
+# --elastic with no explicit test: loop the full kill/resume cycle —
+# train, SIGKILL mid-run, resume, assert bit-exact parity vs straight-
+# through — plus the 2-worker chaos smoke (death/ejection/re-admission)
+ELASTIC_TESTS = (
+    'tests/test_elastic_train.py::test_sigkill_resume_parity',
+    'tests/test_kvstore_elastic.py::test_chaos_two_worker_training',
+)
+
 
 def find_test_path(test_file):
     """Locate the test file under cwd (reference find_test_path)."""
@@ -89,8 +97,10 @@ def run_trials(path, name, num_trials, seed, verbosity, race=False):
 def parse_args():
     parser = argparse.ArgumentParser(
         description='Check a test for flakiness')
-    parser.add_argument('test', help='test spec: file.py::name, '
-                        'file.py, or bare test function name')
+    parser.add_argument('test', nargs='?', default=None,
+                        help='test spec: file.py::name, file.py, or '
+                        'bare test function name (optional with '
+                        '--elastic)')
     parser.add_argument('-n', '--num-trials', type=int,
                         default=DEFAULT_NUM_TRIALS)
     parser.add_argument('-s', '--seed', type=int, default=None,
@@ -100,14 +110,28 @@ def parse_args():
     parser.add_argument('--race', action='store_true',
                         help='run every trial with MXNET_RACE_CHECK=1 '
                         '(Eraser-style dynamic race/deadlock checker)')
-    return parser.parse_args()
+    parser.add_argument('--elastic', action='store_true',
+                        help='elastic-training soak: loop the '
+                        'kill/resume parity cycle and the 2-worker '
+                        'chaos smoke (default specs when no test is '
+                        'given)')
+    args = parser.parse_args()
+    if args.test is None and not args.elastic:
+        parser.error('a test spec is required unless --elastic is given')
+    return args
 
 
 def main():
     args = parse_args()
-    path, name = parse_test_spec(args.test)
-    failures = run_trials(path, name, args.num_trials, args.seed,
-                          args.verbosity, race=args.race)
+    if args.test is not None:
+        specs = [args.test]
+    else:
+        specs = list(ELASTIC_TESTS)
+    failures = 0
+    for spec in specs:
+        path, name = parse_test_spec(spec)
+        failures += run_trials(path, name, args.num_trials, args.seed,
+                               args.verbosity, race=args.race)
     sys.exit(1 if failures else 0)
 
 
