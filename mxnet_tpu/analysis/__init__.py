@@ -27,11 +27,16 @@ from .report import AnalysisReport, Finding, strict_enabled
 from .walker import GraphView, trace_block, trace_function, iter_eqns
 from . import rules
 from .rules import all_rules, run_rules
+from . import costs
+from .costs import CostReport, cost_of_graph
+from .device_specs import DEVICE_SPECS, get_device_spec
 from . import locks
 from . import race
 
-__all__ = ['lint', 'AnalysisReport', 'Finding', 'GraphView',
-           'all_rules', 'rules', 'strict_enabled', 'locks', 'race']
+__all__ = ['lint', 'cost_report', 'AnalysisReport', 'Finding',
+           'GraphView', 'CostReport', 'cost_of_graph', 'costs',
+           'DEVICE_SPECS', 'get_device_spec', 'all_rules', 'rules',
+           'strict_enabled', 'locks', 'race']
 
 
 def lint(fn_or_block, *example_args, train=False, rules=None,
@@ -87,6 +92,24 @@ def lint(fn_or_block, *example_args, train=False, rules=None,
     run_rules(graph, report, rules=rules, compile_rules=donation,
               **config)
     return report
+
+
+def cost_report(fn_or_block, *example_args, train=False,
+                device_spec=None, name=None, **config):
+    """Analytical roofline cost of a HybridBlock or step function: total
+    FLOPs, bytes moved, arithmetic intensity vs machine balance, and
+    predicted peak HBM (donation-aware liveness). Same tracing contract
+    as :func:`lint`; returns a :class:`CostReport`.
+
+    ``device_spec`` picks the roofline device: a name from
+    :data:`DEVICE_SPECS`, a JSON path, or a dict (default: the
+    BENCH_r05 measured entry, overridable via
+    ``MXNET_ANALYSIS_DEVICE_SPEC``). ``while_trips=N`` sets the assumed
+    trip count for ``lax.while_loop`` equations (static analysis cannot
+    know it; the assumption is recorded on the report).
+    """
+    return costs.analyze(fn_or_block, *example_args, train=train,
+                         device_spec=device_spec, name=name, **config)
 
 
 def lint_graph(graph, strict=None, rules=None, donation=False, **config):

@@ -5,7 +5,8 @@ config: dict)`` registered under a stable kebab-case name — the names
 appear in reports, docs/static-analysis.md, and the ``--rules`` CLI
 filter. Registration order is report order.
 
-The six shipped checks (ISSUE 7 tentpole):
+The six correctness checks (ISSUE 7 tentpole) plus four roofline perf
+lints over the analysis.costs pass (ISSUE 14, rules/perf.py):
 
 ==========================  =================================================
 rule                        catches
@@ -22,6 +23,14 @@ dead-code                   unused params/inputs, pass-through or constant
 donation-audit              static_alloc donation claims vs XLA's compiled
                             input-output aliasing; donatable-but-undonated
                             buffers
+unfused-dequant             int8 dequantize as a standalone equation chain
+                            next to a matmul instead of a fused epilogue
+bandwidth-bound-chain       elementwise/reduce runs below machine balance
+                            with no ops/pallas kernel (Pallas target list)
+small-collective            psum/reduce-scatter under the kvstore
+                            fusion-buffer bucket threshold
+padding-waste               serve pad-to-bucket FLOP waste above
+                            MXNET_ANALYSIS_PAD_WASTE_FRAC
 ==========================  =================================================
 """
 
@@ -51,7 +60,15 @@ def get_rule(name):
 
 
 def run_rules(graph, report, rules=None, compile_rules=False, **config):
-    """Run the selected rules (default: all) over a GraphView."""
+    """Run the selected rules (default: all) over a GraphView.
+    Unknown rule names raise ValueError — a typo'd ``rules=[...]``
+    must not silently lint nothing."""
+    if rules is not None:
+        unknown = [n for n in rules if n not in _RULES]
+        if unknown:
+            raise ValueError(
+                f'unknown analysis rule(s) {unknown}: available rules '
+                f'are {sorted(_RULES)}')
     selected = _RULES if rules is None else {
         n: _RULES[n] for n in rules}
     for name, fn in selected.items():
@@ -69,3 +86,4 @@ from . import recompile          # noqa: E402,F401
 from . import transfer           # noqa: E402,F401
 from . import dead_code          # noqa: E402,F401
 from . import donation           # noqa: E402,F401
+from . import perf               # noqa: E402,F401

@@ -1,6 +1,9 @@
 """dead-code: unused params/inputs, degenerate outputs, dead equations.
 
-Three independent checks, all over the outermost jaxpr:
+Three independent checks; unused-argument and output checks read the
+outermost jaxpr (that is where the graph's arguments live), while dead
+equations are counted through nested sub-jaxprs — dead compute inside a
+scan/while/cond body repeats every iteration:
 
 * **unused arguments** — a param/input invar no eqn reads and no output
   returns. For params this usually means a layer was constructed but
@@ -23,6 +26,7 @@ Three independent checks, all over the outermost jaxpr:
 from jax import core as _core
 
 from . import register_rule
+from ..walker import iter_eqns
 
 
 def _dce(jaxpr):
@@ -95,14 +99,20 @@ def run(graph, report, config):
 
     live = _dce(jaxpr)
     if live is not None:
-        n_dead = len(jaxpr.eqns) - len(live.eqns)
+        # count nested equations too: dce_jaxpr prunes inside
+        # scan/while/cond/pjit bodies, and dead compute hiding in a
+        # decode loop repeats every iteration — the outermost eqn list
+        # alone would miss it entirely
+        n_total = sum(1 for _ in iter_eqns(jaxpr))
+        n_live = sum(1 for _ in iter_eqns(live))
+        n_dead = n_total - n_live
         if n_dead > int(config.get('dead_eqn_info', 0) or 0):
             census = {}
             live_count = {}
-            for eqn in live.eqns:
+            for eqn, _d in iter_eqns(live):
                 live_count[eqn.primitive.name] = \
                     live_count.get(eqn.primitive.name, 0) + 1
-            for eqn in jaxpr.eqns:
+            for eqn, _d in iter_eqns(jaxpr):
                 census[eqn.primitive.name] = \
                     census.get(eqn.primitive.name, 0) + 1
             dead = {k: v - live_count.get(k, 0) for k, v in census.items()

@@ -49,7 +49,8 @@ class GraphView:
 
     def __init__(self, closed_jaxpr, args, out_kinds, name,
                  source='function', block=None, static_alloc=False,
-                 donate_groups=(), lower_fn=None, notes=None):
+                 donate_groups=(), lower_fn=None, notes=None,
+                 suppressions=None):
         self.closed = closed_jaxpr
         self.jaxpr = closed_jaxpr.jaxpr
         self.consts = list(closed_jaxpr.consts)
@@ -65,6 +66,12 @@ class GraphView:
         # avals; None when the caller didn't supply a compilable form
         self.lower_fn = lower_fn
         self.notes = list(notes or [])
+        # rule -> justification, collected from `_analysis_suppressions`
+        # dicts on the block tree (docs/static-analysis.md "Suppressing
+        # a finding"): a justified suppression downgrades that rule's
+        # findings to info instead of dropping them — the report still
+        # shows the pattern exists and why it is accepted.
+        self.suppressions = dict(suppressions or {})
 
     # ---------------------------------------------------------------- helpers
     @property
@@ -194,6 +201,20 @@ def _example_key():
     return jax.random.PRNGKey(0)
 
 
+def collect_suppressions(block):
+    """Gather ``_analysis_suppressions`` ({rule: justification}) from a
+    block and all its children. A child's entry wins over the parent's
+    only if the parent did not set one — outer blocks own the policy."""
+    out = {}
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        for rule, why in getattr(b, '_analysis_suppressions', {}).items():
+            out.setdefault(rule, why)
+        stack.extend(getattr(b, '_children', {}).values())
+    return out
+
+
 def trace_block(block, *example_args, train=False, name=None):
     """Trace a (Hybrid)Block's forward to a GraphView — the same capture
     ``hybridize`` performs, shapes taken from ``example_args`` (NDArrays,
@@ -301,7 +322,8 @@ def trace_block(block, *example_args, train=False, name=None):
                      name or type(block).__name__, source='block',
                      block=block, static_alloc=static_alloc,
                      donate_groups=donate_groups, lower_fn=lower_fn,
-                     notes=notes)
+                     notes=notes,
+                     suppressions=collect_suppressions(block))
 
 
 def _label_args(closed, key, in_sds, main_sds, aux_sds, main_names,

@@ -518,6 +518,14 @@ class _CachedGraph:
             return
         self.block._analysis_report = report
         profiler.attach_analysis(name, report)
+        if os.environ.get('MXNET_ANALYSIS_COSTS', '1') != '0':
+            try:
+                cost = analysis.cost_of_graph(graph)
+                self.block._cost_report = cost
+                profiler.attach_cost(name, cost)
+            except Exception as e:   # noqa: BLE001 - advisory only
+                warnings.warn(f'{name}: cost model failed: '
+                              f'{type(e).__name__}: {e}', stacklevel=4)
         if report.findings:
             warnings.warn(str(report), stacklevel=4)
         report.raise_if_errors()

@@ -56,6 +56,9 @@ _PAD_QUANTUM = 65536  # elements; bounds the number of distinct jit shapes
 
 
 def fusion_buffer_bytes():
+    """Bucket cap in bytes — also the small-collective lint threshold
+    (mx.analysis): a standalone collective under this size indicates an
+    unbucketed push that make_buckets would have coalesced."""
     return int(float(os.environ.get('MXNET_KVSTORE_FUSION_BUFFER_MB', '64'))
                * 1e6)
 

@@ -71,7 +71,26 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
     return out.reshape(sq, b, heads * hd)
 
 
-@register('flash_attention', f32_only=True)
+def _attention_pallas_cost(eqn):
+    """Analytical cost for the fused flash-attention kernel
+    (mx.analysis.costs): two matmuls (QK^T and PV) over the full score
+    grid, 4·B·H·T·S·d flops. Causal kernels skip ~half the blocks; this
+    prices the dense upper bound since masking isn't visible in the eqn.
+    Non-pallas equations return None so the primitive table handles the
+    XLA fallback."""
+    if eqn.primitive.name != 'pallas_call':
+        return None
+    q, k = eqn.invars[0].aval, eqn.invars[1].aval
+    t, d = q.shape[-2], q.shape[-1]
+    s = k.shape[-2]
+    bh = 1
+    for n in q.shape[:-2]:
+        bh *= n
+    return 4 * bh * t * s * d
+
+
+@register('flash_attention', f32_only=True, fused_kernel=True,
+          cost=_attention_pallas_cost)
 def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
                     block_k=128):
     """Blockwise fused attention (Pallas on TPU, XLA fallback elsewhere).
@@ -85,7 +104,8 @@ def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
                block_q=block_q, block_k=block_k)
 
 
-@register('multi_head_attention')
+@register('multi_head_attention', fused_kernel=True,
+          cost=_attention_pallas_cost)
 def multi_head_attention(q, k, v, num_heads, mask=None, dropout_p=0.0,
                          causal=False, key=None):
     """Fused scaled-dot-product attention (batch, seq, embed) — the TPU-first

@@ -378,7 +378,22 @@ def batch_norm_train(x, gamma, beta, eps=1e-5, axis=1, fix_gamma=False):
     return out, mean, var
 
 
-@register('layer_norm', aliases=('LayerNorm',), f32_only=True)
+def _norm_pallas_cost(eqn):
+    """Analytical cost for the fused Pallas norm kernels (mx.analysis.costs).
+
+    The single-pass kernel reads each element once and does O(1) arithmetic
+    per element (center/square, rsqrt-scale, affine) — price it at 5 flops
+    per output element. Non-pallas equations return None so the generic
+    primitive table handles the XLA fallback lowering.
+    """
+    if eqn.primitive.name != 'pallas_call':
+        return None
+    out = max((v.aval for v in eqn.outvars), key=lambda a: a.size)
+    return 5 * out.size
+
+
+@register('layer_norm', aliases=('LayerNorm',), f32_only=True,
+          fused_kernel=True, cost=_norm_pallas_cost)
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
     """Reference: src/operator/nn/layer_norm.cc (hand-fused CUDA kernel).
     Last-axis norms take the Pallas single-HBM-pass kernel on TPU
@@ -460,7 +475,8 @@ def moments(data, axes=None, keepdims=False):
     return mean, var
 
 
-@register('rms_norm', f32_only=True)
+@register('rms_norm', f32_only=True, fused_kernel=True,
+          cost=_norm_pallas_cost)
 def rms_norm(data, gamma, axis=-1, eps=1e-6):
     """New (no reference analog): RMSNorm for the LLM stack. Last-axis
     case takes the Pallas single-pass kernel (ops/pallas/fused_norms.py)."""

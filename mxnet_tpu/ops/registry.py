@@ -42,12 +42,13 @@ class Op:
     __slots__ = ('name', 'fn', 'differentiable', 'stochastic', 'namespaces',
                  'aliases', 'wrap', 'n_out', 'static_argnums',
                  'static_argnames', 'dynamic_shape', 'vjp_lock',
-                 'host_transfer', 'f32_only')
+                 'host_transfer', 'f32_only', 'cost', 'fused_kernel')
 
     def __init__(self, name, fn, differentiable=True, stochastic=False,
                  namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1,
                  static_argnums=(), static_argnames=(), dynamic_shape=False,
-                 host_transfer=None, f32_only=False):
+                 host_transfer=None, f32_only=False, cost=None,
+                 fused_kernel=False):
         self.name = name
         self.fn = fn
         # held while a DEFERRED jax.vjp re-traces fn at backward() time
@@ -83,6 +84,17 @@ class Op:
         self.host_transfer = bool(dynamic_shape if host_transfer is None
                                   else host_transfer)
         self.f32_only = bool(f32_only)
+        # analysis.costs metadata. cost: callable(eqn) -> flops | None,
+        # consulted for equations attributed to this op (source-info
+        # frames, walker.eqn_op); returning None falls through to the
+        # per-primitive closed forms. The override exists for equations
+        # the primitive table cannot cost from shapes alone — today
+        # pallas_call, whose kernel body the walker does not recurse.
+        # fused_kernel: the op dispatches to a hand-fused kernel
+        # (ops/pallas), so the bandwidth-bound-chain lint must not
+        # re-propose it as a fusion target.
+        self.cost = cost
+        self.fused_kernel = bool(fused_kernel)
 
 
 class DynamicShapeError(TypeError):
@@ -95,7 +107,8 @@ class DynamicShapeError(TypeError):
 def register(name=None, differentiable=True, stochastic=False,
              namespaces=('np', 'nd'), aliases=(), wrap=None, n_out=1,
              static_argnums=(), static_argnames=(), dynamic_shape=False,
-             host_transfer=None, f32_only=False):
+             host_transfer=None, f32_only=False, cost=None,
+             fused_kernel=False):
     """Decorator registering a raw-array function as an operator.
 
     The decorated ``fn`` takes jax arrays (plus static kwargs) and returns a
@@ -112,7 +125,8 @@ def register(name=None, differentiable=True, stochastic=False,
                 static_argnums=static_argnums,
                 static_argnames=static_argnames,
                 dynamic_shape=dynamic_shape,
-                host_transfer=host_transfer, f32_only=f32_only)
+                host_transfer=host_transfer, f32_only=f32_only,
+                cost=cost, fused_kernel=fused_kernel)
         _OPS[opname] = op
         for a in aliases:
             _OPS[a] = op

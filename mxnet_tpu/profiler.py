@@ -21,6 +21,7 @@ _op_stats = {}
 _OP_SAMPLES = 512
 _mem_stats = {'peak_live_bytes': 0}
 _analysis_reports = {}   # graph name -> mx.analysis.AnalysisReport
+_cost_reports = {}       # graph name -> mx.analysis.CostReport
 _serving = {}            # server name -> stats-snapshot provider (mx.serve)
 _checkpoint = {}         # trainer name -> stats-snapshot provider (mx.train)
 
@@ -168,6 +169,16 @@ def attach_analysis(name, report):
         _analysis_reports[name] = report
 
 
+def attach_cost(name, cost):
+    """Attach an analytical roofline cost report
+    (``mx.analysis.CostReport``) so ``dumps()`` shows predicted
+    FLOPs/bytes/peak-HBM next to the measured numbers —
+    ``hybridize(check=True)`` computes one per compiled graph unless
+    ``MXNET_ANALYSIS_COSTS=0``. Latest report per graph name wins."""
+    with _stats_lock:
+        _cost_reports[name] = cost
+
+
 def dumps(reset=False):
     """Aggregate statistics table (reference ``mx.profiler.dumps()`` over
     ``src/profiler/aggregate_stats.cc``): per-op count / total / avg /
@@ -249,6 +260,10 @@ def dumps(reset=False):
             lines.append(f'  {report.summary()}')
             for f in report.findings:
                 lines.append(f'    [{f.severity}] {f.rule}: {f.message}')
+    if _cost_reports:
+        lines.append('Cost (mx.analysis.costs, static roofline):')
+        for name, cost in sorted(_cost_reports.items()):
+            lines.append(f'  {cost.summary()}')
     try:
         from .analysis import race as _race
     except ImportError:         # partial install / early interpreter exit
@@ -267,6 +282,7 @@ def dumps(reset=False):
             _op_stats.clear()
             _mem_stats['peak_live_bytes'] = 0
             _analysis_reports.clear()
+            _cost_reports.clear()
     return '\n'.join(lines)
 
 

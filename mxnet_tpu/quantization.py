@@ -155,6 +155,19 @@ def calib_table(collectors, mode='entropy'):
 class _QuantizedLayer(HybridBlock):
     """Shared int8 state: quantized weight + scales + input calib range."""
 
+    # mx.analysis justified suppression (docs/static-analysis.md): the
+    # unfused-dequant lint correctly flags the dequantize -> float
+    # (bias/BN/act) -> requantize round trip between int8 layers. It is
+    # inherent to this PTQ design — layer outputs stay float
+    # (enable_float_output, module docstring) because BN/activation run
+    # unquantized — and is accepted until the fused requantize epilogue
+    # lands (ROADMAP item 5, BENCH_r05 int8_speedup 0.63). The finding
+    # downgrades to info with this note; it is not dropped.
+    _analysis_suppressions = {
+        'unfused-dequant': 'PTQ keeps inter-layer activations in float '
+                           '(enable_float_output); fused requantize '
+                           'epilogue tracked as ROADMAP item 5'}
+
     def __init__(self, float_layer, in_min, in_max,
                  activation_dtype='bfloat16', **kwargs):
         super().__init__(**kwargs)

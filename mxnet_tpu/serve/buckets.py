@@ -14,7 +14,7 @@ asserts it (see docs/serving.md for sizing guidance).
 import os
 
 __all__ = ['parse_buckets', 'pick_bucket', 'pow2_bucket',
-           'default_buckets', 'chunk_spans']
+           'default_buckets', 'chunk_spans', 'bucket_waste_fracs']
 
 _DEFAULT = '1,2,4,8'
 
@@ -58,6 +58,22 @@ def chunk_spans(n, chunk):
     if chunk < 1:
         raise ValueError(f'chunk must be >= 1, got {chunk}')
     return [(s, min(chunk, n - s)) for s in range(0, n, chunk)]
+
+
+def bucket_waste_fracs(buckets):
+    """Worst-case padded-FLOP waste fraction per bucket: bucket ``b``
+    serves batches down to ``prev + 1`` rows, so up to
+    ``(b - prev - 1) / b`` of its compute is pad rows. The
+    padding-waste lint (mx.analysis, docs/static-analysis.md) flags
+    buckets whose worst case exceeds MXNET_ANALYSIS_PAD_WASTE_FRAC —
+    the default ``1,2,4,8`` ladder tops out at 3/8."""
+    buckets = tuple(sorted(buckets))
+    fracs = {}
+    prev = 0
+    for b in buckets:
+        fracs[b] = (b - prev - 1) / b
+        prev = b
+    return fracs
 
 
 def pow2_bucket(n, lo=1, hi=None):

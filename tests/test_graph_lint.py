@@ -192,6 +192,25 @@ def test_dead_code_silent_on_clean_net():
     assert 'dead-code' not in rule_names(r)
 
 
+def test_dead_code_counts_inside_scan_body():
+    # the walker sees into control-flow sub-jaxprs: an unused compute
+    # inside a scan body (a decode-loop regression shape) must count
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        def body(c, _):
+            wasted = jnp.tanh(c) * 3.0          # never used
+            del wasted
+            return c * 0.5, ()
+        y, _ = jax.lax.scan(body, x, None, length=4)
+        return y
+
+    r = mx.analysis.lint(f, jnp.ones((8, 8)), rules=['dead-code'])
+    msgs = [f_.message for f_ in r.by_rule('dead-code')]
+    assert any('equation' in m for m in msgs), msgs
+
+
 # ------------------------------------------------ rule 6: donation audit
 def test_donation_audit_proves_static_alloc_aliases():
     """The static_alloc donation claim (PARITY.md) is machine-checked:
@@ -294,6 +313,32 @@ def test_hybridize_check_warns_and_attaches():
     assert 'Graph analysis' in profiler.dumps(reset=True)
 
 
+def test_hybridize_check_attaches_cost_report(monkeypatch):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation='relu'), nn.Dense(2))
+    net.initialize()
+    net(mx.np.ones((2, 4)))
+    net.hybridize(check=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        net(mx.np.ones((2, 4)))
+    assert isinstance(net._cost_report, mx.analysis.CostReport)
+    assert net._cost_report.flops > 0
+    assert 'Cost (mx.analysis.costs' in profiler.dumps(reset=True)
+    # MXNET_ANALYSIS_COSTS=0 disables the pass
+    monkeypatch.setenv('MXNET_ANALYSIS_COSTS', '0')
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4))
+    net2.initialize()
+    net2(mx.np.ones((2, 4)))
+    net2.hybridize(check=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        net2(mx.np.ones((2, 4)))
+    assert not hasattr(net2, '_cost_report')
+    profiler.dumps(reset=True)
+
+
 def test_hybridize_check_clean_net_silent():
     net = nn.HybridSequential()
     net.add(nn.Dense(8, activation='relu'), nn.Dense(2))
@@ -328,6 +373,17 @@ def test_lint_rule_subset():
                          rules=['dead-code'])
     assert r.rules_run == ['dead-code']
     assert 'recompile-hazard' not in rule_names(r)
+
+
+def test_lint_unknown_rule_raises():
+    # a typo in rules=[...] must fail loudly, not silently skip the rule
+    with pytest.raises(ValueError, match='unknown analysis rule'):
+        mx.analysis.lint(lambda x: x + 1, mx.np.ones((4, 4)),
+                         rules=['no-such-rule'])
+    with pytest.raises(ValueError, match='dead-code'):
+        # the error names the available rules
+        mx.analysis.lint(lambda x: x + 1, mx.np.ones((4, 4)),
+                         rules=['dead-code', 'dead_code'])
 
 
 # ----------------------------------------------------- zoo integration
@@ -372,3 +428,18 @@ def test_cli_nonzero_exit_on_failure():
     finally:
         sys.path.pop(0)
     assert graph_lint.main(['not_a_model']) == 1
+
+
+def test_cli_json_output(capsys):
+    import json
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import graph_lint
+    finally:
+        sys.path.pop(0)
+    rc = graph_lint.main(['mobilenet0.25', '--json'])
+    doc = json.loads(capsys.readouterr().out)   # one JSON document only
+    assert rc == 0
+    assert doc['summary']['models'] == 1 and doc['summary']['errors'] == 0
+    model = doc['models']['mobilenet0.25']
+    assert model['stats']['params'] > 0 and model['rules_run']

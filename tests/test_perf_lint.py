@@ -1,0 +1,220 @@
+"""Perf lint rules (unfused-dequant, bandwidth-bound-chain,
+small-collective, padding-waste): positive/negative fixtures per rule,
+the block-level suppression contract on the quantized layers, the
+planted-finding dead-man's switch that keeps both detectors honest,
+and the tools/perf_lint.py CLI surface (docs/static-analysis.md)."""
+
+import json
+import os
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, quantization
+from mxnet_tpu.gluon import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PERF_RULES = ['unfused-dequant', 'bandwidth-bound-chain',
+              'small-collective', 'padding-waste']
+
+
+def lint_fn(fn, *args, rules=None, **config):
+    g = analysis.trace_function(fn, *args, name='t')
+    return analysis.lint_graph(g, rules=rules or PERF_RULES, **config)
+
+
+def by_rule(report, rule):
+    return [f for f in report.findings if f.rule == rule]
+
+
+def test_perf_rules_registered():
+    assert set(PERF_RULES) <= set(analysis.all_rules())
+
+
+# ------------------------------------------------------- unfused-dequant
+def test_unfused_dequant_fires_on_int8_weight():
+    def f(x, wq, scale):
+        return x @ (wq.astype(jnp.float32) * scale)
+
+    r = lint_fn(f, jnp.ones((4, 8)), jnp.zeros((8, 4), jnp.int8),
+                jnp.float32(0.1), rules=['unfused-dequant'])
+    hits = by_rule(r, 'unfused-dequant')
+    assert hits and hits[0].severity == 'warning'
+    assert 'dequant' in hits[0].message
+
+
+def test_unfused_dequant_silent_on_float_weights():
+    r = lint_fn(lambda x, w: x @ w, jnp.ones((4, 8)), jnp.ones((8, 4)),
+                rules=['unfused-dequant'])
+    assert not by_rule(r, 'unfused-dequant')
+
+
+def test_quantized_dense_suppression_contract():
+    # the int8 PTQ path keeps inter-layer activations in float, so the
+    # dequant round-trip is a KNOWN cost: _QuantizedLayer declares an
+    # _analysis_suppressions entry that downgrades the finding to info
+    # (never drops it); ignore_suppressions=True restores the warning
+    rng = onp.random.RandomState(0)
+    # two stacked layers: layer 2's int8 matmul consumes layer 1's
+    # dequantized float output — the round-trip the rule targets
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=16), nn.Dense(8, in_units=16))
+    net.initialize()
+    x = mx.np.array(rng.uniform(-1, 1, (4, 16)).astype('float32'))
+    qnet = quantization.quantize_net(net, calib_data=[x],
+                                     calib_mode='naive')
+    g = analysis.trace_block(qnet, x, name='qdense')
+    assert 'unfused-dequant' in g.suppressions
+
+    r = analysis.lint_graph(g, rules=['unfused-dequant'])
+    hits = by_rule(r, 'unfused-dequant')
+    assert hits and all(f.severity == 'info' for f in hits)
+    assert any('suppressed' in f.message for f in hits)
+
+    r2 = analysis.lint_graph(g, rules=['unfused-dequant'],
+                             ignore_suppressions=True)
+    assert any(f.severity == 'warning'
+               for f in by_rule(r2, 'unfused-dequant'))
+
+
+# ------------------------------------------------- bandwidth-bound-chain
+def _chain(x):
+    y = x + 1.0
+    y = y * 2.0
+    y = jnp.tanh(y)
+    y = y - 0.5
+    return y / 3.0
+
+
+def test_bandwidth_chain_fires_on_big_elementwise_run():
+    r = lint_fn(_chain, jnp.ones((512, 512)),
+                rules=['bandwidth-bound-chain'])
+    hits = by_rule(r, 'bandwidth-bound-chain')
+    assert hits and hits[0].severity == 'info'
+    assert hits[0].data['fusable_savings_bytes'] > 0
+
+
+def test_bandwidth_chain_silent_below_thresholds():
+    # tiny tensors (< bw_chain_min_bytes moved)
+    r = lint_fn(_chain, jnp.ones((4, 4)), rules=['bandwidth-bound-chain'])
+    assert not by_rule(r, 'bandwidth-bound-chain')
+    # short run (< bw_chain_min_eqns compute equations)
+    r = lint_fn(lambda x: (x + 1.0) * 2.0, jnp.ones((512, 512)),
+                rules=['bandwidth-bound-chain'])
+    assert not by_rule(r, 'bandwidth-bound-chain')
+
+
+def test_bandwidth_chain_exempts_fused_kernels():
+    # rms_norm is registered fused_kernel=True: its lowering is a long
+    # elementwise+reduce run, but a hand-fused kernel owns it
+    from mxnet_tpu.ops import nn as opsnn
+    r = lint_fn(lambda x, g: opsnn.rms_norm(x, g),
+                jnp.ones((1024, 1024)), jnp.ones((1024,)),
+                rules=['bandwidth-bound-chain'])
+    assert not by_rule(r, 'bandwidth-bound-chain')
+
+
+# ---------------------------------------------------- small-collective
+def test_small_collective_warns_under_fusion_bucket():
+    f = jax.pmap(lambda x: jax.lax.psum(x, 'i'), 'i')
+    r = lint_fn(f, jnp.ones((1, 2048)), rules=['small-collective'])
+    hits = by_rule(r, 'small-collective')
+    assert hits and hits[0].severity == 'warning'
+    assert 'fusion' in hits[0].message
+
+
+def test_small_collective_scalar_is_info_only():
+    # scalar/near-scalar psums (loss values) are unavoidable — info
+    f = jax.pmap(lambda x: jax.lax.psum(x, 'i'), 'i')
+    r = lint_fn(f, jnp.ones((1, 4)), rules=['small-collective'])
+    hits = by_rule(r, 'small-collective')
+    assert hits and hits[0].severity == 'info'
+
+
+# ------------------------------------------------------- padding-waste
+def test_padding_waste_fires_on_sparse_buckets():
+    # buckets (1, 16): a 2-token request pads to 16 -> 14/16 waste
+    r = lint_fn(lambda x: x + 1.0, jnp.ones((8, 8)),
+                rules=['padding-waste'], serve_buckets=(1, 16))
+    hits = by_rule(r, 'padding-waste')
+    assert hits and hits[0].severity == 'warning'
+
+
+def test_padding_waste_clean_on_default_buckets():
+    # default power-of-two ladder tops out at 3/8 < the 0.5 threshold
+    r = lint_fn(lambda x: x + 1.0, jnp.ones((8, 8)),
+                rules=['padding-waste'])
+    assert not by_rule(r, 'padding-waste')
+
+
+# ------------------------------------------------ dead-man's switch
+def test_planted_findings_dead_mans_switch():
+    """A fixture graph with a KNOWN unfused dequant and a KNOWN
+    sub-balance elementwise chain must produce BOTH findings. If either
+    detector rots (a jax upgrade changes the traced shape, a refactor
+    breaks the chase), this fails before the lint silently goes blind
+    on real models."""
+    def planted(x, wq, scale):
+        y = x + 1.0                       # | 5-eqn elementwise chain,
+        y = y * 2.0                       # | 1 MB+ moved, intensity
+        y = jnp.tanh(y)                   # | ~0.1 flop/B — far under
+        y = y - 0.5                       # | the 1524 flop/B balance
+        y = y / 3.0
+        w = wq.astype(jnp.float32) * scale    # dequant feeding a matmul
+        return y @ w
+
+    r = lint_fn(planted, jnp.ones((512, 512)),
+                jnp.zeros((512, 128), jnp.int8), jnp.float32(0.05),
+                rules=['unfused-dequant', 'bandwidth-bound-chain'],
+                ignore_suppressions=True)
+    fired = {f.rule for f in r.findings}
+    assert 'unfused-dequant' in fired, \
+        'dead-man\'s switch: the planted int8 dequant was NOT detected'
+    assert 'bandwidth-bound-chain' in fired, \
+        'dead-man\'s switch: the planted elementwise chain was NOT detected'
+
+
+# ------------------------------------------------------------- CLI
+def _perf_lint_main():
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import perf_lint
+    finally:
+        sys.path.pop(0)
+    return perf_lint
+
+
+def test_cli_single_model_json(capsys):
+    perf_lint = _perf_lint_main()
+    rc = perf_lint.main(['bert', '--json'])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0, doc
+    bert = doc['models']['bert']
+    assert bert['errors'] == 0
+    assert bert['cost']['flops'] > 0
+    assert bert['fixture']['drift'] == {}
+    assert doc['failures'] == []
+
+
+def test_cli_fixture_drift_fails(monkeypatch, tmp_path, capsys):
+    perf_lint = _perf_lint_main()
+    bad = {'flops': 1, 'bytes_moved': 1, 'hbm_bytes_min': 1,
+           'peak_hbm_bytes': 1, 'eqns': 1}
+    (tmp_path / 'bert.json').write_text(json.dumps(bad))
+    monkeypatch.setattr(perf_lint, 'FIXTURE_DIR', str(tmp_path))
+    rc = perf_lint.main(['bert'])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert 'drift' in out
+
+
+def test_cli_unknown_model_fails():
+    perf_lint = _perf_lint_main()
+    with pytest.raises(SystemExit):
+        perf_lint.main(['not_a_model'])

@@ -176,3 +176,38 @@ def test_flakiness_checker_race_mode(monkeypatch):
     fc.run_trials('tests/test_tools.py', None, 1, seed=0, verbosity=0)
     assert 'MXNET_RACE_CHECK' not in seen[0]
     assert 'MXNET_RACE_CHECK' not in os.environ
+
+
+# ------------------------------------------------ perf_lint (roofline CI)
+def test_perf_lint_cli_gates_representative_models():
+    """The roofline CI gate: tools/perf_lint.py over resnet50 / bert /
+    llama-decode must exit 0 — zero error-severity findings and every
+    analytical cost total inside the checked-in fixture tolerance
+    (tests/fixtures/costs). A nonzero exit here is a graph-shape perf
+    regression even if the numerics still pass."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'perf_lint.py')],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'}, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'clean vs fixtures' in proc.stdout, proc.stdout
+
+
+def test_bench_predicted_train_costs_match_analytical():
+    """bench.py's BENCH-row prediction hook: the static cost model over
+    the exact resnet50 train step bench_resnet_train measures must land
+    within 10% of the analytical MFU count (3 x RESNET50_FWD_FLOPS per
+    image — the denominator of every reported MFU)."""
+    import types
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+        import mxnet_tpu as mx
+    finally:
+        sys.path.pop(0)
+    args = types.SimpleNamespace(batch=2, dtype='f32')
+    d = bench._predicted_train_costs(args, mx)
+    want = 3 * bench.RESNET50_FWD_FLOPS * args.batch
+    assert abs(d['predicted_flops'] - want) / want < 0.10, d
+    assert d['predicted_peak_hbm_bytes'] > 0
+    assert 0 < d['predicted_mfu_bound'] <= 1.0

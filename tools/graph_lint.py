@@ -81,6 +81,9 @@ def main(argv=None):
                    help='classifier width for vision models (default 10)')
     p.add_argument('--verbose', '-v', action='store_true',
                    help='print info-severity findings too')
+    p.add_argument('--json', action='store_true',
+                   help='emit one machine-readable JSON document '
+                        '(per-model findings + stats) instead of text')
     args = p.parse_args(argv)
 
     import mxnet_tpu as mx
@@ -93,11 +96,14 @@ def main(argv=None):
 
     n_errors = n_warnings = 0
     failed = []
+    doc = {'models': {}, 'argv': list(argv) if argv else []}
     for name in models:
         try:
             report = lint_one(name, args, mx)
         except Exception as e:   # noqa: BLE001 - report and keep going
-            print(f'{name}: LINT FAILED — {type(e).__name__}: {e}')
+            if not args.json:
+                print(f'{name}: LINT FAILED — {type(e).__name__}: {e}')
+            doc['models'][name] = {'failed': f'{type(e).__name__}: {e}'}
             failed.append(name)
             continue
         errs = report.errors
@@ -105,17 +111,42 @@ def main(argv=None):
                  and f not in errs]
         n_errors += len(errs)
         n_warnings += len(warns)
-        status = 'clean' if not report.findings else report.summary()
+        doc['models'][name] = {
+            'stats': dict(report.stats),
+            'rules_run': list(report.rules_run),
+            'findings': [
+                {'rule': f.rule, 'severity': f.severity,
+                 'message': f.message, 'location': f.location,
+                 'data': {k: v for k, v in f.data.items()
+                          if isinstance(v, (str, int, float, bool,
+                                            list, dict, type(None)))}}
+                for f in report.findings],
+        }
+        if args.json:
+            continue
+        # info findings are advisory (docs/static-analysis.md severity
+        # semantics) — a model is clean when nothing actionable fired
+        infos = [f for f in report.findings if f not in errs + warns]
+        if not (errs or warns):
+            status = 'clean' + (f' ({len(infos)} info)' if infos else '')
+        else:
+            status = report.summary()
         print(f'{name}: {status}')
         shown = report.findings if args.verbose else errs + warns
         for f in shown:
             loc = f' [{f.location}]' if f.location else ''
             print(f'  {f.severity.upper()} {f.rule}{loc}: {f.message}')
 
-    print(f'\n{len(models)} model(s): {n_errors} error(s), '
-          f'{n_warnings} warning(s), {len(failed)} failed to lint')
-    if failed:
-        print('failed:', ', '.join(failed))
+    doc['summary'] = {'models': len(models), 'errors': n_errors,
+                      'warnings': n_warnings, 'failed': failed}
+    if args.json:
+        import json
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(f'\n{len(models)} model(s): {n_errors} error(s), '
+              f'{n_warnings} warning(s), {len(failed)} failed to lint')
+        if failed:
+            print('failed:', ', '.join(failed))
     return 1 if (n_errors or failed) else 0
 
 

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Roofline cost audit over representative graphs (mx.analysis.costs).
+
+For each model this traces the graph exactly as ``hybridize`` compiles
+it, runs the analytical cost pass (FLOPs, bytes, arithmetic intensity,
+predicted peak HBM) plus the perf lint rules (unfused-dequant,
+bandwidth-bound-chain, small-collective, padding-waste), and compares
+the cost totals against checked-in fixtures
+(``tests/fixtures/costs/<model>.json``) — so a silent graph-shape
+regression (an extra dequant round trip, a fusion break, a doubled
+buffer) fails CI even though the graph still computes the right
+numbers.
+
+Exit is nonzero when any model has an error-severity finding, a cost
+total drifts outside the fixture tolerance, or a fixture is missing.
+
+Usage:
+    python tools/perf_lint.py                       # resnet50 bert llama-decode
+    python tools/perf_lint.py resnet50 --json
+    python tools/perf_lint.py --update-fixtures     # re-baseline after
+                                                    # an intended change
+
+CI pins JAX_PLATFORMS=cpu; the jaxpr (and therefore every predicted
+number) is backend-independent.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_MODELS = ['resnet50', 'bert', 'llama-decode']
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'tests', 'fixtures', 'costs')
+
+# relative drift tolerated before a fixture comparison fails. FLOPs are
+# closed-form over shapes (tight); byte totals shift slightly with jax
+# jaxpr formation details across versions (looser).
+TOLERANCES = {'flops': 0.02, 'bytes_moved': 0.05, 'hbm_bytes_min': 0.05,
+              'peak_hbm_bytes': 0.05, 'eqns': 0.10}
+
+BERT_SMALL = dict(num_layers=2, vocab_size=100, units=32, hidden_size=64,
+                  num_heads=2, dropout=0.0, use_decoder=False,
+                  use_classifier=False)
+
+
+def build_graph(name, mx):
+    """-> (GraphView, notes) for one audited model."""
+    import numpy as np
+    from mxnet_tpu import analysis
+
+    if name == 'resnet50':
+        from mxnet_tpu.gluon.model_zoo.vision import get_model
+        net = get_model('resnet50_v1', classes=1000)
+        net.initialize()
+        return analysis.trace_block(
+            net, (1, 3, 224, 224), name=name), []
+    if name == 'bert':
+        from mxnet_tpu.gluon.model_zoo import bert
+        net = bert.get_bert_model(**BERT_SMALL)
+        net.initialize()
+        toks = mx.np.array(np.ones((2, 6), 'f'))
+        segs = mx.np.zeros((2, 6))
+        return analysis.trace_block(net, toks, segs, name=name), []
+    if name == 'llama-decode':
+        return build_llama_decode(mx), []
+    raise SystemExit(f'unknown model {name!r}: want one of '
+                     f'{DEFAULT_MODELS}')
+
+
+def build_llama_decode(mx, n_tokens=8, batch=1, prompt_len=4):
+    """The llama_tiny decode loop as ONE traced scan program — the same
+    shape ``generate()``/``DecodeServer`` compile (llama.py decode_n):
+    costs inside the scan body count once per generated token."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import analysis
+    from mxnet_tpu.gluon.model_zoo.llama import llama_tiny
+
+    import numpy as np
+    net = llama_tiny()
+    net.initialize()
+    # one eager forward materializes deferred-shape params
+    net(mx.nd.array(np.ones((batch, prompt_len), np.int32)))
+    run, praws = net._param_run()
+    caches = net.init_caches(batch, net.cfg.max_length)
+
+    def decode_n(praws_, tok, caches_, offset, key):
+        def body(carry, _):
+            nxt, ch, off, k = carry
+            k, sub = jax.random.split(k)
+            logits, ch = run(praws_, nxt[:, None], ch, off)
+            nxt = jnp.argmax(logits[:, -1, :], -1).astype(tok.dtype)
+            return (nxt, ch, off + 1, k), nxt
+
+        (_, caches_out, _, _), toks = jax.lax.scan(
+            body, (tok, caches_, offset, key), None, length=n_tokens)
+        return toks, caches_out
+
+    tok = jnp.zeros((batch,), jnp.int32)
+    offset = jnp.asarray(prompt_len, jnp.int32)
+    key = jax.random.PRNGKey(0)
+    return analysis.trace_function(decode_n, praws, tok, caches, offset,
+                                   key, name='llama-decode')
+
+
+def audit_one(name, args, mx):
+    """-> result dict for one model (cost totals, findings, fixture
+    comparison)."""
+    from mxnet_tpu import analysis
+
+    graph, _notes = build_graph(name, mx)
+    cost = analysis.cost_of_graph(graph)
+    report = analysis.lint_graph(
+        graph, rules=['unfused-dequant', 'bandwidth-bound-chain',
+                      'small-collective', 'padding-waste'])
+
+    result = {
+        'cost': cost.as_dict(),
+        'findings': [
+            {'rule': f.rule, 'severity': f.severity, 'message': f.message,
+             'location': f.location}
+            for f in report.findings],
+        'errors': len(report.errors),
+        'fixture': None,
+    }
+
+    fixture_path = os.path.join(FIXTURE_DIR, f'{name}.json')
+    expected_keys = sorted(TOLERANCES)
+    if args.update_fixtures:
+        os.makedirs(FIXTURE_DIR, exist_ok=True)
+        fixture = {k: result['cost'][k] for k in expected_keys}
+        fixture['_comment'] = (
+            'Expected analytical cost totals (tools/perf_lint.py). '
+            'Regenerate with --update-fixtures after an INTENDED graph '
+            'change; an unexplained diff here is a perf regression.')
+        with open(fixture_path, 'w') as f:
+            json.dump(fixture, f, indent=2, sort_keys=True)
+            f.write('\n')
+        result['fixture'] = {'updated': True}
+        return result
+
+    if not os.path.exists(fixture_path):
+        result['fixture'] = {'missing': fixture_path}
+        return result
+    with open(fixture_path) as f:
+        fixture = json.load(f)
+    drift = {}
+    for key in expected_keys:
+        want = fixture.get(key)
+        got = result['cost'][key]
+        if want is None:
+            continue
+        tol = TOLERANCES[key]
+        if want == 0:
+            ok = got == 0
+        else:
+            ok = abs(got - want) / abs(want) <= tol
+        if not ok:
+            drift[key] = {'expected': want, 'got': got,
+                          'rel': round((got - want) / max(abs(want), 1), 4),
+                          'tol': tol}
+    result['fixture'] = {'path': fixture_path, 'drift': drift}
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('models', nargs='*', default=None,
+                   help=f'models to audit; default: {" ".join(DEFAULT_MODELS)}')
+    p.add_argument('--json', action='store_true',
+                   help='emit one machine-readable JSON document')
+    p.add_argument('--update-fixtures', action='store_true',
+                   help='rewrite tests/fixtures/costs/<model>.json from '
+                        'the current graphs (for INTENDED changes)')
+    p.add_argument('--verbose', '-v', action='store_true',
+                   help='print the full per-primitive cost table')
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+
+    models = args.models or DEFAULT_MODELS
+    doc = {'models': {}}
+    fail = []
+    for name in models:
+        try:
+            result = audit_one(name, args, mx)
+        except Exception as e:   # noqa: BLE001 - report and keep going
+            doc['models'][name] = {'failed': f'{type(e).__name__}: {e}'}
+            fail.append(f'{name}: audit failed — {type(e).__name__}: {e}')
+            continue
+        doc['models'][name] = result
+        c = result['cost']
+        if not args.json:
+            print(f"{name}: {c['flops'] / 1e9:.2f} GFLOP, "
+                  f"intensity {c['intensity_flop_per_byte']} flop/B "
+                  f"({c['classification']}, mfu bound "
+                  f"{c['predicted_mfu_bound']}), peak HBM "
+                  f"{c['peak_hbm_bytes'] / 1e6:.1f} MB, "
+                  f"{len(result['findings'])} finding(s) "
+                  f"[{result['errors']} error(s)]")
+            if args.verbose:
+                for prim, s in sorted(c['by_primitive'].items(),
+                                      key=lambda kv: -kv[1]['flops'])[:10]:
+                    print(f"    {prim:<26}{s['count']:>7}"
+                          f"{s['flops'] / 1e9:>12.3f} GFLOP")
+            for f in result['findings']:
+                if f['severity'] != 'info' or args.verbose:
+                    loc = f" [{f['location']}]" if f['location'] else ''
+                    print(f"  {f['severity'].upper()} {f['rule']}{loc}: "
+                          f"{f['message']}")
+        if result['errors']:
+            fail.append(f"{name}: {result['errors']} error-severity "
+                        'finding(s)')
+        fx = result['fixture']
+        if fx and fx.get('missing'):
+            fail.append(f"{name}: missing fixture {fx['missing']} "
+                        '(run --update-fixtures)')
+        elif fx and fx.get('drift'):
+            fail.append(f"{name}: cost drift vs fixture: {fx['drift']}")
+
+    doc['failures'] = fail
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        if fail:
+            print('\nFAIL:')
+            for line in fail:
+                print(f'  {line}')
+        else:
+            print(f'\n{len(models)} model(s) clean vs fixtures')
+    return 1 if fail else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
