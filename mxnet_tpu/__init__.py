@@ -88,6 +88,7 @@ from . import recordio
 from . import io
 from . import image
 from . import parallel
+from . import sharding
 from . import amp
 from . import analysis
 from . import serve

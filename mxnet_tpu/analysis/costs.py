@@ -69,7 +69,7 @@ real_to_complex sharding_constraint optimization_barrier
 """.split())
 
 COLLECTIVE_PRIMS = frozenset("""
-psum psum_scatter all_gather all_to_all ppermute pbroadcast
+psum psum2 psum_scatter all_gather all_to_all ppermute pbroadcast
 reduce_scatter allreduce pmax pmin
 """.split())
 
@@ -181,6 +181,10 @@ class CostReport:
         self.unmodeled = {}         # primitive -> eqn count
         self.assumptions = []
         self.machine_balance = machine_balance(device)
+        # set by cost_of_graph when the graph was traced under an
+        # mx.sharding mesh: per-device flops/bytes/peak (see
+        # _per_device_costs for the scaling model and its assumption)
+        self.per_device = None
 
     # ------------------------------------------------------------ derived
     @property
@@ -254,6 +258,7 @@ class CostReport:
             'collectives': list(self.collectives),
             'unmodeled_primitives': dict(self.unmodeled),
             'assumptions': list(self.assumptions),
+            'per_device': dict(self.per_device) if self.per_device else None,
         }
 
     def summary(self):
@@ -281,6 +286,13 @@ class CostReport:
         if self.unmodeled:
             lines.append(f'  unmodeled primitives (defaulted): '
                          f'{sorted(self.unmodeled)}')
+        if self.per_device:
+            pd = self.per_device
+            lines.append(
+                f'  per-device ({pd["n_devices"]}x): '
+                f'{pd["flops"] / 1e9:.2f} GFLOP, '
+                f'{pd["hbm_bytes_min"] / 1e6:.1f} MB boundary, '
+                f'peak HBM {pd["peak_hbm_bytes"] / 1e6:.1f} MB')
         for a in self.assumptions:
             lines.append(f'  assumption: {a}')
         return '\n'.join(lines)
@@ -465,6 +477,71 @@ def peak_hbm_bytes(graph, config=None):
     return peak_hbm_bytes_jaxpr(graph.jaxpr, donated, const_bytes, config)
 
 
+def _per_device_costs(graph, report):
+    """Per-device cost dict for a graph traced under an mx.sharding
+    mesh (GraphView.sharding metadata from the walker).
+
+    Model: FLOPs divide evenly over the mesh (SPMD — every device runs
+    the same program over its shard). Boundary bytes divide per-argument
+    by that argument's shard factor (a replicated bias counts full on
+    every device, a 'dp'-sharded batch counts 1/dp); closure constants
+    are always replicated. Interior traffic and peak HBM are scaled by
+    the resulting boundary ratio — recorded as an assumption, since
+    GSPMD may materialize different interiors (halo exchanges,
+    re-sharding) than the single-device jaxpr suggests.
+    """
+    meta = graph.sharding
+    n = int(meta.get('n_devices', 1) or 1)
+    factors = meta.get('factors', {})
+    out_axis = meta.get('data_axis')
+    extent = meta.get('axes', {}).get(out_axis, 1) if out_axis else 1
+
+    boundary = sum(int(getattr(c, 'nbytes', 0) or 0)
+                   for c in graph.consts)
+    for a in graph.args:
+        f = max(1, int(factors.get(a.label, 1)))
+        boundary += _var_bytes(graph.jaxpr.invars[a.index]) / f
+    for v, kind in zip(graph.jaxpr.outvars, graph.out_kinds):
+        if not isinstance(v, _core.Var):
+            continue
+        shape = tuple(v.aval.shape)
+        # outputs leave at the batch spec; aux write-backs at the param
+        # spec — approximate the latter by the mean param factor
+        if kind == 'aux':
+            pf = [f for lbl, f in factors.items()
+                  if lbl.startswith(('param:', 'aux:'))]
+            f = max(1, int(sum(pf) / len(pf))) if pf else 1
+        else:
+            f = extent if (shape and extent > 1
+                           and shape[0] % extent == 0) else 1
+        boundary += _var_bytes(v) / f
+
+    ratio = (boundary / report.hbm_bytes_min
+             if report.hbm_bytes_min else 1.0 / n)
+    flops = report.flops / n
+    hbm_min = boundary
+    peak = report.peak_hbm_bytes * ratio
+    t_flops = flops / float(report.device['peak_flops'])
+    t_hbm = hbm_min / float(report.device['hbm_bytes_s'])
+    report.assumptions.append(
+        f'per-device: FLOPs/{n}; boundary bytes divided per-arg by '
+        f'shard factor; interior traffic and peak HBM scaled by the '
+        f'boundary ratio {ratio:.3f} (GSPMD may materialize different '
+        'interiors: halo exchange, re-sharding)')
+    return {
+        'n_devices': n,
+        'mode': meta.get('mode'),
+        'axes': dict(meta.get('axes', {})),
+        'flops': int(flops),
+        'hbm_bytes_min': int(hbm_min),
+        'bytes_moved': int(report.bytes_moved * ratio),
+        'peak_hbm_bytes': int(peak),
+        'intensity_flop_per_byte': round(flops / hbm_min, 3)
+        if hbm_min else 0.0,
+        'predicted_step_seconds': max(t_flops, t_hbm),
+    }
+
+
 # ------------------------------------------------------------- entry points
 def cost_of_graph(graph, device_spec=None, **config):
     """Analytical CostReport for an already-traced GraphView. Cached on
@@ -482,6 +559,8 @@ def cost_of_graph(graph, device_spec=None, **config):
         + sum(_var_bytes(v) for v in graph.jaxpr.outvars
               if isinstance(v, _core.Var)))
     report.peak_hbm_bytes = peak_hbm_bytes(graph, config)
+    if getattr(graph, 'sharding', None):
+        report.per_device = _per_device_costs(graph, report)
     if not config and device_spec is None:
         graph._cost_report = report
     return report

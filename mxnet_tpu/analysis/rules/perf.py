@@ -20,7 +20,12 @@ bandwidth-bound-chain       a data-dependent run of elementwise/reduce
                             target list (ROADMAP item 5)
 small-collective            a psum/reduce-scatter whose payload is under the
                             kvstore fusion-buffer bucket threshold — an
-                            unbucketed gradient push (ROADMAP item 2)
+                            unbucketed gradient push (ROADMAP item 2).
+                            Collectives over a *named mesh axis* (the
+                            mx.sharding TP/FSDP psums) are in-step GSPMD
+                            collectives, not kvstore pushes: always info
+                            with ``mesh_axes`` data, never the bucketing
+                            warning
 padding-waste               worst-case FLOPs the serve pad-to-bucket policy
                             wastes above ``MXNET_ANALYSIS_PAD_WASTE_FRAC``,
                             per MXNET_SERVE_BUCKETS bucket
@@ -266,6 +271,18 @@ def bandwidth_bound_chain(graph, report, config):
 
 
 # -------------------------------------------------------- small-collective
+def _mesh_axes(eqn):
+    """Named mesh axes a collective reduces over, e.g. ('dp',) for a
+    psum bound to an ``mx.sharding`` mesh axis — empty for positional
+    axes (vmap ints) and for axis-free collectives."""
+    axes = eqn.params.get('axes', None)
+    if axes is None:
+        axes = eqn.params.get('axis_name', ())
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
 @register_rule('small-collective')
 def small_collective(graph, report, config):
     from ...kvstore.fusion import fusion_buffer_bytes
@@ -273,12 +290,39 @@ def small_collective(graph, report, config):
                                fusion_buffer_bytes()))
     scalar_floor = 4096     # scalar/loss psums are unavoidable: info
     from ..walker import iter_eqns
+    # axis names that belong to a real device mesh: the sharding
+    # context's axes plus any shard_map mesh in the graph. A pmap
+    # axis_name is NOT one — its psum is the kvstore-style replica
+    # all-reduce the bucketing remedy exists for.
+    known = set((getattr(graph, 'sharding', None) or {}).get('axes', {}))
+    for eqn, _ in iter_eqns(graph.jaxpr):
+        names = getattr(eqn.params.get('mesh', None), 'axis_names', None)
+        if names:
+            known.update(a for a in names if isinstance(a, str))
     for eqn, depth in iter_eqns(graph.jaxpr):
         if eqn.primitive.name not in COLLECTIVE_PRIMS:
             continue
         payload = sum(int(v.aval.size * v.aval.dtype.itemsize)
                       for v in eqn.invars if isinstance(v, _core.Var))
         if payload >= threshold:
+            continue
+        mesh_axes = tuple(a for a in _mesh_axes(eqn) if a in known)
+        if mesh_axes:
+            # a psum over a named mesh axis is GSPMD-scheduled inside
+            # the step (mx.sharding TP/FSDP cross-shard reduction), not
+            # an unbucketed kvstore gradient push — XLA fuses and
+            # overlaps these; the fusion-buffer remedy does not apply
+            _emit(graph, report, config, 'small-collective', 'info',
+                  f'{eqn.primitive.name} over mesh axis '
+                  f'{"/".join(mesh_axes)} ({payload / 1e6:.3f} MB) — '
+                  'an in-step GSPMD collective on the sharding mesh, '
+                  'not an unbucketed gradient push; no fusion-buffer '
+                  'action needed',
+                  location=source_location(eqn),
+                  data={'primitive': eqn.primitive.name,
+                        'payload_bytes': int(payload),
+                        'mesh_axes': list(mesh_axes),
+                        'in_step_collective': True, 'depth': depth})
             continue
         sev = 'warning' if payload >= scalar_floor else 'info'
         _emit(graph, report, config, 'small-collective', sev,

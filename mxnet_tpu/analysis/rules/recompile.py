@@ -33,6 +33,18 @@ serving audit can assert its dynamic indices actually entered the
 graph as traced arrays (a block table demoted to a Python list would
 bake as a constant and show up missing here — and recompile per
 value).
+
+Second non-hazard: **mesh-change retraces**. ``_CachedGraph`` keys
+compiled entries by the ``mx.sharding`` context fingerprint (mesh axes,
+shape, device ids, mode) in addition to shapes/dtypes, so entering a
+*different* mesh recompiles the graph. That is by design, not cache
+fragmentation: a new device assignment is a new XLA partitioning — the
+sharded executable for ``dp=4,tp=2`` cannot run on ``dp=8``.
+Re-entering the *same* mesh hits the warm cache (zero recompiles after
+warmup — tested in tests/test_sharding.py). When the graph was traced
+under a mesh the rule emits an info naming the fingerprint axes and
+sets ``report.stats['mesh_keyed']`` so audits can assert the cache key
+includes the mesh without treating the retrace as a finding.
 """
 
 from . import register_rule
@@ -77,3 +89,16 @@ def run(graph, report, config):
                 'new program',
                 shape=shape)
     report.stats['traced_index_inputs'] = traced_index_inputs
+    meta = getattr(graph, 'sharding', None)
+    report.stats['mesh_keyed'] = meta is not None
+    if meta is not None:
+        axes = 'x'.join(f'{k}={v}' for k, v in meta['axes'].items())
+        report.add(
+            'recompile-hazard', 'info',
+            f'graph compiled under sharding mesh [{axes}]: the mesh '
+            'fingerprint is part of the compile-cache key, so entering '
+            'a different mesh retraces by design (a new device '
+            'assignment is a new XLA partitioning) — a documented '
+            'non-hazard, while same-mesh re-entry stays warm',
+            mesh_axes=dict(meta['axes']), mode=meta.get('mode'),
+            non_hazard='mesh-change-retrace')

@@ -50,7 +50,7 @@ class GraphView:
     def __init__(self, closed_jaxpr, args, out_kinds, name,
                  source='function', block=None, static_alloc=False,
                  donate_groups=(), lower_fn=None, notes=None,
-                 suppressions=None):
+                 suppressions=None, sharding=None):
         self.closed = closed_jaxpr
         self.jaxpr = closed_jaxpr.jaxpr
         self.consts = list(closed_jaxpr.consts)
@@ -72,6 +72,12 @@ class GraphView:
         # findings to info instead of dropping them — the report still
         # shows the pattern exists and why it is accepted.
         self.suppressions = dict(suppressions or {})
+        # non-None when traced under an active mx.sharding context:
+        # {'axes', 'mode', 'n_devices', 'data_axis', 'specs' (per arg
+        # label), 'factors' (per arg label, = #shards of that buffer)}.
+        # The cost model divides per-device traffic by these factors and
+        # the recompile rule reads it to state the mesh-key non-hazard.
+        self.sharding = sharding
 
     # ---------------------------------------------------------------- helpers
     @property
@@ -279,14 +285,79 @@ def trace_block(block, *example_args, train=False, name=None):
 
     treedef = jax.tree.structure(
         tuple(args), is_leaf=lambda x: isinstance(x, NDArray))
-    pure_fn = temp._make_pure(('analysis',), train, treedef)
+
+    # sharding-aware trace: under an active mx.sharding context lint the
+    # program the context would actually compile — the same injected
+    # with_sharding_constraint boundaries (_make_pure ctx arg) and
+    # params/aux avals carrying their rule-resolved NamedShardings, so
+    # lower_fn produces a genuinely sharded lowering for the donation
+    # audit and the cost model can report per-device numbers.
+    from .. import sharding as _shd
+    ctx = _shd.current()
+    sharding_meta = None
+    aux_specs = None
+    if ctx is not None:
+        from jax.sharding import NamedSharding
+        rules = ctx.rules_for_block(block)
+        specs, factors = {}, {}
+
+        def _note(label, spec, shape):
+            specs[label] = tuple(spec)
+            factors[label] = _shd.shard_factor(spec, shape, ctx.mesh)
+
+        in_specs = []
+        for i, a in enumerate(args):
+            spec = ctx.batch_spec(a.shape)
+            in_specs.append(spec)
+            _note(f'input[{i}]', spec, a.shape)
+        # block-relative names resolved fresh — a child-level
+        # collect_params() (infer_shape above traces child cached
+        # graphs) re-stamps _structure_name child-relative
+        fresh = {id(p): k for k, p in block.collect_params().items()}
+        main_specs, aux_param_specs = [], []
+        for p in main:
+            name = fresh.get(id(p)) or p.name
+            spec = ctx.spec_for(name, p.data().shape, rules)
+            main_specs.append(spec)
+            _note(f'param:{name}', spec, p.data().shape)
+        for p in aux:
+            name = fresh.get(id(p)) or p.name
+            spec = ctx.spec_for(name, p.data().shape, rules)
+            aux_param_specs.append(spec)
+            _note(f'aux:{name}', spec, p.data().shape)
+        aux_specs = tuple(aux_param_specs)
+        sharding_meta = {
+            'axes': dict(ctx.axis_sizes),
+            'mode': ctx.mode,
+            'n_devices': ctx.n_devices,
+            'data_axis': ctx.data_axis,
+            'specs': specs,
+            'factors': factors,
+        }
+        notes.append('traced under mx.sharding mesh '
+                     + 'x'.join(f'{k}={v}'
+                                for k, v in ctx.axis_sizes.items()))
+
+        def _sds(shape, dtype, spec):
+            return jax.ShapeDtypeStruct(
+                shape, dtype, sharding=NamedSharding(ctx.mesh, spec))
+    else:
+        def _sds(shape, dtype, spec):
+            return jax.ShapeDtypeStruct(shape, dtype)
+        in_specs = [None] * len(args)
+        main_specs = [None] * len(main)
+        aux_param_specs = [None] * len(aux)
+
+    pure_fn = temp._make_pure(('analysis',), train, treedef, ctx=ctx,
+                              aux_specs=aux_specs)
 
     key = _example_key()
-    in_sds = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
-    main_sds = tuple(jax.ShapeDtypeStruct(p.data().shape,
-                                          p.data().dtype) for p in main)
-    aux_sds = tuple(jax.ShapeDtypeStruct(p.data().shape, p.data().dtype)
-                    for p in aux)
+    in_sds = tuple(_sds(a.shape, a.dtype, s)
+                   for a, s in zip(args, in_specs))
+    main_sds = tuple(_sds(p.data().shape, p.data().dtype, s)
+                     for p, s in zip(main, main_specs))
+    aux_sds = tuple(_sds(p.data().shape, p.data().dtype, s)
+                    for p, s in zip(aux, aux_param_specs))
 
     closed, out_shapes = jax.make_jaxpr(pure_fn, return_shape=True)(
         key, in_sds, main_sds, aux_sds)
@@ -323,7 +394,8 @@ def trace_block(block, *example_args, train=False, name=None):
                      block=block, static_alloc=static_alloc,
                      donate_groups=donate_groups, lower_fn=lower_fn,
                      notes=notes,
-                     suppressions=collect_suppressions(block))
+                     suppressions=collect_suppressions(block),
+                     sharding=sharding_meta)
 
 
 def _label_args(closed, key, in_sds, main_sds, aux_sds, main_names,

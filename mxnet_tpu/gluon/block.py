@@ -346,11 +346,64 @@ class _CachedGraph:
             self._param_order = (main, aux)
         return self._param_order
 
-    def _build(self, shapes_key, train_mode, n_in, treedef, donate=()):
+    def _sharding_plan(self, ctx, in_nds):
+        """Resolved shardings for one compile under an active
+        ``mx.sharding`` context: ``(in_shardings kwarg, param specs,
+        input specs)``. Params match the rule registry by structural
+        name; inputs take the batch spec (leading dim on the data
+        axis). Parameter buffers are placed on the mesh here, once —
+        later calls dispatch on already-sharded arrays."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        main, aux = self._params()
+        rules = ctx.rules_for_block(self.block)
+        # names relative to THIS block, resolved fresh: a child-level
+        # collect_params() call (infer_shape tracing a child's cached
+        # graph, a user poking net.output) re-stamps _structure_name
+        # with child-relative names, so the cached stamp cannot be
+        # trusted for rule matching
+        fresh = {id(p): k for k, p in self.block.collect_params().items()}
+        specs = {}
+        for p in list(main) + list(aux):
+            name = fresh.get(id(p)) or p.name
+            spec = ctx.spec_for(name, p.shape, rules)
+            specs[id(p)] = spec
+            sh = NamedSharding(ctx.mesh, spec)
+            for c, nd in list(p._data.items()):
+                if getattr(nd._data, 'sharding', None) != sh:
+                    nd._rebind(jax.device_put(nd._data, sh))
+            p._sharding_spec = spec
+            p._sharding_mesh = ctx.mesh
+        in_specs = tuple(ctx.batch_spec(x.shape) for x in in_nds)
+        # rng key and graph inputs arrive as fresh single-device arrays
+        # each call: leave their entry None (jax.jit: inherit from the
+        # argument) and let the with_sharding_constraint injected in
+        # pure_fn distribute them; a committed explicit sharding here
+        # would make pjit reject the host-resident batch outright.
+        in_shardings = (
+            None,
+            tuple(None for _ in in_specs),
+            tuple(NamedSharding(ctx.mesh, specs[id(p)]) for p in main),
+            tuple(NamedSharding(ctx.mesh, specs[id(p)]) for p in aux),
+        )
+        return in_shardings, specs, in_specs
+
+    def _build(self, shapes_key, train_mode, n_in, treedef, donate=(),
+               ctx=None, in_nds=()):
         import jax
 
-        pure_fn = self._make_pure(shapes_key, train_mode, treedef)
         jit_kwargs = {}
+        aux_specs = None
+        in_specs = None
+        if ctx is not None:
+            in_shardings, specs, in_specs = self._sharding_plan(ctx,
+                                                                in_nds)
+            jit_kwargs['in_shardings'] = in_shardings
+            _, aux = self._params()
+            aux_specs = tuple(specs[id(p)] for p in aux)
+        pure_fn = self._make_pure(shapes_key, train_mode, treedef,
+                                  ctx=ctx, aux_specs=aux_specs)
         if donate:
             # static_alloc buffer reuse (≙ the reference's persistent
             # workspace): donate the mutable aux state (argnum 3, BN
@@ -366,12 +419,54 @@ class _CachedGraph:
             # recompute activations in backward instead of storing them
             # (reference backward mirroring, MXNET_BACKWARD_DO_MIRROR)
             pure_fn = jax.checkpoint(pure_fn)
-        return jax.jit(pure_fn, **jit_kwargs)
+        jitted = jax.jit(pure_fn, **jit_kwargs)
+        if ctx is None:
+            return jitted
+        # rng key / inputs arrive as committed single-device arrays each
+        # call while the params are committed to the mesh — jax rejects
+        # mixed device sets, so place them on the mesh at dispatch.
+        # device_put is a traceable primitive, so the autograd vjp
+        # re-trace of this wrapper stays valid.
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+        key_sh = NamedSharding(ctx.mesh, _P())
+        in_shs = tuple(NamedSharding(ctx.mesh, s) for s in in_specs)
 
-    def _make_pure(self, shapes_key, train_mode, treedef):
+        def sharded_fn(rng_key, in_raws, main_raws, aux_raws):
+            rng_key = jax.device_put(rng_key, key_sh)
+            in_raws = tuple(
+                jax.device_put(r, sh)
+                if getattr(r, 'ndim', None) is not None else r
+                for r, sh in zip(in_raws, in_shs))
+            return jitted(rng_key, in_raws, main_raws, aux_raws)
+
+        return sharded_fn
+
+    def _make_pure(self, shapes_key, train_mode, treedef, ctx=None,
+                   aux_specs=None):
         import jax
 
         main, aux = self._params()
+
+        if ctx is not None:
+            # rule-tagged activation boundaries: constrain graph inputs
+            # and outputs to the batch spec (leading dim on the data
+            # axis) and aux write-backs to their param spec, so GSPMD
+            # propagation anchors at the graph edge and the donated aux
+            # output provably aliases its (identically sharded) input.
+            # Interior boundaries: mx.sharding.constrain() — a no-op
+            # outside the context, so models stay mesh-agnostic.
+            from jax.sharding import NamedSharding
+
+            def _bound(raw, spec=None):
+                if getattr(raw, 'ndim', None) is None:
+                    return raw
+                spec = spec if spec is not None else ctx.batch_spec(
+                    raw.shape)
+                return jax.lax.with_sharding_constraint(
+                    raw, NamedSharding(ctx.mesh, spec))
+        else:
+            def _bound(raw, spec=None):
+                return raw
 
         def pure_fn(rng_key, in_raws, main_raws, aux_raws):
             # swap traced values into the parameters
@@ -387,16 +482,22 @@ class _CachedGraph:
                         list(zip(aux, aux_raws)):
                     saved.append((p, p._data))
                     p._data = {c: NDArray(raw, ctx=c) for c in p._data}
-                args = jax.tree.unflatten(treedef,
-                                          [NDArray(r) for r in in_raws])
+                args = jax.tree.unflatten(
+                    treedef, [NDArray(_bound(r)) for r in in_raws])
                 out = self.block.forward(*args)
                 out_leaves, out_tree = jax.tree.flatten(
                     out, is_leaf=lambda x: isinstance(x, NDArray))
-                out_raws = [o._data if isinstance(o, NDArray) else o
-                            for o in out_leaves]
-                aux_out = [st.aux_writes[id(p)][1]
-                           if id(p) in st.aux_writes else ar
-                           for p, ar in zip(aux, aux_raws)]
+                out_raws = [_bound(o._data) if isinstance(o, NDArray)
+                            else o for o in out_leaves]
+                if aux_specs is not None:
+                    aux_out = [_bound(st.aux_writes[id(p)][1], spec)
+                               if id(p) in st.aux_writes else ar
+                               for p, ar, spec in zip(aux, aux_raws,
+                                                      aux_specs)]
+                else:
+                    aux_out = [st.aux_writes[id(p)][1]
+                               if id(p) in st.aux_writes else ar
+                               for p, ar in zip(aux, aux_raws)]
                 self._out_trees[shapes_key] = out_tree
                 return tuple(out_raws), tuple(aux_out)
             finally:
@@ -441,11 +542,19 @@ class _CachedGraph:
         if self.donate_inputs and not recording:
             donate += (1,)
         donate = tuple(sorted(donate))
+        # ambient mx.sharding context: its fingerprint joins the cache
+        # key (a different mesh is a different XLA program — retracing
+        # on mesh change is by design, the recompile-hazard rule
+        # documents it as a non-hazard), and the entry compiles with
+        # in_shardings derived from the partition-rule registry.
+        from .. import sharding as _sharding
+        ctx = _sharding.current()
+        mesh_key = ctx.fingerprint() if ctx is not None else None
         # treedef is part of the key: same leaf shapes under different arg
         # nesting (or train/eval forwards with different output structures)
         # must not share a compiled entry or its output pytree
         key = (tuple((x.shape, str(x.dtype)) for x in in_nds), train_mode,
-               donate, treedef)
+               donate, treedef, mesh_key)
         # Thread-safety contract (reference thread-safe CachedOp,
         # src/imperative/cached_op_threadsafe.cc:1-316; docs/threading.md):
         # compiled steady-state INFERENCE runs lock-free from N threads —
@@ -486,7 +595,8 @@ class _CachedGraph:
             if key not in self._compiled:
                 self._compiled[key] = self._build(key, train_mode,
                                                   len(in_nds), treedef,
-                                                  donate=donate)
+                                                  donate=donate, ctx=ctx,
+                                                  in_nds=in_nds)
                 self.compiles += 1
             jfn = self._compiled[key]
             main_nds = [p.data() for p in main]
@@ -552,7 +662,8 @@ class _CachedGraph:
         # payload swap and must hold this graph's lock (ADVICE r4)
         op.vjp_lock = self._lock
         try:
-            res = apply_op(op, in_nds + main_nds, fn, name='_CachedOp')
+            res = apply_op(op, in_nds + main_nds, fn, name='_CachedOp',
+                           lift=False)
         except DynamicShapeError:
             # a dynamic-output-shape op inside the graph (boolean_mask,
             # unique, ...): permanently switch this block to eager

@@ -510,22 +510,40 @@ def llama_partition_rules(axis='tp'):
     Megatron layout — q/k/v/gate/up sharded on the output (head) dim,
     o/down on the input dim, embeddings on the vocab dim, norms replicated.
     gluon Dense stores weight as (units_out, units_in), so the output dim
-    is axis 0."""
-    def col(name, shape):   # output-dim (column-parallel) kernels
-        return any(t in name for t in
-                   ('q_proj', 'k_proj', 'v_proj', 'gate_proj', 'up_proj'))
+    is axis 0.
 
-    def row(name, shape):   # input-dim (row-parallel) kernels
-        return any(t in name for t in ('o_proj', 'down_proj'))
+    Derived from the ``mx.sharding`` registry's ``('llama', 'tp')``
+    table — one source of truth for every sharded surface — and exposed
+    as legacy ``pred(name, shape)`` callables for existing
+    ``shard_params`` callers. ``axis`` renames the mesh axis in the
+    returned specs ('tp' in the registry)."""
+    import re as _re
+    from ...sharding import rules_for
 
-    def embed(name, shape):
-        return 'embed_tokens' in name or 'lm_head' in name
+    def _remap(spec):
+        if axis == 'tp':
+            return spec
+        out = []
+        for e in tuple(spec):
+            if isinstance(e, tuple):
+                out.append(tuple(axis if a == 'tp' else a for a in e))
+            else:
+                out.append(axis if e == 'tp' else e)
+        return P(*out)
 
-    return [
-        (col, P(axis, None)),
-        (row, P(None, axis)),
-        (embed, P(axis, None)),
-    ]
+    rules = []
+    for pattern, spec in rules_for('llama', 'tp'):
+        if callable(pattern) and not isinstance(pattern, _re.Pattern):
+            pred = pattern
+        else:
+            creg = _re.compile(pattern) if isinstance(pattern, str) \
+                else pattern
+
+            def pred(name, shape, _c=creg):
+                return _c.search(name) is not None
+            pred.__name__ = getattr(creg, 'pattern', str(pattern))
+        rules.append((pred, _remap(spec)))
+    return rules
 
 
 _LLAMA_CONFIGS = {

@@ -43,6 +43,12 @@ class Parameter:
         self._grad = None   # dict Context -> NDArray
         self._deferred_init = None
         self._structure_name = None  # set by Block registration
+        # PartitionSpec matched by the mx.sharding rule registry when a
+        # mesh context compiled this param's block; placement is sticky:
+        # set_data() re-places new values (checkpoint restores) on the
+        # same mesh layout instead of silently un-sharding the param
+        self._sharding_spec = None
+        self._sharding_mesh = None
 
     # ------------------------------------------------------------------ props
     @property
@@ -223,6 +229,16 @@ class Parameter:
         for c in list(self._data):
             self._data[c] = src.as_in_context(c).astype(self.dtype,
                                                         copy=False)
+        if self._sharding_spec is not None and \
+                self._sharding_mesh is not None:
+            # sticky sharded placement: a restored checkpoint value goes
+            # back onto the mesh layout the compiled program expects
+            import jax
+            from jax.sharding import NamedSharding
+            sh = NamedSharding(self._sharding_mesh, self._sharding_spec)
+            for c, nd in list(self._data.items()):
+                if getattr(nd._data, 'sharding', None) != sh:
+                    nd._rebind(jax.device_put(nd._data, sh))
         if self._grad_req != 'null':
             self._init_grad()
 

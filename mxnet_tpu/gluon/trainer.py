@@ -242,8 +242,9 @@ class Trainer:
             if param.grad_req == 'null' or param._data is None:
                 continue
             if i not in self._states:
-                self._states[i] = self._optimizer.create_state_multi_precision(
-                    i, param.data())
+                self._states[i] = self._zero1_place(
+                    param, self._optimizer.create_state_multi_precision(
+                        i, param.data()))
             if param._grad_stype == 'row_sparse':
                 sparse_live.append((i, param))
             else:
@@ -282,6 +283,99 @@ class Trainer:
                     i, datas[0], grads[0], self._states[i])
                 for d in datas[1:]:
                     d._rebind(datas[0]._data)
+                self._restore_placement(param)
+
+    # ------------------------------------------------------- sharded slots
+    def _zero1_place(self, param, state):
+        """Place freshly created optimizer slots on the active
+        ``mx.sharding`` mesh: the parameter's own layout plus the data
+        axis on the first still-replicated divisible dim (ZeRO-1 — the
+        GSPMD expression of kvstore/tpu.py ``_zero1_update``'s owner
+        plan, where each data-parallel rank holds and updates only its
+        slice of the slots). No-op outside a sharding context."""
+        from .. import sharding as _sharding
+        ctx = _sharding.current()
+        if ctx is None:
+            return state
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        pspec = getattr(param, '_sharding_spec', None)
+        if pspec is None or getattr(param, '_sharding_mesh', None) \
+                != ctx.mesh:
+            # param never compiled under this mesh: treat as replicated
+            pspec = P()
+
+        def place(nd):
+            if not isinstance(nd, NDArray) or nd.shape is None:
+                return nd
+            spec = ctx.zero1_spec(pspec, nd.shape) \
+                if nd.shape == param.shape else P()
+            nd._rebind(jax.device_put(
+                nd._data, NamedSharding(ctx.mesh, spec)))
+            return nd
+
+        if isinstance(state, NDArray):
+            return place(state)
+        if isinstance(state, (list, tuple)):
+            return type(state)(place(e) for e in state)
+        return state
+
+    def _mesh_place(self, live, ctx):
+        """Commit every fused-update operand to the active mesh.
+
+        The operands can arrive on mixed committed device sets: the
+        first-ever forward runs eagerly for shape inference and leaves
+        params/grads on one device while ``_zero1_place`` already
+        committed the fresh slots to the mesh — and conversely a
+        trainer warmed outside the context carries single-device slots
+        next to mesh-sharded params. jax rejects mixed committed sets
+        in one jitted call, so lift stragglers to the param's recorded
+        layout (replicated when the graph has not compiled under this
+        mesh yet) and rebind in place; the next sharded compile
+        re-places params per the rules regardless."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def on_mesh(raw):
+            sh = getattr(raw, 'sharding', None)
+            return sh is not None and \
+                len(sh.device_set) == ctx.n_devices
+
+        for i, p in live:
+            sp = getattr(p, '_sharding_spec', None)
+            if sp is None or getattr(p, '_sharding_mesh', None) \
+                    != ctx.mesh:
+                sp = P()
+            sh = NamedSharding(ctx.mesh, sp)
+            for nd in (p.list_data()[0], p.list_grad()[0]):
+                if not on_mesh(nd._data):
+                    nd._rebind(jax.device_put(nd._data, sh))
+            st = self._states.get(i)
+            leaves = [st] if isinstance(st, NDArray) else \
+                [e for e in (st or ()) if isinstance(e, NDArray)]
+            for e in leaves:
+                if not on_mesh(e._data) and e.shape is not None:
+                    spec = ctx.zero1_spec(sp, e.shape) \
+                        if e.shape == p.shape else P()
+                    e._rebind(jax.device_put(
+                        e._data, NamedSharding(ctx.mesh, spec)))
+
+    def _restore_placement(self, param):
+        """Eager-update fallback: put the rebound weight back on its
+        recorded mesh layout (the fused path constrains this inside the
+        jitted update instead)."""
+        from .. import sharding as _sharding
+        ctx = _sharding.current()
+        sp = getattr(param, '_sharding_spec', None)
+        if ctx is None or sp is None or \
+                getattr(param, '_sharding_mesh', None) != ctx.mesh:
+            return
+        import jax
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(ctx.mesh, sp)
+        for nd in param.list_data():
+            if nd._data.sharding != sh:
+                nd._rebind(jax.device_put(nd._data, sh))
 
     # -------------------------------------------------------- fused update
     def _fused_update(self, live):
@@ -299,15 +393,48 @@ class Trainer:
                 return [s._data]
             return [e._data for e in s if isinstance(e, NDArray)]
 
+        from .. import sharding as _sharding
+        _ctx = _sharding.current()
+        if _ctx is not None:
+            self._mesh_place(live, _ctx)
+
         praws = [p.list_data()[0]._data for _, p in live]
         graws = [p.list_grad()[0]._data for _, p in live]
         sraws = [flat_state(self._states[i]) for i, _ in live]
 
+        # placements join the key under a mesh: the step after the first
+        # sharded compile re-places params per the rules, and the fused
+        # fn's baked w_shard/s_shard constraints must be rebuilt for the
+        # new layouts
+        place_key = tuple(str(getattr(r, 'sharding', None))
+                          for r in praws) if _ctx is not None else None
         key = (id(opt), opt.rescale_grad, opt.clip_gradient,
-               tuple((r.shape, str(r.dtype)) for r in praws))
+               tuple((r.shape, str(r.dtype)) for r in praws),
+               _ctx.fingerprint() if _ctx is not None else None,
+               place_key)
         fn = self._fused_cache.get(key)
         if fn is None:
             state_templates = [self._states[i] for i, _ in live]
+            # under a mesh context, pin the updated weights and slots to
+            # the layouts the compiled forward / ZeRO-1 plan expect:
+            # GSPMD would otherwise let a replicated param inherit its
+            # gradient's data-parallel sharding and break the pjit
+            # entry's declared in_shardings on the next step
+            w_shard = [None] * len(live)
+            s_shard = [None] * len(live)
+            if _ctx is not None:
+                from jax.sharding import NamedSharding
+                for j, (i, p) in enumerate(live):
+                    sp = getattr(p, '_sharding_spec', None)
+                    if sp is not None and \
+                            getattr(p, '_sharding_mesh', None) == _ctx.mesh:
+                        w_shard[j] = NamedSharding(_ctx.mesh, sp)
+                    s_shard[j] = [
+                        e._data.sharding for e in
+                        (self._states[i] if isinstance(
+                            self._states[i], (list, tuple))
+                         else [self._states[i]])
+                        if isinstance(e, NDArray)] or None
 
             def fused(praws_, graws_, sraws_, lrs_, wds_, ts_):
                 prev = _tape.set_recording(False)
@@ -331,13 +458,23 @@ class Trainer:
                         # the parameter itself must stay bf16)
                         if nw.dtype != w.dtype:
                             nw = nw.astype(w.dtype)
+                        if w_shard[j] is not None:
+                            nw = jax.lax.with_sharding_constraint(
+                                nw, w_shard[j])
                         new_ws.append(nw)
                         if ns is None:
-                            new_ss.append([])
+                            ns_list = []
                         elif isinstance(ns, tuple):
-                            new_ss.append(list(ns))
+                            ns_list = list(ns)
                         else:
-                            new_ss.append([ns])
+                            ns_list = [ns]
+                        if s_shard[j]:
+                            ns_list = [
+                                jax.lax.with_sharding_constraint(e, sh)
+                                if sh is not None and hasattr(e, 'shape')
+                                else e
+                                for e, sh in zip(ns_list, s_shard[j])]
+                        new_ss.append(ns_list)
                     return new_ws, new_ss
                 finally:
                     _tape.set_recording(prev)

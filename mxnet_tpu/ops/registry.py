@@ -28,6 +28,17 @@ from .. import profiler as _prof
 
 _OPS = {}
 
+# bound lazily on first dispatch: ops loads before mx.sharding does
+_sharding_current = None
+_lift_raws = None
+
+
+def _bind_sharding():
+    global _sharding_current, _lift_raws
+    from ..sharding.context import current, lift_raws
+    _sharding_current = current
+    _lift_raws = lift_raws
+
 
 class Op:
     """One registered operator.
@@ -184,7 +195,7 @@ def _hashable(x):
 
 
 def apply_op(op, arrays, fn, n_out=None, name=None, _from_invoke=False,
-             bulk_key=None):
+             bulk_key=None, lift=True):
     """Imperative dispatch of a pure function over NDArray inputs.
 
     ``arrays``: NDArray inputs participating in autograd. ``fn``: closure over
@@ -217,6 +228,14 @@ def apply_op(op, arrays, fn, n_out=None, name=None, _from_invoke=False,
             return tuple(wrapped) if multi else wrapped[0]
 
     raws = [a._data for a in arrays]
+    if lift and _sharding_current is not None \
+            and _sharding_current() is not None:
+        # mesh context active: reconcile committed device sets (sharded
+        # graph outputs vs host-fresh labels) before dispatch. The
+        # _CachedGraph dispatch opts out (lift=False): its pjit entry
+        # declares explicit per-param in_shardings and places args
+        # itself.
+        raws = _lift_raws(raws)
     vjp_fn = None
     if profiling:
         import time as _time

@@ -21,25 +21,30 @@ def replicate(tree, mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
-def shard_params(params, mesh, rules=None):
-    """Place parameters on the mesh. ``rules``: list of (predicate(name,
-    shape) -> PartitionSpec); first match wins, default replicated.
+def shard_params(params, mesh, rules=None, on_unmatched='replicate'):
+    """Place parameters on the mesh. ``rules``: an ``mx.sharding`` rule
+    table — ordered ``(pattern, PartitionSpec)`` pairs where a pattern
+    is a regex over the structural name or a legacy ``pred(name, shape)``
+    callable; first match wins. A thin wrapper over the registry matcher
+    (``mx.sharding.match_spec``), so this, the hybridize cache and the
+    serve pool agree on every placement; the historical default of
+    replicating uncovered params is kept via ``on_unmatched='replicate'``
+    (pass ``'error'`` for the registry contract).
 
     Typical TP rule set for a transformer (megatron layout):
       - qkv/ffn-in kernels: shard output dim over 'tp'
       - proj/ffn-out kernels: shard input dim over 'tp'
     """
     from ..gluon.parameter import Parameter
+    from ..sharding import match_spec, resolve_spec
 
     out = {}
     for name, value in params.items():
         if isinstance(value, Parameter):   # accept collect_params() dicts
             value = value.data()
-        spec = P()
-        for pred, s in (rules or []):
-            if pred(name, value.shape):
-                spec = s
-                break
+        spec = match_spec(name, value.shape, rules,
+                          on_unmatched=on_unmatched)
+        spec = resolve_spec(spec, value.shape, mesh, name=name)
         out[name] = jax.device_put(
             value._data if isinstance(value, NDArray) else value,
             NamedSharding(mesh, spec))

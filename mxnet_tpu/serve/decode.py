@@ -234,6 +234,47 @@ class DecodeServer:
             run, self._praws = net._param_run()
         self._pool = net.init_paged_pool(num_pages, self.page_size)
 
+        # ambient mx.sharding context, captured at construction: params
+        # placed per the rule registry, the page pool sharded pages-on-
+        # 'dp' / KV-heads-on-'tp', and the step/prefill entries compiled
+        # once per mesh with matching in_shardings (the mesh is part of
+        # this server's identity — a new mesh is a new server)
+        from .. import sharding as _sharding
+        self._shard_ctx = _sharding.current()
+        self._pool_sharding = None
+        jit_kw = {'donate_argnums': (2,)}
+        if self._shard_ctx is not None:
+            sctx = self._shard_ctx
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rules = sctx.rules_for_block(net)
+            praw_sh = {name: sctx.sharding_for(name, raw.shape, rules)
+                       for name, raw in self._praws.items()}
+            self._praws = {name: jax.device_put(raw, praw_sh[name])
+                           for name, raw in self._praws.items()}
+            pool_spec = _sharding.resolve_spec(
+                P('dp', None, 'tp', None), self._pool[0][0].shape,
+                sctx.mesh, name='kv_pool')
+            self._pool_sharding = NamedSharding(sctx.mesh, pool_spec)
+            self._pool = [
+                (jax.device_put(k, self._pool_sharding),
+                 jax.device_put(v, self._pool_sharding))
+                for k, v in self._pool]
+            pool_in = [(self._pool_sharding, self._pool_sharding)
+                       for _ in self._pool]
+            jit_kw['in_shardings'] = (praw_sh, None, pool_in, None, None)
+
+        pool_sh = self._pool_sharding
+
+        def constrain_pool(pool):
+            # anchor the updated pages to the pool's layout so the
+            # donated buffers provably alias (in == out sharding) and
+            # the pool never drifts off its placement across steps
+            if pool_sh is None:
+                return pool
+            return [(jax.lax.with_sharding_constraint(k, pool_sh),
+                     jax.lax.with_sharding_constraint(v, pool_sh))
+                    for k, v in pool]
+
         # un-jitted bodies are kept for audit_donation()/lint — tracing
         # them does not disturb the compile counter
         def step_body(praws, toks, pool, offsets, pages):
@@ -241,23 +282,28 @@ class DecodeServer:
                                pages=pages)
             nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
                              axis=-1).astype(jnp.int32)
-            return nxt, pool
+            return nxt, constrain_pool(pool)
 
         def prefill_body(praws, tok, pool, off, pages, last):
             logits, pool = run(praws, tok, pool, off, pages=pages)
             nxt = jnp.argmax(
                 logits[0, last].astype(jnp.float32)).astype(jnp.int32)
-            return nxt, pool
+            return nxt, constrain_pool(pool)
 
         self._step_body = step_body
         self._prefill_body = prefill_body
 
-        @partial(jax.jit, donate_argnums=(2,))
+        @partial(jax.jit, **jit_kw)
         def step(praws, toks, pool, offsets, pages):
             self._compiles += 1     # trace-time side effect
             return step_body(praws, toks, pool, offsets, pages)
 
-        @partial(jax.jit, donate_argnums=(2,))
+        prefill_kw = dict(jit_kw)
+        if 'in_shardings' in prefill_kw:
+            prefill_kw['in_shardings'] = \
+                prefill_kw['in_shardings'] + (None,)
+
+        @partial(jax.jit, **prefill_kw)
         def prefill(praws, tok, pool, off, pages, last):
             self._compiles += 1
             return prefill_body(praws, tok, pool, off, pages, last)

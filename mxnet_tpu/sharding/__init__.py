@@ -1,0 +1,46 @@
+"""``mx.sharding`` — zero-model-change SPMD sharding for training and
+serving (ROADMAP item 1).
+
+Two pieces, composed by ``gluon/block.py``'s hybridize cache:
+
+* a **partition-rule registry** (:mod:`rules`): ordered
+  ``(regex, PartitionSpec)`` tables over the structural param names,
+  per-arch defaults for resnet/bert/llama in ``tp`` and ``fsdp`` modes,
+  user-registrable via :func:`register_rules`. First match wins,
+  scalars auto-replicate, an uncovered param errors naming the nearest
+  rule.
+* a **mesh-scoped context** (:mod:`context`): ``with mx.sharding.mesh(
+  dp=4, tp=2):`` makes every hybridize compile inside it a pjit-sharded
+  program — parameters placed per the rules, activations constrained at
+  the graph boundary, donation preserved — keyed by the mesh
+  fingerprint so mesh changes retrace (by design) and same-mesh reuse
+  is warm.
+
+Downstream consumers: ``gluon.Trainer`` partitions optimizer slots
+along the data axis (ZeRO-1) inside the context; ``serve.DecodeServer``
+shards the paged KV pool (pages on ``dp``, KV heads on ``tp``);
+``mx.analysis`` lowers/audits the sharded program and reports
+per-device costs. Everything runs on CPU under
+``--xla_force_host_platform_device_count=8`` (tools/launch.py
+``--cpu-mesh``), so tier-1 exercises real 8-device meshes.
+
+See docs/sharding.md for rule syntax and TP/FSDP recipes, and
+``parallel.init_distributed`` for the multi-host rendezvous.
+"""
+
+from .rules import (match_partition_rules, match_spec, resolve_spec,
+                    shard_factor, register_rules, rules_for, list_archs,
+                    infer_arch, UnmatchedParamError)
+from .context import (ShardingContext, mesh, current, constrain,
+                      batch_spec, use, lift_raws)
+
+# let the eager dispatch layer see the ambient mesh context (device-set
+# reconciliation in apply_op) without a circular top-level import
+from ..ops import registry as _registry
+_registry._bind_sharding()
+del _registry
+
+__all__ = ['match_partition_rules', 'match_spec', 'resolve_spec',
+           'shard_factor', 'register_rules', 'rules_for', 'list_archs',
+           'infer_arch', 'UnmatchedParamError', 'ShardingContext',
+           'mesh', 'current', 'constrain', 'batch_spec', 'use']

@@ -1,0 +1,274 @@
+"""Mesh-scoped sharding context: ``with mx.sharding.mesh(dp=4, tp=2):``.
+
+Inside the context every ``HybridBlock.hybridize()`` compile routes
+through ``jax.jit`` with ``in_shardings`` derived from the partition-rule
+registry (rules.py), parameters are placed sharded on the mesh, the
+Trainer partitions optimizer slots along the data axis (ZeRO-1), and
+``DecodeServer`` shards its KV page pool — all with zero model-code
+changes (gluon/block.py reads the ambient context at compile time).
+
+The context is thread-local and reentrant (a stack); its
+``fingerprint()`` is part of the ``_CachedGraph`` compile-cache key, so
+entering a *different* mesh retraces by design (a new device assignment
+is a new XLA program — the recompile-hazard rule documents this as a
+non-hazard), while re-entering the *same* mesh shape hits the warm
+cache.
+
+Env overrides (docs/env_vars.md):
+
+* ``MXNET_SHARDING_DP`` / ``MXNET_SHARDING_TP`` — override the axis
+  sizes passed to :func:`mesh` (deploy-time reshape without code edits);
+* ``MXNET_SHARDING_DISABLE=1`` — make :func:`mesh` a no-op (escape
+  hatch: single-device semantics for bisection);
+* ``MXNET_SHARDING_STRICT=1`` — error instead of replicating when a
+  rule's mesh axis does not divide the dim (rules.resolve_spec).
+"""
+
+import os
+import threading
+from contextlib import contextmanager
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import rules as _rules
+
+__all__ = ['ShardingContext', 'mesh', 'current', 'constrain',
+           'batch_spec']
+
+_STACK = threading.local()
+
+
+def _stack():
+    if not hasattr(_STACK, 'items'):
+        _STACK.items = []
+    return _STACK.items
+
+
+def current():
+    """The innermost active :class:`ShardingContext`, or None."""
+    items = _stack()
+    return items[-1] if items else None
+
+
+class ShardingContext:
+    """One mesh + rule table + the derived placement helpers."""
+
+    def __init__(self, mesh, rules=None, mode=None, arch=None,
+                 data_axis='dp'):
+        self.mesh = mesh
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        self.axis_sizes = sizes
+        if mode is None:
+            mode = 'tp' if sizes.get('tp', 1) > 1 else 'fsdp'
+        self.mode = mode
+        self.arch = arch          # None -> inferred per block
+        self._rules = rules       # explicit table beats the registry
+        self.data_axis = data_axis if sizes.get(data_axis, 1) > 1 else None
+        self.n_devices = int(mesh.devices.size)
+
+    # ------------------------------------------------------------- identity
+    def fingerprint(self):
+        """Hashable identity for compile-cache keys: mesh shape + axis
+        names + device ids + mode (+ rule-table identity). Two contexts
+        over the same devices/axes/rules share compiled executables."""
+        dev_ids = tuple(int(d.id) for d in self.mesh.devices.flat)
+        return (tuple(self.mesh.axis_names),
+                tuple(self.mesh.devices.shape), dev_ids, self.mode,
+                self.arch, id(self._rules) if self._rules else None)
+
+    # ------------------------------------------------------------ rule match
+    def rules_for_block(self, block=None, arch=None):
+        if self._rules is not None:
+            return self._rules
+        arch = arch or self.arch
+        if arch is None and block is not None:
+            arch = _rules.infer_arch(block)
+        arch = arch or 'generic'
+        try:
+            return _rules.rules_for(arch, self.mode)
+        except KeyError:
+            if arch != 'generic' and self.mode == 'fsdp':
+                return _rules.rules_for('generic', 'fsdp')
+            raise
+
+    def spec_for(self, name, shape, rules):
+        """Resolved PartitionSpec for one named parameter (rule match +
+        divisibility fallback against this mesh)."""
+        spec = _rules.match_spec(name, shape, rules)
+        return _rules.resolve_spec(spec, shape, self.mesh, name=name)
+
+    def sharding_for(self, name, shape, rules):
+        return NamedSharding(self.mesh, self.spec_for(name, shape, rules))
+
+    # ------------------------------------------------------------ placement
+    def batch_spec(self, shape):
+        """Activation spec: leading (batch) dim on the data axis when it
+        divides, otherwise replicated — the rule-tagged graph boundary
+        the hybridize cache constrains activations at."""
+        if self.data_axis is None or not shape:
+            return P()
+        extent = self.axis_sizes.get(self.data_axis, 1)
+        if shape[0] % extent:
+            return P()
+        return P(self.data_axis)
+
+    def put(self, raw, spec):
+        return jax.device_put(raw, NamedSharding(self.mesh, spec))
+
+    def zero1_spec(self, param_spec, shape):
+        """Optimizer-slot spec: the parameter's layout plus the data
+        axis on the first still-replicated divisible dim — optimizer
+        state partitioned along 'dp' (ZeRO-1; the GSPMD expression of
+        the kvstore/tpu.py ``_zero1_update`` owner plan, where each
+        data-parallel rank updates only its slice)."""
+        if self.data_axis is None:
+            return param_spec
+        extent = self.axis_sizes.get(self.data_axis, 1)
+        entries = list(tuple(param_spec)) + [None] * (len(shape)
+                                                      - len(param_spec))
+        used = set()
+        for e in entries:
+            for a in (e if isinstance(e, tuple) else (e,)):
+                if a is not None:
+                    used.add(a)
+        if self.data_axis in used:
+            return param_spec
+        sizes = self.axis_sizes
+        for d, e in enumerate(entries):
+            have = 1
+            for a in ((e if isinstance(e, tuple) else (e,)) or ()):
+                if a is not None:
+                    have *= sizes.get(a, 1)
+            if shape[d] % (have * extent) == 0 and shape[d] >= extent:
+                if e is None:
+                    entries[d] = self.data_axis
+                elif isinstance(e, tuple):
+                    entries[d] = e + (self.data_axis,)
+                else:
+                    entries[d] = (e, self.data_axis)
+                while entries and entries[-1] is None:
+                    entries.pop()
+                return P(*entries)
+        return param_spec
+
+    def __repr__(self):
+        ax = ', '.join(f'{k}={v}' for k, v in self.axis_sizes.items())
+        return f'<ShardingContext {ax} mode={self.mode}>'
+
+
+def constrain(x, spec=None):
+    """``with_sharding_constraint`` under the active mesh; identity when
+    no context is active (so library/model code may call it
+    unconditionally). ``x`` may be an NDArray or a raw array; ``spec``
+    defaults to the context's batch spec for the value's shape."""
+    ctx = current()
+    if ctx is None:
+        return x
+    from ..ndarray.ndarray import NDArray
+    raw = x._data if isinstance(x, NDArray) else x
+    if spec is None:
+        spec = ctx.batch_spec(raw.shape)
+    else:
+        spec = _rules.resolve_spec(spec, raw.shape, ctx.mesh)
+    out = jax.lax.with_sharding_constraint(
+        raw, NamedSharding(ctx.mesh, spec))
+    return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def batch_spec(shape):
+    """The active context's batch spec for ``shape`` (P() when none)."""
+    ctx = current()
+    return ctx.batch_spec(tuple(shape)) if ctx is not None else P()
+
+
+def lift_raws(raws):
+    """Eager-op device reconciliation (called by ``ops.registry``).
+
+    Inside a mesh context one dispatch may see arrays committed to the
+    full mesh (sharded graph outputs) next to host-fresh single-device
+    arrays (labels, loss masks) — jax rejects mixed committed device
+    sets. Lift the single-device ones onto the mesh at their batch spec
+    so eager loss/metric math composes with sharded forwards with zero
+    model-code changes. No-op (same list back) when nothing is
+    multi-device."""
+    ctx = current()
+    if ctx is None:
+        return raws
+    for r in raws:
+        sh = getattr(r, 'sharding', None)
+        if sh is not None and len(sh.device_set) > 1:
+            break
+    else:
+        return raws
+    out = []
+    for r in raws:
+        sh = getattr(r, 'sharding', None)
+        if sh is not None and len(sh.device_set) == 1 \
+                and getattr(r, 'ndim', None) is not None:
+            r = jax.device_put(r, NamedSharding(
+                ctx.mesh, ctx.batch_spec(r.shape)))
+        out.append(r)
+    return out
+
+
+def _env_axis(name, value):
+    env = os.environ.get(name, '')
+    if env:
+        return int(env)
+    return value
+
+
+@contextmanager
+def mesh(dp=None, tp=None, devices=None, rules=None, mode=None,
+         arch=None, **axes):
+    """Scoped sharding over a device mesh built from axis sizes::
+
+        with mx.sharding.mesh(dp=4, tp=2):
+            net.hybridize()
+            out = net(x)            # pjit-sharded, zero model changes
+
+    ``dp``/``tp`` (and any extra named axes) size the mesh;
+    ``MXNET_SHARDING_DP``/``MXNET_SHARDING_TP`` override them from the
+    environment, and ``MXNET_SHARDING_DISABLE=1`` turns the whole
+    context into a no-op. ``rules`` pins an explicit rule table;
+    otherwise the registry table for ``arch`` (inferred per block when
+    omitted) and the mode ('tp' when tp>1 else 'fsdp') applies.
+    """
+    if os.environ.get('MXNET_SHARDING_DISABLE', '') == '1':
+        yield None
+        return
+    from ..parallel.mesh import make_mesh
+    dp = _env_axis('MXNET_SHARDING_DP', dp)
+    tp = _env_axis('MXNET_SHARDING_TP', tp)
+    sizes = {}
+    if dp and dp > 1:
+        sizes['dp'] = dp
+    if tp and tp > 1:
+        sizes['tp'] = tp
+    for k, v in axes.items():
+        if v and v > 1:
+            sizes[k] = v
+    if not sizes:
+        sizes = {'dp': len(devices or jax.devices())}
+    ctx = ShardingContext(make_mesh(devices=devices, **sizes),
+                          rules=rules, mode=mode, arch=arch)
+    _stack().append(ctx)
+    try:
+        yield ctx
+    finally:
+        _stack().pop()
+
+
+@contextmanager
+def use(ctx):
+    """Re-enter an existing :class:`ShardingContext` (e.g. one captured
+    by a server at construction)."""
+    if ctx is None:
+        yield None
+        return
+    _stack().append(ctx)
+    try:
+        yield ctx
+    finally:
+        _stack().pop()

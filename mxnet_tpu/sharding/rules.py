@@ -1,0 +1,288 @@
+"""Partition-rule registry: regex -> PartitionSpec over the param pytree.
+
+The single matcher behind every sharded surface in the repo
+(``mx.sharding.mesh`` + the hybridize cache, ``parallel.shard_params``,
+the sharded serve pool, the Trainer's ZeRO-1 slot placement). The
+pattern is the one the SNIPPETS.md exemplars prove out at scale
+(``match_partition_rules``): a rule table is an ordered list of
+``(pattern, PartitionSpec)`` pairs, a parameter's *structural name*
+(``collect_params()`` keys, e.g. ``model.layers0.self_attn.q_proj.weight``)
+is matched with ``re.search`` against each pattern in order, and the
+first match wins. Scalars (0-d params) auto-replicate without consulting
+the table. A parameter no rule covers is an *error* naming the
+nearest-missing rule — a silently replicated 7B embedding is exactly the
+OOM the registry exists to prevent. (``parallel.shard_params`` keeps its
+historical replicate-by-default behavior by passing
+``on_unmatched='replicate'``.)
+
+Rules also accept legacy *predicate* patterns — ``pred(name, shape) ->
+bool`` callables — so the pre-registry rule sets
+(``llama_partition_rules``) run through the same matcher unchanged.
+
+Per-architecture tables ship for ``resnet``, ``bert`` and ``llama`` in
+two modes:
+
+* ``tp`` — Megatron tensor parallelism: column-parallel kernels shard
+  the output dim on the ``tp`` mesh axis, row-parallel kernels the
+  input dim, embeddings the vocab dim; norms/biases replicate.
+* ``fsdp`` — ZeRO-3-style fully-sharded data parallel: every weight
+  shards its leading dim on the ``dp`` mesh axis; small 1-d params
+  replicate (sharding a (64,) gamma buys nothing and costs a gather).
+
+``register_rules('myarch', 'tp', [...])`` adds user tables;
+``rules_for(arch, mode)`` reads them back. ``resolve_spec`` adapts a
+matched spec to a concrete (shape, mesh): any spec axis that does not
+evenly divide its dim is dropped (that dim replicates) unless
+``MXNET_SHARDING_STRICT=1``, which errors instead — documented in
+docs/sharding.md.
+"""
+
+import difflib
+import os
+import re
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['match_partition_rules', 'match_spec', 'resolve_spec',
+           'register_rules', 'rules_for', 'list_archs', 'infer_arch',
+           'UnmatchedParamError']
+
+
+class UnmatchedParamError(ValueError):
+    """A parameter matched no rule in the table (and the caller asked
+    for errors, the registry default)."""
+
+
+# --------------------------------------------------------------- the matcher
+def _matches(pattern, name, shape):
+    if isinstance(pattern, re.Pattern):
+        return pattern.search(name) is not None
+    if callable(pattern):
+        return bool(pattern(name, shape))
+    return re.search(pattern, name) is not None
+
+
+def _pattern_label(pattern):
+    if isinstance(pattern, re.Pattern):
+        return pattern.pattern
+    if callable(pattern):
+        return getattr(pattern, '__name__', repr(pattern))
+    return str(pattern)
+
+
+def _shape_of(value):
+    shape = getattr(value, 'shape', None)
+    if shape is None and isinstance(value, (tuple, list)) and all(
+            isinstance(d, int) for d in value):
+        shape = tuple(value)
+    if shape is None:
+        raise TypeError(f'cannot read a shape from {type(value).__name__}')
+    return tuple(shape)
+
+
+def match_spec(name, shape_or_value, rules, on_unmatched='error'):
+    """PartitionSpec for one parameter: first matching rule wins;
+    0-d scalars replicate unconditionally.
+
+    ``on_unmatched``: ``'error'`` raises :class:`UnmatchedParamError`
+    naming the nearest rule (the registry contract); ``'replicate'``
+    returns ``P()`` (the legacy ``shard_params`` contract).
+    """
+    shape = _shape_of(shape_or_value)
+    if len(shape) == 0:
+        return P()
+    for pattern, spec in rules or []:
+        if _matches(pattern, name, shape):
+            return spec
+    if on_unmatched == 'replicate':
+        return P()
+    labels = [_pattern_label(p) for p, _ in rules or []]
+    near = difflib.get_close_matches(name, labels, n=1, cutoff=0.0)
+    hint = f"; nearest rule: '{near[0]}'" if near else ''
+    raise UnmatchedParamError(
+        f"no partition rule matches parameter '{name}' "
+        f'(shape {shape}){hint}. Add a rule via '
+        "mx.sharding.register_rules(...) or pass rules=[...] "
+        "covering it (scalars auto-replicate; an explicit "
+        "(r'.*', PartitionSpec()) tail replicates the rest).")
+
+
+def match_partition_rules(rules, params, on_unmatched='error'):
+    """Match a whole param mapping (name -> shaped value / shape tuple)
+    to ``{name: PartitionSpec}`` through one pass of the matcher."""
+    return {name: match_spec(name, value, rules, on_unmatched=on_unmatched)
+            for name, value in params.items()}
+
+
+def strict_enabled():
+    return os.environ.get('MXNET_SHARDING_STRICT', '') == '1'
+
+
+def resolve_spec(spec, shape, mesh, name='<param>', strict=None):
+    """Adapt a matched spec to a concrete (shape, mesh): axes whose mesh
+    extent does not evenly divide the dim are dropped (that dim
+    replicates), and axes missing from the mesh are dropped too. Under
+    ``MXNET_SHARDING_STRICT=1`` (or ``strict=True``) a non-dividing
+    axis raises instead."""
+    if strict is None:
+        strict = strict_enabled()
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for d, entry in enumerate(tuple(spec) + (None,) * (len(shape)
+                                                       - len(spec))):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        axes = tuple(a for a in axes if a in sizes and sizes[a] > 1)
+        extent = 1
+        for a in axes:
+            extent *= sizes[a]
+        if extent > 1 and shape[d] % extent:
+            if strict:
+                raise ValueError(
+                    f'{name}: dim {d} of shape {tuple(shape)} is not '
+                    f'divisible by mesh axes {axes} (extent {extent}) '
+                    '— MXNET_SHARDING_STRICT=1 forbids the replicate '
+                    'fallback')
+            axes = ()
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def shard_factor(spec, shape, mesh):
+    """Number of devices one shard of this buffer is divided across:
+    the product of resolved mesh-axis extents — the divisor for the
+    per-device byte accounting in ``mx.analysis.costs``."""
+    resolved = resolve_spec(spec, shape, mesh, strict=False)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    factor = 1
+    for entry in resolved:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            factor *= sizes.get(a, 1)
+    return factor
+
+
+# ----------------------------------------------------------- per-arch tables
+# gluon Dense stores weight as (units_out, units_in): the output dim is
+# axis 0 (column-parallel -> P('tp', None)); conv weight is
+# (O, I, kh, kw).
+_ARCH_RULES = {
+    'llama': {
+        'tp': [
+            (r'(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight$',
+             P('tp', None)),
+            (r'(o_proj|down_proj)\.weight$', P(None, 'tp')),
+            (r'(embed_tokens|lm_head)\.weight$', P('tp', None)),
+            (r'(layernorm|norm)\.weight$', P()),
+            (r'\.bias$', P()),
+        ],
+        'fsdp': [
+            (r'(layernorm|norm)\.weight$', P()),
+            (r'\.bias$', P()),
+            (r'\.weight$', P('dp')),
+        ],
+    },
+    'resnet': {
+        # TP for convnets: shard output channels; BN stats/scales and
+        # biases are per-channel 1-d — replicate (a (64,) gather costs
+        # more than it saves).
+        'tp': [
+            (r'(conv|downsample).*weight$', P('tp')),
+            (r'(dense|fc|output).*weight$', P('tp', None)),
+            (r'(batchnorm|bn|norm)', P()),
+            (r'(gamma|beta|running_mean|running_var)$', P()),
+            (r'\.bias$', P()),
+        ],
+        'fsdp': [
+            (r'(batchnorm|bn|norm)', P()),
+            (r'(gamma|beta|running_mean|running_var)$', P()),
+            (r'\.bias$', P()),
+            (r'weight$', P('dp')),
+        ],
+    },
+    'bert': {
+        'tp': [
+            (r'attention.*(query|key|value).*weight$', P('tp', None)),
+            (r'(intermediate|ffn_1|ffn1).*weight$', P('tp', None)),
+            (r'attention.*(proj|output|out_proj).*weight$', P(None, 'tp')),
+            (r'(ffn_2|ffn2|output).*weight$', P(None, 'tp')),
+            (r'(word_embed|token_embed|embed|position_weight)',
+             P('tp', None)),
+            (r'(layer_norm|layernorm|norm)', P()),
+            (r'(gamma|beta)$', P()),
+            (r'\.bias$', P()),
+        ],
+        'fsdp': [
+            (r'(layer_norm|layernorm|norm)', P()),
+            (r'(gamma|beta)$', P()),
+            (r'\.bias$', P()),
+            (r'weight$', P('dp')),
+        ],
+    },
+    # zero-config fallback for arbitrary blocks: FSDP-style leading-dim
+    # sharding for tensors, replicate the 1-d odds and ends. TP has no
+    # generic answer — an unknown arch under mode='tp' must bring rules.
+    'generic': {
+        'fsdp': [
+            (lambda name, shape: len(shape) <= 1, P()),
+            (r'.*', P('dp')),
+        ],
+    },
+}
+
+
+def register_rules(arch, mode, rules):
+    """Register (or replace) a rule table: ``register_rules('mymodel',
+    'tp', [(r'attn.*weight', P('tp', None)), ...])``. Patterns are
+    regexes (or ``pred(name, shape)`` callables); first match wins."""
+    _ARCH_RULES.setdefault(arch, {})[mode] = list(rules)
+
+
+def rules_for(arch, mode='tp'):
+    """The registered rule table for (arch, mode). Raises KeyError with
+    the available tables listed when there is none."""
+    tables = _ARCH_RULES.get(arch)
+    if tables is None or mode not in tables:
+        have = sorted(f'{a}:{m}' for a, ms in _ARCH_RULES.items()
+                      for m in ms)
+        raise KeyError(
+            f'no partition rules registered for arch={arch!r} '
+            f'mode={mode!r}; have {have}. Register a table with '
+            'mx.sharding.register_rules(arch, mode, rules).')
+    return list(tables[mode])
+
+
+def list_archs():
+    return {a: sorted(ms) for a, ms in _ARCH_RULES.items()}
+
+
+_ARCH_HINTS = (
+    ('llama', 'llama'), ('bert', 'bert'), ('resnet', 'resnet'),
+)
+
+
+def infer_arch(block):
+    """Best-effort architecture tag for a block (class-name match down
+    the child tree); ``'generic'`` when nothing matches."""
+    seen, stack = set(), [block]
+    while stack:
+        b = stack.pop()
+        if id(b) in seen:
+            continue
+        seen.add(id(b))
+        cls = type(b).__name__.lower()
+        for hint, arch in _ARCH_HINTS:
+            if hint in cls:
+                return arch
+        stack.extend(getattr(b, '_children', {}).values())
+    return 'generic'

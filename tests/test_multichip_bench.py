@@ -1,0 +1,66 @@
+"""tools/multichip_bench.py --smoke, in process (tier-1).
+
+The bench is the executable form of the multi-chip acceptance
+criteria: an unmodified resnet18 trains FSDP- and TP-sharded and an
+unmodified llama_tiny decodes under a dp x tp mesh, with zero
+recompiles after warmup and the donation audit clean on the sharded
+program. Running it here keeps ``MULTICHIP_r06.json`` reproducible
+from a plain checkout.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason='needs the 8-device CPU mesh')
+
+
+def test_smoke_emits_artifact(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import multichip_bench
+    finally:
+        sys.path.pop(0)
+
+    out = tmp_path / 'MULTICHIP_smoke.json'
+    doc, rc = multichip_bench.run_bench(smoke=True, out=str(out))
+    assert rc == 0, doc.get('errors')
+    assert doc['ok'] and not doc['errors']
+    assert doc['n_devices'] == 8
+
+    # the artifact round-trips and carries every promised field
+    saved = json.loads(out.read_text())
+    assert saved['round'] == 'r06'
+
+    train = saved['train']
+    assert train['mode'] == 'fsdp' and train['mesh'] == {'dp': 8}
+    assert train['steps_s'] > 0 and train['samples_s'] > 0
+    assert train['recompiles_after_warmup'] == 0
+    for k in ('predicted_flops', 'predicted_hbm_bytes_min',
+              'predicted_bytes_moved', 'predicted_peak_hbm_bytes',
+              'predicted_step_seconds'):
+        assert train[k] and train[k] > 0, k
+
+    assert saved['train_tp']['params_on_mesh'] is True
+
+    decode = saved['decode']
+    assert decode['mesh'] == {'dp': 2, 'tp': 2}
+    assert decode['tok_s'] > 0
+    assert decode['recompiles'] == 0
+    assert (decode['donation']['aliased_args']
+            == decode['donation']['donated_args'])
+    assert decode['pool_spec'].startswith("PartitionSpec('dp'")
+    assert decode['predicted_step_seconds'] > 0
+
+    # the r05 baseline rides along for side-by-side reading
+    base = saved['baseline']
+    assert base['file'] == 'MULTICHIP_r05.json'
+    if base['found']:
+        assert base['n_devices'] == saved['n_devices'] == 8
+        assert base['ok'] is True
