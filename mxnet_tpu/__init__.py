@@ -91,6 +91,7 @@ from . import parallel
 from . import sharding
 from . import amp
 from . import analysis
+from . import telemetry
 from . import serve
 from . import train
 from . import quantization

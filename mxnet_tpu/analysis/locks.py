@@ -101,6 +101,15 @@ LOCK_HIERARCHY = (
                         'generation counter (kvstore/dist_async.py)'),
     ('misc.leaf', 'leaf locks (stats/seq/registry/compile-once): nothing '
                   'may be acquired while holding one'),
+    ('telemetry.buffer', 'the flight recorder ring + clock-offset table '
+                         '(telemetry/trace.py): spans may be recorded '
+                         'while holding ANY runtime lock, so it sits '
+                         'below them all; nothing is acquired under it'),
+    ('telemetry.metrics', 'metrics registry + instrument values '
+                          '(telemetry/metrics.py): counter/histogram '
+                          'updates nest under any runtime lock; '
+                          'collector callables run OUTSIDE it (they '
+                          'take their owners\' locks at scrape time)'),
     ('race.internal', 'the dynamic race checker\'s own metadata lock; '
                       'innermost by construction (analysis/race.py)'),
 )
@@ -149,6 +158,8 @@ LOCK_SITES = {
         '_tp_lock': 'misc.leaf',
     },
     '*/analysis/race.py': {'_meta': 'race.internal'},
+    '*/telemetry/trace.py': {'_lock': 'telemetry.buffer'},
+    '*/telemetry/metrics.py': {'_LOCK': 'telemetry.metrics'},
 }
 
 # Levels whose entire purpose is serializing blocking work: the

@@ -77,6 +77,7 @@ import threading
 import numpy as _onp
 
 from ..ndarray.ndarray import NDArray
+from ..telemetry import trace as _trace
 from . import faults
 from .base import KVStoreBase, register
 # framing helpers re-exported from their historical home: faults-harness
@@ -717,6 +718,14 @@ class KVStoreDistAsync(KVStoreBase):
                                    'shape': part.shape}, part.tobytes())
 
     def push(self, key, value, priority=0):
+        # child-only span: a traced caller (telemetry.span around the
+        # training step) sees its push/pull legs — and, through the tc
+        # injected on each RPC, the server-side apply — as one trace;
+        # untraced callers pay one context check
+        with _trace.child_span('kvstore.push'):
+            self._push(key, value, priority)
+
+    def _push(self, key, value, priority=0):
         keys = key if isinstance(key, (list, tuple)) else [key]
         vals = value if isinstance(value, (list, tuple)) else [value]
         for k, v in zip(keys, vals):
@@ -739,6 +748,10 @@ class KVStoreDistAsync(KVStoreBase):
             reply['shape'])
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        with _trace.child_span('kvstore.pull'):
+            return self._pull(key, out, priority, ignore_sparse)
+
+    def _pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = key if isinstance(key, (list, tuple)) else [key]
         outs = out if isinstance(out, (list, tuple)) else [out]
         import jax.numpy as jnp

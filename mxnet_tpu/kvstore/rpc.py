@@ -39,6 +39,16 @@ import threading
 import time as _time
 
 from . import faults
+from ..telemetry import trace as _trace
+from ..telemetry import metrics as _tmetrics
+
+# fleet-wide transport counters (registry instruments, mergeable over
+# the 'metrics' verb); each channel's per-instance stats dict remains
+# the local thin view
+_C_RETRIES = _tmetrics.counter('mx_rpc_retries_total')
+_C_REDIALS = _tmetrics.counter('mx_rpc_redials_total')
+_C_GIVEUPS = _tmetrics.counter('mx_rpc_giveups_total')
+_C_REPLAYS = _tmetrics.counter('mx_rpc_dedup_replays_total')
 
 
 def _recv_exact(sock, n):
@@ -197,15 +207,39 @@ class RpcServer(threading.Thread):
             self._server.shutdown()
         self._server.server_close()
 
+    def release_port(self):
+        """Drop the post-crash port hold so a successor may bind the
+        advertised port (no-op unless :meth:`crash` ran)."""
+        hold = getattr(self, '_port_hold', None)
+        if hold is not None:
+            self._port_hold = None
+            try:
+                hold.close()
+            except OSError:
+                pass
+
     def crash(self):
         """Abrupt death for chaos tests: stop accepting, force-close
         every live connection mid-flight — no replies, no farewells —
         exactly what a killed replica process looks like to its peers.
         The instance is dead afterwards; recovery is a NEW server on
         the same port (see ``serve.replica.Replica.restart``)."""
+        addr = self._server.server_address
         if self.is_alive():
             self._server.shutdown()
         self._server.server_close()
+        # Hold the freed port with a bound, non-listening socket:
+        # peers still get connection-refused (dead-process semantics),
+        # but the OS cannot hand the port out as an ephemeral source
+        # port to some unrelated connection, which would make the
+        # same-port restart fail EADDRINUSE. release_port() drops it.
+        try:
+            hold = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            hold.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            hold.bind(addr)
+            self._port_hold = hold
+        except OSError:
+            pass                        # already stolen; restart retries
         with self._conns_lock:
             conns = list(self._conns)
             self._conns.clear()
@@ -233,6 +267,21 @@ class RpcServer(threading.Thread):
 
     # ----------------------------------------------------------- dispatch
     def _dispatch(self, header, payload, peer='127.0.0.1'):
+        """Trace adoption around :meth:`_dispatch_inner`: when the
+        envelope carries a ``tc`` context (injected by a tracing
+        :class:`RpcClient`; old peers simply never send one) the whole
+        server-side handling becomes a ``rpc.handle:<cmd>`` span in the
+        caller's trace — including an injected crash, which lands as
+        the span's ``error`` attr before the connection severs."""
+        tc = header.get('tc')
+        if not tc or not _trace.enabled():
+            return self._dispatch_inner(header, payload, peer)
+        with _trace.attach(tc):
+            with _trace.span('rpc.handle:%s' % header['cmd'],
+                             sid=self._sid):
+                return self._dispatch_inner(header, payload, peer)
+
+    def _dispatch_inner(self, header, payload, peer):
         """Bookkeeping envelope around :meth:`_handle`: heartbeat
         refresh (tombstone-gated), then the (client, seq) dedup window
         — a retried mutating RPC the server already applied gets its
@@ -254,6 +303,7 @@ class RpcServer(threading.Thread):
                 cached = self._dedup.get((client, int(seq)))
                 if cached is not None:
                     self._counters['dedup_replays'] += 1
+                    _C_REPLAYS.inc()
                     return cached
         reply, rpayload = self._handle(header, payload, peer)
         if client is not None and seq is not None and reply.get('ok'):
@@ -272,7 +322,11 @@ class RpcServer(threading.Thread):
     def _handle(self, header, payload, peer='127.0.0.1'):
         cmd = header['cmd']
         if cmd == 'ping':
-            reply = {'ok': True, 'sid': self._sid}
+            # ts/proc: the peer's wall clock + process identity, read by
+            # telemetry.note_clock on the caller for cross-process trace
+            # alignment (NTP-midpoint offset off this one round trip)
+            reply = {'ok': True, 'sid': self._sid,
+                     'ts': _time.time(), 'proc': _trace.proc_name()}
             extra = self._ping_extra()
             if extra:
                 reply.update(extra)
@@ -295,6 +349,17 @@ class RpcServer(threading.Thread):
             # tombstoned ranks left CLEANLY: reported separately, never
             # counted dead
             return {'ok': True, 'dead': dead, 'departed': departed}, b''
+        if cmd == 'metrics':
+            # fleet aggregation: the whole process registry snapshot —
+            # the caller merges snapshots rid-deduped (in-process peers
+            # share one registry and must not be double-counted)
+            return {'ok': True,
+                    'metrics': _tmetrics.default_registry().snapshot()}, \
+                b''
+        if cmd == 'telemetry':
+            # flight-recorder sweep for the cross-process trace export
+            return {'ok': True,
+                    'telemetry': _trace.snapshot_buffer()}, b''
         return self._handle_app(header, payload, peer)
 
     def _handle_app(self, header, payload, peer):
@@ -395,7 +460,23 @@ class RpcClient:
                 pass
 
     def call(self, header, payload=b'', attempts=None, deadline_s=None):
-        """One RPC with retry/backoff + reconnect (see class docs)."""
+        """One RPC with retry/backoff + reconnect (see class docs).
+
+        When the calling thread has a live trace context the whole
+        call (retries and backoff included) becomes an ``rpc:<cmd>``
+        span and the envelope grows an optional ``tc`` field carrying
+        that span's context — old peers ignore the extra key, tracing
+        peers adopt it, so one user request stitches into ONE trace
+        across every hop. No context → the envelope is byte-identical
+        to the pre-telemetry wire format."""
+        if _trace.current_tc() is None:
+            return self._call(header, payload, attempts, deadline_s)
+        with _trace.span('rpc:%s' % header['cmd'], peer=self._label):
+            header = dict(header)
+            header['tc'] = _trace.current_tc()
+            return self._call(header, payload, attempts, deadline_s)
+
+    def _call(self, header, payload=b'', attempts=None, deadline_s=None):
         import random
         import time
         deadline = time.monotonic() + (
@@ -410,6 +491,7 @@ class RpcClient:
                         sock = self._dial(deadline=deadline)
                         self._sock = sock
                         self._stats['redials'] += 1
+                        _C_REDIALS.inc()
                     sock.settimeout(
                         max(0.05, deadline - time.monotonic()))
                     _send_msg(sock, header, payload)
@@ -426,6 +508,7 @@ class RpcClient:
                     now = time.monotonic()
                     if attempt + 1 >= attempts or now >= deadline:
                         self._stats['giveups'] += 1
+                        _C_GIVEUPS.inc()
                         raise ConnectionError(
                             f'{self._what} rpc {header["cmd"]!r} to '
                             f'{self._label} at '
@@ -436,6 +519,7 @@ class RpcClient:
                             'MXNET_KVSTORE_RPC_DEADLINE_S to wait '
                             'longer') from e
                     self._stats['retries'] += 1
+                    _C_RETRIES.inc()
                     step = self._backoff * (2 ** attempt)
                     step *= 0.5 + random.random() / 2   # jitter
                     time.sleep(min(step, max(0.0, deadline - now)))

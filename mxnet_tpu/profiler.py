@@ -12,13 +12,18 @@ import time
 
 import jax
 
+from .telemetry.metrics import Histogram as _Histogram
+
 _config = {'profile_all': False, 'filename': '/tmp/mxnet_tpu_profile',
            'running': False, 'ops': False, 'memory': False}
-_records = []
-# name -> [count, total_s, min_s, max_s, out_bytes, samples]; ``samples``
-# is a bounded ring of per-call latencies feeding the percentile columns
+# scoped host timings, aggregated at record time: name -> [count,
+# total_s] — bounded by the number of distinct scope names (the old
+# per-event list grew by one tuple per scope() forever)
+_records = {}
+# name -> [count, total_s, min_s, max_s, out_bytes, hist]; ``hist`` is
+# a telemetry Histogram (fixed log-scale buckets, bounded memory)
+# feeding the percentile columns
 _op_stats = {}
-_OP_SAMPLES = 512
 _mem_stats = {'peak_live_bytes': 0}
 _analysis_reports = {}   # graph name -> mx.analysis.AnalysisReport
 _cost_reports = {}       # graph name -> mx.analysis.CostReport
@@ -30,10 +35,17 @@ def percentiles(samples, qs=(50, 95, 99)):
     """Nearest-rank percentiles of a latency sample set, as
     ``{q: value}``. Shared between the per-op table and the Serving
     section (``mx.serve`` metrics use the same estimator so the two
-    surfaces agree)."""
-    if not samples:
+    surfaces agree).
+
+    Accepts any iterable (lists, generators, numpy arrays — whose
+    truthiness is ambiguous and used to raise here). Empty input
+    yields all-zero percentiles; a single sample reports itself for
+    every ``q``."""
+    s = sorted(float(x) for x in samples)
+    if not s:
         return {q: 0.0 for q in qs}
-    s = sorted(samples)
+    if len(s) == 1:
+        return {q: s[0] for q in qs}
     return {q: s[min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))]
             for q in qs}
 
@@ -103,17 +115,14 @@ def record_op(name, dt, out_bytes):
     with _stats_lock:
         s = _op_stats.get(name)
         if s is None:
-            _op_stats[name] = [1, dt, dt, dt, out_bytes, [dt]]
-        else:
-            s[0] += 1
-            s[1] += dt
-            s[2] = min(s[2], dt)
-            s[3] = max(s[3], dt)
-            s[4] += out_bytes
-            if len(s[5]) < _OP_SAMPLES:
-                s[5].append(dt)
-            else:
-                s[5][s[0] % _OP_SAMPLES] = dt
+            s = [0, 0.0, dt, dt, 0, _Histogram()]
+            _op_stats[name] = s
+        s[0] += 1
+        s[1] += dt
+        s[2] = min(s[2], dt)
+        s[3] = max(s[3], dt)
+        s[4] += out_bytes
+        s[5].observe(dt)
         if _config['memory']:
             # O(1) allocator peak where the backend exposes it (TPU
             # does); a per-op live_arrays() walk would be O(live
@@ -192,21 +201,18 @@ def dumps(reset=False):
         lines.append(f'{"Name":<32}{"Count":>8}{"Total(ms)":>12}'
                      f'{"Avg(ms)":>10}{"p50(ms)":>10}{"p95(ms)":>10}'
                      f'{"p99(ms)":>10}{"Out(MB)":>10}')
-        for name, (c, t, _lo, _hi, nb, samples) in sorted(
+        for name, (c, t, _lo, _hi, nb, hist) in sorted(
                 _op_stats.items(), key=lambda kv: -kv[1][1]):
-            pct = percentiles(samples)
+            pct = hist.percentiles()
             lines.append(f'{name:<32}{c:>8}{t * 1e3:>12.3f}'
                          f'{t / c * 1e3:>10.3f}{pct[50] * 1e3:>10.3f}'
                          f'{pct[95] * 1e3:>10.3f}{pct[99] * 1e3:>10.3f}'
                          f'{nb / 1e6:>10.2f}')
-    agg = {}
-    for name, dt in _records:
-        c, t = agg.get(name, (0, 0.0))
-        agg[name] = (c + 1, t + dt)
-    if agg:
+    if _records:
         lines.append('Scoped host timings:')
         lines.append(f'{"Name":<40}{"Count":>8}{"Total(ms)":>12}')
-        for name, (c, t) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        for name, (c, t) in sorted(_records.items(),
+                                   key=lambda kv: -kv[1][1]):
             lines.append(f'{name:<40}{c:>8}{t * 1e3:>12.3f}')
     if _config['memory'] and _mem_stats['peak_live_bytes']:
         lines.append(f'Peak live device memory: '
@@ -309,6 +315,16 @@ def memory_summary(device=None):
     return out
 
 
+def _record(name, dt):
+    with _stats_lock:
+        r = _records.get(name)
+        if r is None:
+            _records[name] = [1, dt]
+        else:
+            r[0] += 1
+            r[1] += dt
+
+
 @contextlib.contextmanager
 def scope(name='<unk>:'):
     """Reference profiler.scope — also emits a jax named annotation so the
@@ -316,8 +332,7 @@ def scope(name='<unk>:'):
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
-    with _stats_lock:
-        _records.append((name, time.perf_counter() - t0))
+    _record(name, time.perf_counter() - t0)
 
 
 class Task:
@@ -330,9 +345,7 @@ class Task:
 
     def stop(self):
         if self._t0 is not None:
-            with _stats_lock:
-                _records.append((self.name,
-                                 time.perf_counter() - self._t0))
+            _record(self.name, time.perf_counter() - self._t0)
 
 
 Frame = Task
@@ -359,8 +372,7 @@ class Marker:
         self.name = name
 
     def mark(self, scope='process'):
-        with _stats_lock:
-            _records.append((self.name, 0.0))
+        _record(self.name, 0.0)
 
 
 def server_annotation(*a, **kw):

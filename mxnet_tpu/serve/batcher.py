@@ -37,6 +37,7 @@ from collections import deque
 from concurrent.futures import Future
 
 from ..analysis import race as _race
+from ..telemetry import trace as _trace
 from . import faults as _faults
 from .errors import DeadlineExceeded, ServerClosed, ServerOverloaded
 from .metrics import ServingMetrics, register as _register, \
@@ -63,13 +64,18 @@ def _env_float(name, default):
 class Request:
     """One queued example: payload + completion future + timing."""
 
-    __slots__ = ('payload', 'future', 'submit_t', 'deadline')
+    __slots__ = ('payload', 'future', 'submit_t', 'deadline', 'tc',
+                 'wall_t')
 
     def __init__(self, payload, submit_t, deadline):
         self.payload = payload
         self.future = Future()
         self.submit_t = submit_t
         self.deadline = deadline        # absolute clock time or None
+        # trace context captured at submission; None (the untraced
+        # common case) short-circuits the scheduler's telemetry path
+        self.tc = _trace.current_tc()
+        self.wall_t = _trace.walltime() if self.tc is not None else 0.0
 
 
 class DynamicBatcher:
@@ -223,6 +229,8 @@ class DynamicBatcher:
                 'deadline expired in queue; aborted before dispatch'))
         if not batch:
             return len(expired)
+        traced = [r for r in batch if r.tc is not None]
+        t0w = _trace.walltime() if traced else 0.0
         try:
             _faults.on('dispatch')
             rows, n_pad = self.runner.run_batch(
@@ -233,6 +241,16 @@ class DynamicBatcher:
                 self._fail(req, e)
             return len(batch) + len(expired)
         now = self._clock()
+        if traced:
+            t1w = _trace.walltime()
+            for req in traced:
+                # retroactive spans per traced request: its queue wait
+                # (submit -> batch cut) and its ride on the dispatch
+                _trace.emit('batch.queue', req.wall_t, t0w,
+                            parent=req.tc, batcher=self.name)
+                _trace.emit('batch.dispatch', t0w, t1w, parent=req.tc,
+                            batcher=self.name, rows=len(batch),
+                            pad=n_pad)
         self.metrics.on_dispatch(
             len(batch), n_pad, [now - r.submit_t for r in batch])
         if self.runner.compile_count != self.compile_baseline:

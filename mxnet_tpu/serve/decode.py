@@ -67,6 +67,7 @@ from concurrent.futures import Future
 from functools import partial
 
 from ..analysis import race as _race
+from ..telemetry import trace as _trace
 from . import faults as _faults
 from . import pages as _pages
 from .buckets import chunk_spans
@@ -110,7 +111,8 @@ class _Seq:
 
 
 class _DecodeRequest:
-    __slots__ = ('prompt', 'max_new', 'future', 'submit_t', 'deadline')
+    __slots__ = ('prompt', 'max_new', 'future', 'submit_t', 'deadline',
+                 'tc', 'wall_t')
 
     def __init__(self, prompt, max_new, submit_t, deadline):
         self.prompt = prompt
@@ -118,6 +120,12 @@ class _DecodeRequest:
         self.future = Future()
         self.submit_t = submit_t
         self.deadline = deadline
+        # trace context captured at submission (the handler thread's
+        # attached ctx): the scheduler emits queue-wait / prefill /
+        # per-step spans against it retroactively. None (the common
+        # untraced case) short-circuits every telemetry touch.
+        self.tc = _trace.current_tc()
+        self.wall_t = _trace.walltime() if self.tc is not None else 0.0
 
 
 class DecodeServer:
@@ -477,6 +485,7 @@ class DecodeServer:
         start = seq.filled
         real = min(c, alen - start)
         is_final = start + real >= alen
+        t0w = _trace.walltime() if req.tc is not None else 0.0
         try:
             _faults.on('prefill')
             toks = req.prompt[start:start + real] + [0] * (c - real)
@@ -490,6 +499,10 @@ class DecodeServer:
             self.metrics.on_failed()
             self._retire(seq, error=e)
             return 0
+        if req.tc is not None:
+            _trace.emit('decode.prefill', t0w, _trace.walltime(),
+                        parent=req.tc, server=self.name, start=start,
+                        real=real, final=is_final)
         if self._prefix_on and real == c:
             # a full chunk is shareable: publish its pages under the
             # chain key of the entire prefix through this chunk
@@ -549,6 +562,15 @@ class DecodeServer:
                     self._set_slot(slot, seq)
                 admitted.append(seq)
                 self.metrics.on_admit([now - req.submit_t])
+        for seq in admitted:
+            # retroactive queue-wait span: submission wall time ->
+            # admission (locks released; emit takes only the recorder
+            # lock, which sits below everything)
+            req = seq.request
+            if req.tc is not None:
+                _trace.emit('decode.queue', req.wall_t,
+                            _trace.walltime(), parent=req.tc,
+                            server=self.name, slot=seq.slot)
         for req in expired:
             self.metrics.on_expired()
             self._fail(req, DeadlineExceeded(
@@ -571,6 +593,8 @@ class DecodeServer:
             alive = [s for s in decoding if s.remaining > 0]
             if alive:
                 stepped = len(alive)
+                traced = [s for s in alive if s.request.tc is not None]
+                t0w = _trace.walltime() if traced else 0.0
                 try:
                     import numpy as onp
                     _faults.on('step')
@@ -598,6 +622,13 @@ class DecodeServer:
                         self._retire(s, error=e)
                     return len(admitted) + prefilled + len(expired)
                 now2 = self._clock()
+                t1w = _trace.walltime() if traced else 0.0
+                for s in traced:
+                    # one span per traced sequence per decode step: the
+                    # token-by-token heartbeat of the request's trace
+                    _trace.emit('decode.step', t0w, t1w,
+                                parent=s.request.tc, server=self.name,
+                                slot=s.slot, token=nxt[s.slot])
                 for s in alive:
                     s.tokens.append(nxt[s.slot])
                     s.offset += 1
@@ -696,6 +727,7 @@ class DecodeServer:
                             'drain deadline exceeded '
                             '(MXNET_SERVE_DRAIN_S)')
                     self._closed = True
+        self._alloc.detach()
         _unregister(self._metrics_name)
 
     @property

@@ -15,10 +15,11 @@ acquired while holding it, level ``misc.leaf`` in
 """
 
 import threading
-from collections import deque
 
 from .. import profiler
 from ..analysis import race as _race
+from ..telemetry import metrics as _tmetrics
+from ..telemetry.metrics import Reservoir
 
 __all__ = ['ServingMetrics', 'registry', 'register', 'unregister']
 
@@ -38,10 +39,18 @@ class ServingMetrics:
         self._lock = threading.Lock()
         if _race.enabled():
             self._lock = _race.tracked(self._lock, 'misc.leaf')
-        self._latency_s = deque(maxlen=_SAMPLES)   # submit -> result
-        self._queue_s = deque(maxlen=_SAMPLES)     # submit -> dispatch
-        self._ttft_s = deque(maxlen=_SAMPLES)      # submit -> 1st token
-        self._intertok_s = deque(maxlen=_SAMPLES)  # token -> next token
+        # bounded WHOLE-RUN percentile samples (reservoir sampling,
+        # uniform over every observation) — a sliding-window deque
+        # only ever showed the last few thousand events, so long-run
+        # percentiles silently became recent-window percentiles
+        self._latency_s = Reservoir(_SAMPLES)   # submit -> result
+        self._queue_s = Reservoir(_SAMPLES)     # submit -> dispatch
+        self._ttft_s = Reservoir(_SAMPLES)      # submit -> 1st token
+        self._intertok_s = Reservoir(_SAMPLES)  # token -> next token
+        # registry binding (histograms + collector): installed by
+        # module-level register() once the public name is settled
+        self._hist = None
+        self._collector_key = None
         self._requests = 0
         self._completed = 0
         self._failed = 0
@@ -81,12 +90,14 @@ class ServingMetrics:
             self._batched_rows += n_real
             self._padded_rows += n_pad
             self._queue_s.extend(queue_times_s)
+        self._observe('queue', queue_times_s)
 
     def on_admit(self, queue_times_s):
         """Queue-time samples for slot-pool admission (decode server —
         no per-batch dispatch event to hang them on)."""
         with self._lock:
             self._queue_s.extend(queue_times_s)
+        self._observe('queue', queue_times_s)
 
     def on_step(self, n_active, n_rows=None):
         """One continuous-batching decode step: ``n_active`` live
@@ -106,14 +117,16 @@ class ServingMetrics:
         """Time-to-first-token: submit → the prompt's first generated
         token (the tail of the last prefill chunk)."""
         with self._lock:
-            self._ttft_s.append(ttft_s)
+            self._ttft_s.add(ttft_s)
+        self._observe('ttft', (ttft_s,))
 
     def on_token_gap(self, gap_s):
         """Inter-token gap for one live sequence — the latency a
         streaming client perceives between tokens; chunked prefill
         exists to bound its tail while long prompts load."""
         with self._lock:
-            self._intertok_s.append(gap_s)
+            self._intertok_s.add(gap_s)
+        self._observe('intertok', (gap_s,))
 
     def on_prefill_chunk(self, n=1):
         with self._lock:
@@ -140,7 +153,8 @@ class ServingMetrics:
     def on_complete(self, latency_s):
         with self._lock:
             self._completed += 1
-            self._latency_s.append(latency_s)
+            self._latency_s.add(latency_s)
+        self._observe('latency', (latency_s,))
 
     def on_failed(self):
         with self._lock:
@@ -152,15 +166,70 @@ class ServingMetrics:
         with self._lock:
             self._recompiles += n
 
+    # --------------------------------------------------- registry binding
+    def _observe(self, which, values):
+        """Feed registry histograms (fleet-mergeable duplicates of the
+        reservoir samples). Outside ``self._lock``: the histogram's own
+        lock (``telemetry.metrics``) is all it takes."""
+        h = self._hist
+        if h is not None:
+            hist = h[which]
+            for v in values:
+                hist.observe(v)
+
+    def _bind(self, reg_name):
+        """Install registry instruments under the deduplicated public
+        name (called by :func:`register`): four latency histograms plus
+        a collector exporting the counters/gauges."""
+        labels = {'server': reg_name}
+        self._hist = {
+            'latency': _tmetrics.histogram('mx_serve_latency_seconds',
+                                           **labels),
+            'queue': _tmetrics.histogram('mx_serve_queue_seconds',
+                                         **labels),
+            'ttft': _tmetrics.histogram('mx_serve_ttft_seconds',
+                                        **labels),
+            'intertok': _tmetrics.histogram(
+                'mx_serve_intertoken_seconds', **labels),
+        }
+        self._collector_key = _tmetrics.register_collector(
+            f'serving:{reg_name}', lambda: self._collect(labels))
+
+    def _unbind(self):
+        if self._collector_key is not None:
+            _tmetrics.unregister_collector(self._collector_key)
+            self._collector_key = None
+        self._hist = None
+
+    def _collect(self, labels):
+        with self._lock:
+            counters = {
+                'mx_serve_requests_total': self._requests,
+                'mx_serve_completed_total': self._completed,
+                'mx_serve_failed_total': self._failed,
+                'mx_serve_shed_total': self._shed,
+                'mx_serve_expired_total': self._expired,
+                'mx_serve_batches_total': self._batches,
+                'mx_serve_steps_total': self._steps,
+                'mx_serve_recompiles_total': self._recompiles,
+                'mx_serve_prefill_chunks_total': self._prefill_chunks,
+                'mx_serve_prefix_hit_total': self._prefix_hit,
+                'mx_serve_prefix_miss_total': self._prefix_miss,
+            }
+            in_use = self._pages_in_use
+        for name, v in counters.items():
+            yield ('counter', name, labels, v)
+        yield ('gauge', 'mx_serve_pages_in_use', labels, in_use)
+
     # ---------------------------------------------------------- snapshot
     def snapshot(self):
         """Point-in-time stats dict (the ``serve.stats()`` payload and
         the profiler Serving section's data source)."""
         with self._lock:
-            lat = list(self._latency_s)
-            qt = list(self._queue_s)
-            ttft = list(self._ttft_s)
-            gaps = list(self._intertok_s)
+            lat = self._latency_s.samples()
+            qt = self._queue_s.samples()
+            ttft = self._ttft_s.samples()
+            gaps = self._intertok_s.samples()
             batches = self._batches
             rows = self._batched_rows
             steps = self._steps
@@ -222,13 +291,16 @@ def register(name, metrics):
             n += 1
             name = f'{base}#{n}'
         _REGISTRY[name] = metrics
+    metrics._bind(name)
     profiler.attach_serving(name, metrics.snapshot)
     return name
 
 
 def unregister(name):
     with _REGISTRY_LOCK:
-        _REGISTRY.pop(name, None)
+        metrics = _REGISTRY.pop(name, None)
+    if metrics is not None:
+        metrics._unbind()
     profiler.detach_serving(name)
 
 

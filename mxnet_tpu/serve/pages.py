@@ -36,6 +36,7 @@ import os
 import threading
 
 from ..analysis import race as _race
+from ..telemetry import metrics as _tmetrics
 from .errors import PagesExhausted
 
 __all__ = ['PageAllocator', 'PagesExhausted', 'chain_key', 'EMPTY_KEY',
@@ -124,6 +125,27 @@ class PageAllocator:
         self._tick = 0                  # LRU clock
         self._evictions = 0
         self._metrics = metrics
+        self._name = str(name)
+        self._collector_key = _tmetrics.register_collector(
+            f'pages:{self._name}', self._collect)
+
+    def _collect(self):
+        """Registry collector: pool occupancy + prefix-cache churn as
+        Prometheus samples (the ``stats()`` dict stays the local
+        view)."""
+        s = self.stats()
+        labels = {'pool': self._name}
+        yield ('gauge', 'mx_pages_in_use', labels, s['pages_in_use'])
+        yield ('gauge', 'mx_pages_free', labels, s['pages_free'])
+        yield ('gauge', 'mx_prefix_entries', labels,
+               s['prefix_entries'])
+        yield ('counter', 'mx_page_evictions_total', labels,
+               s['page_evictions'])
+
+    def detach(self):
+        """Unhook this allocator from the metrics registry (owner
+        close path); idempotent."""
+        _tmetrics.unregister_collector(self._collector_key)
 
     # ------------------------------------------------------------- sizing
     @property

@@ -33,10 +33,13 @@ neither is ever held across a model call or a socket write.
 """
 
 import threading
+import time
 from concurrent.futures import TimeoutError as _FutTimeout
 
 from ..analysis import race as _race
 from ..kvstore.rpc import RpcServer
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _trace
 from . import faults as _faults
 from .decode import DecodeServer
 from .errors import ServeError
@@ -136,8 +139,21 @@ class Replica:
         self._ds = self._make_server(version)
         self._rpc = _ReplicaServer(self, port, bind_host=host)
         self._port = self._rpc.port     # stable across restart()
+        self._collector_key = _tmetrics.register_collector(
+            f'replica:{self.name}', self._collect)
         if start:
             self._rpc.start()
+
+    def _collect(self):
+        """Registry collector: endpoint apply/swap/dedup counters
+        (counters are object-shared across restart(), so totals
+        survive chaos cycles exactly like the dedup window does)."""
+        srv = self._rpc
+        with srv._lock:
+            counters = dict(srv._counters)
+        labels = {'replica': self.name}
+        for k, v in counters.items():
+            yield ('counter', f'mx_replica_{k}_total', labels, v)
 
     def _make_server(self, version):
         net = self._factory(version)
@@ -169,6 +185,13 @@ class Replica:
         """Apply one generate request on the current version; returns
         ``(tokens, version)``. Blocking — runs on the per-connection
         handler thread, never on the scheduler."""
+        # child-only span: traced requests (a ``tc`` on the envelope)
+        # show the admission leg; untraced traffic never roots a trace
+        with _trace.child_span('replica.submit', replica=self.name):
+            return self._apply_submit(prompt, max_new, deadline_ms,
+                                      timeout_s)
+
+    def _apply_submit(self, prompt, max_new, deadline_ms, timeout_s):
         from .errors import ServerClosed
         with self._lock:
             ds, version = self._ds, self._version
@@ -249,7 +272,20 @@ class Replica:
         (in-memory analog of the persisted dedup log that makes
         exactly-once survive a real restart)."""
         old = self._rpc
-        new = _ReplicaServer(self, self._port, bind_host=self._host)
+        old.release_port()              # drop the post-crash port hold
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                new = _ReplicaServer(self, self._port,
+                                     bind_host=self._host)
+                break
+            except OSError:
+                # the freed port can transiently be in use (a stray
+                # connection grabbed it as its source port before the
+                # crash hold landed, or TIME_WAIT remnants)
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
         new._dedup = old._dedup
         new._dedup_order = old._dedup_order
         new._counters = old._counters
@@ -271,6 +307,7 @@ class Replica:
                 'server': ds.stats()}
 
     def close(self, drain=True):
+        _tmetrics.unregister_collector(self._collector_key)
         self._rpc.stop()
         self.server.close(drain=drain)
 

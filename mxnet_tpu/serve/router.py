@@ -41,6 +41,7 @@ happens outside it. ``clock`` is injectable so ejection deadlines are
 driven by fake clocks in tests, not wall-time sleeps.
 """
 
+import itertools
 import os
 import threading
 import time
@@ -49,6 +50,8 @@ from concurrent.futures import Future
 from ..analysis import race as _race
 from ..kvstore.dist_async import _kv_deadline_s
 from ..kvstore.rpc import RpcClient
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _trace
 from .errors import (DeadlineExceeded, NoHealthyReplicas, PagesExhausted,
                      ServeError, ServerClosed, ServerOverloaded)
 
@@ -61,6 +64,9 @@ _KINDS = {c.__name__: c for c in
            DeadlineExceeded, ServerClosed)}
 
 _POOL_MAX = 4       # idle channels kept per replica
+
+
+_CLIENT_IDS = itertools.count()
 
 
 def _hedge_s(override_ms=None):
@@ -114,8 +120,12 @@ class Router:
             raise ValueError('Router needs at least one replica')
         self._clock = clock
         self._rank = int(rank)
+        # process-unique, never recycled: id(self) is NOT usable here —
+        # CPython reuses a freed router's address, and a same-id
+        # successor would hit the replicas' (client, seq) dedup windows
+        # and be served the predecessor's cached replies
         self._client = client if client is not None \
-            else f'router-{os.getpid()}-{id(self):x}'
+            else f'router-{os.getpid()}-{next(_CLIENT_IDS)}'
         self._deadline = float(_kv_deadline_s()
                                if deadline_s is None else deadline_s)
         self._hedge = _hedge_s(hedge_ms)
@@ -136,6 +146,8 @@ class Router:
         self._transport_stats = {'retries': 0, 'redials': 0,
                                  'giveups': 0}
         self._closed = False
+        self._collector_key = _tmetrics.register_collector(
+            f'router:{self._client}', self._collect)
         self._hb_stop = threading.Event()
         self._hb_thread = None
         if start:
@@ -175,6 +187,7 @@ class Router:
         for st in self._states():
             chan = self._borrow(st)
             reply = None
+            ws = _trace.walltime()
             try:
                 # attempts=2: a pooled channel whose socket died with
                 # the replica must get one redial before the ping
@@ -187,6 +200,12 @@ class Router:
                 chan = None
             if chan is not None:
                 self._return(st, chan)
+            if reply is not None and 'ts' in reply:
+                # heartbeats double as clock-sync probes: the reply's
+                # wall timestamp between our send/recv times yields the
+                # peer's clock offset for trace-export normalization
+                _trace.note_clock(reply.get('proc', st.name),
+                                  reply['ts'], ws, _trace.walltime())
             now = self._clock()
             with self._lock:
                 if reply is not None:
@@ -235,7 +254,18 @@ class Router:
         The ``(client, seq)`` identity is allocated once and reused
         verbatim across every retry, hedge and failover attempt — that
         is what makes the replicas' dedup windows see retried work as
-        the same request."""
+        the same request.
+
+        The whole request is one ``router.request`` trace span (the
+        trace root unless the caller already has one); each attempt —
+        hedged, failed-over or final — is a child ``router.attempt``
+        span whose ``error`` attr captures why a leg failed, so a
+        chaos request reads as one connected story in the flight
+        recorder."""
+        with _trace.span('router.request', client=self._client):
+            return self._generate(prompt, max_new_tokens, deadline_ms)
+
+    def _generate(self, prompt, max_new_tokens, deadline_ms):
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -273,15 +303,18 @@ class Router:
             chan = self._borrow(st)
             hedged = hedging and not tried
             try:
-                if hedged:
-                    # first attempt on a short leash: a slow replica
-                    # costs hedge_ms, then the SAME identity fails
-                    # over — the dedup window absorbs any late apply
-                    reply, _ = chan.call(header, attempts=1,
-                                         deadline_s=self._hedge)
-                else:
-                    reply, _ = chan.call(
-                        header, deadline_s=self._rpc_deadline)
+                with _trace.span('router.attempt', replica=st.name,
+                                 hedged=bool(hedged)):
+                    if hedged:
+                        # first attempt on a short leash: a slow
+                        # replica costs hedge_ms, then the SAME
+                        # identity fails over — the dedup window
+                        # absorbs any late apply
+                        reply, _ = chan.call(header, attempts=1,
+                                             deadline_s=self._hedge)
+                    else:
+                        reply, _ = chan.call(
+                            header, deadline_s=self._rpc_deadline)
             except ConnectionError as e:
                 chan.close()
                 last_exc = e
@@ -361,6 +394,66 @@ class Router:
                                 if k != 'ok'}
         return results
 
+    # --------------------------------------------------------- telemetry
+    def _collect(self):
+        """Registry collector: the router's counters + routing-table
+        gauges as Prometheus samples (runs at scrape time, outside the
+        registry lock)."""
+        with self._lock:
+            counters = dict(self._counters)
+            transport = dict(self._transport_stats)
+            total = len(self._replicas)
+            healthy = sum(1 for st in self._replicas.values()
+                          if st.healthy)
+        labels = {'router': self._client}
+        for k, v in counters.items():
+            yield ('counter', f'mx_router_{k}_total', labels, v)
+        for k, v in transport.items():
+            yield ('counter', f'mx_router_transport_{k}_total', labels,
+                   v)
+        yield ('gauge', 'mx_router_replicas', labels, total)
+        yield ('gauge', 'mx_router_healthy_replicas', labels, healthy)
+
+    def _fleet_sweep(self, cmd, field):
+        """Ask every healthy replica for a telemetry payload (the RPC
+        ``metrics``/``telemetry`` verbs); unreachable replicas are
+        skipped — aggregation is best-effort by design."""
+        out = []
+        for st in self._states():
+            if not st.healthy:
+                continue
+            chan = self._borrow(st)
+            try:
+                reply, _ = chan.call(
+                    {'cmd': cmd, 'rank': self._rank}, attempts=2,
+                    deadline_s=max(1.0, self._ping_timeout * 4))
+            except (ConnectionError, RuntimeError, OSError):
+                chan.close()
+                continue
+            self._return(st, chan)
+            if reply.get(field):
+                out.append(reply[field])
+        return out
+
+    def fleet_metrics(self):
+        """One merged metrics snapshot for the whole fleet: the local
+        registry plus every healthy replica's, deduplicated by registry
+        id (in-process replicas share this process's registry and must
+        not be counted twice). Feed to
+        :func:`mx.telemetry.render_prometheus`."""
+        snaps = [_tmetrics.default_registry().snapshot()]
+        snaps.extend(self._fleet_sweep('metrics', 'metrics'))
+        return _tmetrics.merge_snapshots(snaps)
+
+    def fleet_telemetry(self):
+        """Flight-recorder buffers from this process and every healthy
+        replica (recorder-deduplicated downstream). Feed to
+        :func:`mx.telemetry.export_chrome_trace` /
+        :func:`mx.telemetry.merge_buffers` for one cross-process
+        timeline."""
+        return [_trace.snapshot_buffer()] \
+            + self._fleet_sweep('telemetry', 'telemetry')
+
     # ------------------------------------------------------------- admin
     def health(self):
         """Snapshot of the routing table: name -> liveness + load."""
@@ -386,6 +479,7 @@ class Router:
         return out
 
     def close(self):
+        _tmetrics.unregister_collector(self._collector_key)
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)

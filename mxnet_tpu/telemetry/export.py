@@ -1,0 +1,190 @@
+"""Trace export: multi-process buffer merge, span trees, Chrome trace.
+
+The flight recorder (``trace.py``) is per-process; a distributed
+request leaves spans in every process it touched. This module merges
+buffer snapshots (the local one plus any collected over the RPC
+``telemetry`` verb — see ``Router.fleet_telemetry()``) into one event
+list on one clock:
+
+* **dedup** by ``(recorder id, seq)`` — in-process replica clusters
+  return the SAME buffer from every endpoint, and a fleet sweep must
+  count each recorder once;
+* **clock normalization** — events from a remote process are shifted
+  by the offset measured off RPC ping timestamps
+  (``trace.note_clock``), so spans line up across machines to within
+  half a ping RTT.
+
+Outputs: :func:`trace_tree` (parent-edge resolution for tests and the
+``tools/trace_dump.py`` pretty printer), :func:`chrome_doc` /
+:func:`export_chrome_trace` (the ``chrome://tracing`` / Perfetto JSON
+format the reference profiler also targets), and :func:`dump_json`
+(the raw merged buffer ``trace_dump`` reads back).
+"""
+
+import json
+
+from . import trace as _trace
+
+__all__ = ['merge_buffers', 'trace_ids', 'trace_tree', 'format_tree',
+           'chrome_doc', 'export_chrome_trace', 'dump_json']
+
+
+def merge_buffers(buffers, offsets=None):
+    """Merge buffer snapshots into one time-sorted event list.
+    ``buffers`` are :func:`trace.snapshot_buffer` dicts; ``offsets``
+    maps proc name -> seconds its clock runs ahead of ours (default:
+    the offsets measured off ping replies)."""
+    if offsets is None:
+        offsets = _trace.clock_offsets()
+    local = _trace.proc_name()
+    seen = set()
+    out = []
+    for buf in buffers:
+        if not buf:
+            continue
+        rid = buf.get('recorder') or buf.get('proc')
+        proc = buf.get('proc')
+        off = 0.0 if proc == local else float(offsets.get(proc, 0.0))
+        for rec in buf.get('events', ()):
+            if rec is None:
+                continue
+            key = (rid, rec.get('seq'))
+            if key in seen:
+                continue
+            seen.add(key)
+            if off:
+                rec = dict(rec)
+                rec['t0'] -= off
+                rec['t1'] -= off
+            out.append(rec)
+    out.sort(key=lambda r: (r.get('t0', 0.0), r.get('seq', 0)))
+    return out
+
+
+def trace_ids(events):
+    """Trace ids present, most recent root first (roots are spans with
+    no parent); traces whose root was overwritten in the ring come
+    last, in first-seen order."""
+    roots = []
+    rest = []
+    seen = set()
+    for rec in events:
+        tid = rec.get('trace')
+        if tid in seen:
+            continue
+        if rec.get('parent') is None:
+            seen.add(tid)
+            roots.append(tid)
+        else:
+            rest.append(tid)
+    roots.reverse()
+    for tid in rest:
+        if tid not in seen:
+            seen.add(tid)
+            roots.append(tid)
+    return roots
+
+
+def trace_tree(events, trace_id):
+    """Build the span tree of one trace: returns a list of root nodes
+    ``{'rec': record, 'children': [...]}``, children sorted by start
+    time. Spans whose parent is missing from the event set (ring
+    overwrite, uncollected process) surface as extra roots — a fully
+    connected trace has exactly one."""
+    spans = [r for r in events if r.get('trace') == trace_id]
+    nodes = {r['span']: {'rec': r, 'children': []} for r in spans}
+    roots = []
+    for r in spans:
+        parent = r.get('parent')
+        if parent is not None and parent in nodes:
+            nodes[parent]['children'].append(nodes[r['span']])
+        else:
+            roots.append(nodes[r['span']])
+    for node in nodes.values():
+        node['children'].sort(key=lambda n: n['rec'].get('t0', 0.0))
+    roots.sort(key=lambda n: n['rec'].get('t0', 0.0))
+    return roots
+
+
+def format_tree(events, trace_id):
+    """Human-readable span tree of one trace (the trace_dump CLI)."""
+    roots = trace_tree(events, trace_id)
+    if not roots:
+        return f'trace {trace_id}: no spans'
+    t_base = roots[0]['rec'].get('t0', 0.0)
+    lines = [f'trace {trace_id} '
+             f'({sum(1 for e in events if e.get("trace") == trace_id)} '
+             f'spans)']
+
+    def _walk(node, depth):
+        r = node['rec']
+        dur_ms = (r.get('t1', 0.0) - r.get('t0', 0.0)) * 1e3
+        at_ms = (r.get('t0', 0.0) - t_base) * 1e3
+        attrs = r.get('attrs') or {}
+        extra = ' '.join(f'{k}={v}' for k, v in sorted(attrs.items()))
+        lines.append(
+            f'  {"  " * depth}{r.get("name", "?"):<28} '
+            f'+{at_ms:9.3f}ms {dur_ms:9.3f}ms  '
+            f'[{r.get("proc", "?")}/{r.get("thread", "?")}]'
+            + (f'  {extra}' if extra else ''))
+        for child in node['children']:
+            _walk(child, depth + 1)
+
+    for root in roots:
+        _walk(root, 0)
+    return '\n'.join(lines)
+
+
+def chrome_doc(events):
+    """Chrome-trace JSON document ('X' complete events, µs timestamps,
+    process/thread metadata) from a merged event list."""
+    pids, tids = {}, {}
+    trace_events = []
+    for rec in events:
+        proc = rec.get('proc', '?')
+        thread = rec.get('thread', '?')
+        pid = pids.setdefault(proc, len(pids) + 1)
+        tid = tids.setdefault((proc, thread), len(tids) + 1)
+        args = {'trace': rec.get('trace'), 'span': rec.get('span')}
+        if rec.get('parent') is not None:
+            args['parent'] = rec['parent']
+        args.update(rec.get('attrs') or {})
+        trace_events.append({
+            'name': rec.get('name', '?'), 'ph': 'X', 'cat': 'telemetry',
+            'ts': rec.get('t0', 0.0) * 1e6,
+            'dur': max(0.0, (rec.get('t1', 0.0) - rec.get('t0', 0.0))
+                       * 1e6),
+            'pid': pid, 'tid': tid, 'args': args})
+    for proc, pid in pids.items():
+        trace_events.append({'name': 'process_name', 'ph': 'M',
+                             'pid': pid, 'tid': 0,
+                             'args': {'name': proc}})
+    for (proc, thread), tid in tids.items():
+        trace_events.append({'name': 'thread_name', 'ph': 'M',
+                             'pid': pids[proc], 'tid': tid,
+                             'args': {'name': thread}})
+    return {'traceEvents': trace_events, 'displayTimeUnit': 'ms'}
+
+
+def export_chrome_trace(path, extra_buffers=()):
+    """Write this process's flight recorder (merged with any extra
+    buffer snapshots — e.g. ``Router.fleet_telemetry()``) as a Chrome
+    trace; open in ``chrome://tracing`` or https://ui.perfetto.dev.
+    Returns ``path``."""
+    buffers = [_trace.snapshot_buffer()] + list(extra_buffers)
+    events = merge_buffers(buffers)
+    with open(path, 'w') as f:
+        json.dump(chrome_doc(events), f)
+    return path
+
+
+def dump_json(path, extra_buffers=()):
+    """Write the raw merged buffers (events + clock offsets) as JSON —
+    the ``tools/trace_dump.py`` input format. Returns ``path``."""
+    buffers = [_trace.snapshot_buffer()] + list(extra_buffers)
+    doc = {'proc': _trace.proc_name(),
+           'clock_offsets': _trace.clock_offsets(),
+           'events': merge_buffers(buffers)}
+    with open(path, 'w') as f:
+        json.dump(doc, f)
+    return path

@@ -45,6 +45,9 @@ import numpy as _np
 
 from .. import _rng
 from .. import profiler as _profiler
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _trace
+from ..telemetry.metrics import Reservoir
 
 
 class ElasticHalted(RuntimeError):
@@ -64,11 +67,13 @@ class _CheckpointDaemon(threading.Thread):
     counted ``coalesced``, matching CheckFreq's bounded-lag contract:
     at most one checkpoint behind, never a growing queue)."""
 
-    def __init__(self, manager, stats, stats_lock, name='ckpt-daemon'):
+    def __init__(self, manager, stats, stats_lock, name='ckpt-daemon',
+                 observe=None):
         super().__init__(daemon=True, name=name)
         self._manager = manager
         self._stats = stats
         self._stats_lock = stats_lock
+        self._observe = observe     # serialize-time sink (histogram)
         self._cv = threading.Condition()
         self._pending = None        # (step, tree) | None
         self._busy = False
@@ -136,7 +141,9 @@ class _CheckpointDaemon(threading.Thread):
                 else:
                     self._stats['errors'] += 1
                     self._stats['last_error'] = repr(err)
-                self._stats['serialize_ms'].append(dt_ms)
+                self._stats['serialize_ms'].add(dt_ms)
+            if self._observe is not None:
+                self._observe(dt_ms)
             with self._cv:
                 self._busy = False
                 self._cv.notify_all()
@@ -175,18 +182,39 @@ class ElasticTrainer:
         self._every_s = float(every_s)
         self._last_accept = None      # clock time of last accepted save
         self._stats_lock = threading.Lock()
+        # bounded reservoirs, not unbounded lists: a long-running
+        # trainer accumulated one float per save forever; the reservoir
+        # keeps exact count/sum/min/max plus a uniform sample
         self._stats = {'saves': 0, 'async_saves': 0, 'coalesced': 0,
                        'throttled': 0, 'errors': 0, 'last_step': -1,
                        'last_error': None,
-                       'blocked_ms': [], 'serialize_ms': []}
+                       'blocked_ms': Reservoir(512),
+                       'serialize_ms': Reservoir(512)}
+        self._h_blocked = _tmetrics.histogram('mx_ckpt_blocked_ms',
+                                              trainer=name)
+        self._h_serialize = _tmetrics.histogram('mx_ckpt_serialize_ms',
+                                                trainer=name)
+        self._collector_key = _tmetrics.register_collector(
+            f'elastic:{name}', self._collect)
         self._daemon = None
         if self._async:
             self._daemon = _CheckpointDaemon(
                 manager, self._stats, self._stats_lock,
-                name=f'ckpt-{name}')
+                name=f'ckpt-{name}', observe=self._h_serialize.observe)
             self._daemon.start()
         self._closed = False
         _profiler.attach_checkpoint(name, self.stats)
+
+    def _collect(self):
+        """Registry collector: checkpoint counters as Prometheus
+        samples (the ``stats()`` dict stays the local view)."""
+        with self._stats_lock:
+            counters = {k: self._stats[k] for k in
+                        ('saves', 'async_saves', 'coalesced',
+                         'throttled', 'errors')}
+        labels = {'trainer': self._name}
+        for k, v in counters.items():
+            yield ('counter', f'mx_ckpt_{k}_total', labels, v)
 
     # ---------------------------------------------------------- snapshot
     def snapshot(self, step):
@@ -223,6 +251,14 @@ class ElasticTrainer:
             with self._stats_lock:
                 self._stats['throttled'] += 1
             return False
+        # the step loop's checkpoint-blocked time as a span: inside a
+        # caller's train-step trace it shows exactly where checkpoint
+        # cost lands; standalone it roots a small ckpt trace
+        with _trace.span('ckpt.save', trainer=self._name,
+                         step=int(step), sync=self._daemon is None):
+            return self._save(step, block)
+
+    def _save(self, step, block):
         t0 = time.perf_counter()
         tree = self.snapshot(step)
         if self._daemon is not None:
@@ -244,11 +280,13 @@ class ElasticTrainer:
                 else:
                     self._stats['errors'] += 1
                     self._stats['last_error'] = repr(err)
-                self._stats['serialize_ms'].append(blocked_ms)
+                self._stats['serialize_ms'].add(blocked_ms)
+            self._h_serialize.observe(blocked_ms)
             if err is not None:
                 raise err
         with self._stats_lock:
-            self._stats['blocked_ms'].append(blocked_ms)
+            self._stats['blocked_ms'].add(blocked_ms)
+        self._h_blocked.observe(blocked_ms)
         self._last_accept = self._clock()
         return True
 
@@ -296,18 +334,21 @@ class ElasticTrainer:
         """Snapshot for tests and the profiler's Checkpoint section."""
         with self._stats_lock:
             s = dict(self._stats)
-            blocked = list(s.pop('blocked_ms'))
-            ser = list(s.pop('serialize_ms'))
-        s['blocked_ms_avg'] = sum(blocked) / len(blocked) if blocked else 0.0
-        s['blocked_ms_max'] = max(blocked) if blocked else 0.0
-        s['serialize_ms_avg'] = sum(ser) / len(ser) if ser else 0.0
-        s['serialize_ms_max'] = max(ser) if ser else 0.0
+            blocked = s.pop('blocked_ms')
+            ser = s.pop('serialize_ms')
+            # reservoir running aggregates are EXACT over the whole
+            # run (only the sample set is bounded)
+            s['blocked_ms_avg'] = blocked.mean
+            s['blocked_ms_max'] = blocked.max if len(blocked) else 0.0
+            s['serialize_ms_avg'] = ser.mean
+            s['serialize_ms_max'] = ser.max if len(ser) else 0.0
         return s
 
     def close(self, timeout=30.0):
         if self._closed:
             return
         self._closed = True
+        _tmetrics.unregister_collector(self._collector_key)
         _profiler.detach_checkpoint(self._name)
         if self._daemon is not None:
             self._daemon.close(timeout=timeout)

@@ -17,17 +17,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # The ISSUE-named threaded suites: bulked-eager cross-thread settles,
 # thread-safe hybridized inference, the fault-injected dist_async
 # transport (PR 4 harness supplies deterministic scheduling pressure),
-# and the replicated serving tier (router/replica locks + the RPC
-# endpoint's handler threads, ISSUE 12).
+# the replicated serving tier (router/replica locks + the RPC
+# endpoint's handler threads, ISSUE 12), and the traced chaos request
+# (ISSUE 16: telemetry's recorder/metrics locks recording from every
+# runtime thread while the fleet sweep reads them back).
 SUITES = ('test_bulk.py', 'test_threadsafe_inference.py',
-          'test_kvstore_faults.py', 'test_serve_router.py')
+          'test_kvstore_faults.py', 'test_serve_router.py',
+          'test_telemetry.py::'
+          'test_traced_chaos_request_single_connected_trace')
 
 
 @pytest.mark.parametrize('suite', SUITES)
 def test_suite_clean_under_race_check(suite):
     env = dict(os.environ)
     env['MXNET_RACE_CHECK'] = '1'
-    env.setdefault('JAX_PLATFORMS', 'cpu')
+    env['JAX_PLATFORMS'] = 'cpu'  # conftest leaves it '' in-proc; '' defeats setdefault
     r = subprocess.run(
         [sys.executable, '-m', 'pytest', '-q', '-x',
          '-p', 'no:cacheprovider',
@@ -75,7 +79,7 @@ print('PLANTED-RACES-DETECTED')
 '''
     env = dict(os.environ)
     env['MXNET_RACE_CHECK'] = '1'
-    env.setdefault('JAX_PLATFORMS', 'cpu')
+    env['JAX_PLATFORMS'] = 'cpu'  # conftest leaves it '' in-proc; '' defeats setdefault
     r = subprocess.run([sys.executable, '-c', code], capture_output=True,
                        text=True, timeout=240, cwd=REPO, env=env)
     assert r.returncode == 0, r.stdout + r.stderr

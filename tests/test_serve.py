@@ -334,7 +334,7 @@ def test_profiler_serving_section_and_stats():
 def test_serve_bench_smoke():
     out = os.path.join('/tmp', f'serve_bench_smoke_{os.getpid()}.json')
     env = dict(os.environ)
-    env.setdefault('JAX_PLATFORMS', 'cpu')
+    env['JAX_PLATFORMS'] = 'cpu'  # conftest leaves it '' in-proc; '' defeats setdefault
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, 'tools', 'serve_bench.py'),
          '--smoke', '--out', out],
@@ -359,7 +359,7 @@ def test_serve_bench_replicated_smoke():
     import json
     out = os.path.join('/tmp', f'serve_bench_repl_{os.getpid()}.json')
     env = dict(os.environ)
-    env.setdefault('JAX_PLATFORMS', 'cpu')
+    env['JAX_PLATFORMS'] = 'cpu'  # conftest leaves it '' in-proc; '' defeats setdefault
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, 'tools', 'serve_bench.py'),
          '--smoke', '--replicas', '2', '--out', out],
@@ -386,7 +386,7 @@ def test_threaded_serve_clean_under_race_check():
         pytest.skip('already running under the race checker')
     env = dict(os.environ)
     env['MXNET_RACE_CHECK'] = '1'
-    env.setdefault('JAX_PLATFORMS', 'cpu')
+    env['JAX_PLATFORMS'] = 'cpu'  # conftest leaves it '' in-proc; '' defeats setdefault
     r = subprocess.run(
         [sys.executable, '-m', 'pytest', '-q', '-x',
          '-p', 'no:cacheprovider',

@@ -266,3 +266,23 @@ def test_hot_swap_under_load_zero_drops_zero_recompiles(replicas):
             assert rep.stats()['server']['compile_count'] == baseline
         r.heartbeat_once()                       # refresh piggyback info
         assert all(v['version'] == 'v2' for v in r.health().values())
+
+
+def test_router_client_ids_never_recycled(replicas):
+    """Sequentially created routers must never share a client id:
+    CPython reuses a freed object's address, so an id(self)-derived id
+    would let a successor router hit the replicas' (client, seq) dedup
+    windows and be served its predecessor's cached replies (the
+    replicated bench's chaos phase hit exactly this)."""
+    seen, answers = set(), set()
+    for _ in range(5):
+        r = Router(replicas, start=False)
+        assert r._client not in seen, 'client id recycled'
+        seen.add(r._client)
+        # same (prompt, seq=1) identity each time: with recycled ids
+        # the dedup window would replay instead of re-applying
+        answers.add(tuple(r.generate([5, 6], max_new_tokens=3)))
+        r.close()
+    applied = sum(rep.stats()['counters']['applied'] for rep in replicas)
+    assert applied >= 5, f'dedup replay swallowed submits: {applied}'
+    assert len(answers) == 1          # same model, genuinely recomputed

@@ -211,3 +211,50 @@ def test_bench_predicted_train_costs_match_analytical():
     assert abs(d['predicted_flops'] - want) / want < 0.10, d
     assert d['predicted_peak_hbm_bytes'] > 0
     assert 0 < d['predicted_mfu_bound'] <= 1.0
+
+
+# ------------------------------------------------ trace_dump (telemetry)
+def test_trace_dump_smoke_cli():
+    """tools/trace_dump.py --smoke generates a demo trace, renders the
+    span tree and self-checks connectivity — all WITHOUT importing jax
+    (the tool loads mx.telemetry standalone by file path)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'trace_dump.py'),
+         '--smoke'],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'SMOKE OK' in proc.stdout
+    assert 'smoke.request' in proc.stdout
+
+
+def test_trace_dump_reads_dump_json_and_converts(tmp_path):
+    from mxnet_tpu import telemetry
+
+    telemetry.configure(enabled=True, sample=1.0)
+    telemetry.clear()
+    with telemetry.span('cli.root', who='test_tools'):
+        with telemetry.span('cli.leg'):
+            pass
+    dump = str(tmp_path / 'run.trace.json')
+    telemetry.dump_json(dump)
+    telemetry.clear()
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'trace_dump.py'),
+         dump],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'cli.root' in proc.stdout and 'cli.leg' in proc.stdout
+
+    out = str(tmp_path / 'chrome.json')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'trace_dump.py'),
+         dump, '--chrome', out],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+    with open(out) as f:
+        doc = json.load(f)
+    names = {e['name'] for e in doc['traceEvents']
+             if e.get('ph') == 'X'}
+    assert names == {'cli.root', 'cli.leg'}

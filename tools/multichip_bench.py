@@ -192,7 +192,7 @@ def bench_decode(args):
     import random
 
     import mxnet_tpu as mx
-    from mxnet_tpu import sharding
+    from mxnet_tpu import sharding, telemetry
     from mxnet_tpu.serve import DecodeServer
     from mxnet_tpu.gluon.model_zoo.llama import llama_tiny
 
@@ -219,12 +219,16 @@ def bench_decode(args):
         rnd = random.Random(0)
         futs = []
         start = time.perf_counter()
-        for _ in range(args.prompts):
+        for i in range(args.prompts):
             plen = rnd.randint(2, args.max_prompt)
             prompt = [rnd.randrange(net.cfg.vocab_size)
                       for _ in range(plen)]
-            futs.append(server.submit(prompt,
-                                      max_new_tokens=args.new_tokens))
+            # root one trace per request so the sharded server's
+            # queue/prefill/decode-step spans land in the artifact
+            with telemetry.span('bench.request', i=i,
+                                prompt_len=len(prompt)):
+                futs.append(server.submit(
+                    prompt, max_new_tokens=args.new_tokens))
         toks = sum(len(f.result(300)) for f in futs)
         wall = time.perf_counter() - start
         stats = server.stats()
@@ -332,6 +336,10 @@ def run_bench(smoke=False, out=None):
         with open(out, 'w') as f:
             json.dump(doc, f, indent=1)
             f.write('\n')
+        from mxnet_tpu import telemetry
+        if telemetry.enabled():
+            doc['trace'] = telemetry.export_chrome_trace(
+                out + '.trace.json')
     return doc, (0 if doc['ok'] else 1)
 
 

@@ -119,7 +119,7 @@ def bench_resnet(args):
 
 def bench_llama(args):
     import mxnet_tpu as mx
-    from mxnet_tpu import serve
+    from mxnet_tpu import serve, telemetry
     from mxnet_tpu.gluon.model_zoo.llama import llama_tiny
 
     net = llama_tiny()
@@ -150,12 +150,19 @@ def bench_llama(args):
         prompt = [rnd.randrange(net.cfg.vocab_size) for _ in range(plen)]
         if i % 2:
             prompt = (sys_prompt + prompt)[:args.max_prompt]
-        futs.append(server.submit(prompt,
-                                  max_new_tokens=args.new_tokens))
+        # root one trace per request: the captured context parents the
+        # server's queue/prefill/decode-step spans in the artifact
+        with telemetry.span('bench.request', i=i, prompt_len=len(prompt)):
+            futs.append(server.submit(prompt,
+                                      max_new_tokens=args.new_tokens))
     toks = sum(len(f.result(300)) for f in futs)
     wall = time.perf_counter() - start
     stats = server.stats()
     server.close()
+    trace_path = None
+    if telemetry.enabled():
+        trace_path = telemetry.export_chrome_trace(
+            args.out + '.trace.json')
     doc = {
         'metric': f'llama_tiny_paged_decode_slots{args.slots}',
         'value': round(toks / wall, 2),
@@ -165,6 +172,7 @@ def bench_llama(args):
         'new_tokens_each': args.new_tokens,
         'warmup_s': round(warm_s, 2),
         'wall_s': round(wall, 2),
+        'trace': trace_path,
     }
     doc.update(_percentile_trim(stats))
     return doc
@@ -175,7 +183,7 @@ def bench_replicated(args):
     import threading
 
     import mxnet_tpu as mx
-    from mxnet_tpu import profiler, serve
+    from mxnet_tpu import profiler, serve, telemetry
     from mxnet_tpu.serve import faults as sfaults
     from mxnet_tpu.gluon.model_zoo.llama import llama_tiny
 
@@ -262,6 +270,7 @@ def bench_replicated(args):
     kill_at = max(2, (args.prompts // max(1, args.replicas)) // 2)
     spec = args.chaos or f'crash:submit@{victim}:{kill_at}'
     chaos = None
+    fleet_buffers = []
     if spec != 'none':
         sfaults.configure(spec)
         # rpc_deadline bounds the failover tail: the one request caught
@@ -278,6 +287,16 @@ def bench_replicated(args):
             router.heartbeat_once()
             chaos['readmitted'] = router.health()[victim]['healthy']
             chaos['spec'] = spec
+            if telemetry.enabled():
+                # sweep every replica's flight recorder over the RPC
+                # telemetry verb (in-process replicas dedup by
+                # recorder id in the merge)
+                fleet_buffers = router.fleet_telemetry()
+
+    trace_path = None
+    if telemetry.enabled():
+        trace_path = telemetry.export_chrome_trace(
+            args.out + '.trace.json', extra_buffers=fleet_buffers)
 
     recompiles = sum(r.stats()['server']['recompiles'] for r in reps)
     doc = {
@@ -293,6 +312,7 @@ def bench_replicated(args):
         'single': single,
         'replicated': replicated,
         'chaos': chaos,
+        'trace': trace_path,
         'scaling_x': round(replicated['tok_s'] /
                            max(single['tok_s'], 1e-9), 2),
     }

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Flight-recorder trace inspector.
+
+Reads the JSON written by ``mx.telemetry.dump_json()`` (raw merged
+buffers) — or, with ``--smoke``, generates a demo trace in-process —
+and renders each trace as an indented span tree: one line per span
+with start offset, duration, process/thread and attributes. The same
+doc converts to the Chrome-trace/Perfetto format with ``--chrome``.
+
+Usage::
+
+    python tools/trace_dump.py run.trace.json            # pretty trees
+    python tools/trace_dump.py run.trace.json --trace T  # one trace
+    python tools/trace_dump.py run.trace.json --json     # raw events
+    python tools/trace_dump.py run.trace.json --chrome out.json
+    python tools/trace_dump.py --smoke                   # self-test
+
+The telemetry package is loaded by file path, not ``import mxnet_tpu``,
+so this tool runs without jax installed — safe in any CI stage.
+
+Exit status: 0 on success (including an empty buffer), 1 on a missing
+or unreadable input file.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_telemetry():
+    """Load ``mxnet_tpu.telemetry`` standalone (no jax, no package
+    __init__): file-path import with the package's own directory as
+    its search path so the relative imports inside resolve."""
+    name = '_trace_dump_telemetry'
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(_REPO, 'mxnet_tpu', 'telemetry')
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, '__init__.py'),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _smoke(telemetry):
+    """Generate a nested demo trace, round-trip it through the dump
+    format and the tree/Chrome renderers, and print SMOKE OK."""
+    telemetry.configure(enabled=True, sample=1.0)
+    telemetry.clear()
+    with telemetry.span('smoke.request', client='trace_dump'):
+        with telemetry.span('smoke.route', replica='r0'):
+            pass
+        t0 = telemetry.walltime()
+        telemetry.emit('smoke.queue', t0, telemetry.walltime(),
+                       parent=telemetry.current_tc())
+    events = telemetry.merge_buffers([telemetry.snapshot_buffer()])
+    tids = telemetry.trace_ids(events)
+    assert len(tids) == 1, f'expected 1 demo trace, got {len(tids)}'
+    roots = telemetry.trace_tree(events, tids[0])
+    assert len(roots) == 1, 'demo trace is not connected'
+    names = {e['name'] for e in events}
+    assert names == {'smoke.request', 'smoke.route', 'smoke.queue'}, names
+    text = telemetry.format_tree(events, tids[0])
+    assert 'smoke.request' in text
+    doc = telemetry.chrome_doc(events)
+    assert any(e.get('ph') == 'X' for e in doc['traceEvents'])
+    print(text)
+    print('SMOKE OK')
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='pretty-print / convert mx.telemetry trace dumps')
+    parser.add_argument('path', nargs='?',
+                        help='JSON file written by telemetry.dump_json()')
+    parser.add_argument('--trace', metavar='ID',
+                        help='show only this trace id')
+    parser.add_argument('--chrome', metavar='OUT',
+                        help='write Chrome-trace JSON (Perfetto/'
+                             'chrome://tracing) to OUT')
+    parser.add_argument('--json', action='store_true',
+                        help='print the raw merged event list as JSON')
+    parser.add_argument('--smoke', action='store_true',
+                        help='self-test: generate a demo trace, render '
+                             'it, print SMOKE OK')
+    args = parser.parse_args(argv)
+
+    telemetry = _load_telemetry()
+    if args.smoke:
+        return _smoke(telemetry)
+    if not args.path:
+        parser.error('path is required unless --smoke')
+    try:
+        with open(args.path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f'trace_dump: cannot read {args.path}: {e}',
+              file=sys.stderr)
+        return 1
+
+    # dump_json docs carry pre-merged events; accept a bare event list
+    # or a raw snapshot_buffer() dict too.
+    if isinstance(doc, list):
+        events = doc
+    elif 'events' in doc and 'recorder' in doc:
+        events = telemetry.merge_buffers([doc])
+    else:
+        events = doc.get('events', [])
+
+    if args.trace:
+        events = [e for e in events if e.get('trace') == args.trace]
+    if args.chrome:
+        with open(args.chrome, 'w') as f:
+            json.dump(telemetry.chrome_doc(events), f)
+        print(f'wrote {args.chrome} ({len(events)} events)')
+        return 0
+    if args.json:
+        json.dump(events, sys.stdout, indent=2)
+        print()
+        return 0
+    tids = telemetry.trace_ids(events)
+    if not tids:
+        print('no traces recorded')
+        return 0
+    for i, tid in enumerate(tids):
+        if i:
+            print()
+        print(telemetry.format_tree(events, tid))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
