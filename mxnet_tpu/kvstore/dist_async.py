@@ -92,7 +92,14 @@ from .rpc import (RpcClient, RpcServer, _recv_exact,  # noqa: F401
 _MUTATING_CMDS = frozenset(
     {'init', 'push', 'set_optimizer', 'register_server', 'barrier',
      'put', 'elastic_join', 'elastic_leave', 'elastic_commit',
-     'elastic_barrier'})
+     'elastic_barrier', 'mesh_join', 'mesh_leave', 'mesh_epoch'})
+
+# data-plane commands stamped with the client's cached mesh generation
+# (once set_mesh_gen/mesh_join ran): the server's generation fence
+# rejects them typed after a re-formation instead of silently applying
+# a stale world's update. Mesh verbs themselves are never stamped —
+# they are how a client LEARNS the current generation.
+_MESH_STAMPED_CMDS = frozenset({'init', 'push', 'pull', 'put'})
 
 
 class _AsyncServer(RpcServer):
@@ -107,7 +114,8 @@ class _AsyncServer(RpcServer):
     # NEW store of a departed rank revives it); ping/bye/queries do not
     _REVIVING_CMDS = frozenset(
         {'init', 'push', 'pull', 'barrier', 'set_optimizer', 'put',
-         'elastic_join', 'elastic_barrier', 'elastic_commit'})
+         'elastic_join', 'elastic_barrier', 'elastic_commit',
+         'mesh_join'})
 
     def __init__(self, port, bind_host='127.0.0.1', sid=0):
         super().__init__(port, bind_host=bind_host, sid=sid)
@@ -180,6 +188,9 @@ class _AsyncServer(RpcServer):
                     'live': sorted(self._elastic_members),
                     'committed': self._elastic_committed,
                     'step': self._elastic_step}
+            with self._lock:
+                reply['mesh'] = {'gen': self._mesh_gen,
+                                 'members': sorted(self._mesh_members)}
             return reply, b''
         if cmd == 'init':
             arr = _onp.frombuffer(payload, header['dtype']).reshape(
@@ -491,6 +502,10 @@ class KVStoreDistAsync(KVStoreBase):
         self._seq_lock = threading.Lock()
         self._transport_stats = {'retries': 0, 'redials': 0,
                                  'giveups': 0}
+        # cached mesh generation: None until this store joined the mesh
+        # (or set_mesh_gen ran) — only then are data-plane RPCs stamped
+        # and subject to the server's generation fence
+        self._mesh_gen = None
 
     # ------------------------------------------------------------ plumbing
     def _channel(self, sid, host, port):
@@ -671,8 +686,21 @@ class KVStoreDistAsync(KVStoreBase):
                 self._seq += 1
                 header['seq'] = self._seq
             header['client'] = self._client
-        return self._chans[sid].call(header, payload, attempts=attempts,
-                                     deadline_s=deadline_s)
+        if self._mesh_gen is not None and 'gen' not in header \
+                and header['cmd'] in _MESH_STAMPED_CMDS:
+            header['gen'] = self._mesh_gen
+        try:
+            return self._chans[sid].call(header, payload,
+                                         attempts=attempts,
+                                         deadline_s=deadline_s)
+        except RuntimeError as e:
+            reply = getattr(e, 'reply', None) or {}
+            if reply.get('kind') == 'StaleGeneration':
+                from .rpc import StaleGeneration
+                err = StaleGeneration(str(e))
+                err.reply = reply
+                raise err from None
+            raise
 
     def _rpc(self, header, payload=b''):
         self._ensure_connected()
@@ -941,6 +969,50 @@ class KVStoreDistAsync(KVStoreBase):
                                     'step': int(step)},
                                 deadline_s=budget)
         return {k: v for k, v in reply.items() if k != 'ok'}
+
+    # --------------------------------------------------- mesh membership
+    def set_mesh_gen(self, gen):
+        """Adopt ``gen`` as this store's mesh generation: every
+        subsequent data-plane RPC (init/push/pull/put) is stamped with
+        it and the server's generation fence rejects it typed
+        (:class:`~mxnet_tpu.kvstore.rpc.StaleGeneration`) once the mesh
+        re-formed past it. ``None`` un-stamps (pre-mesh behaviour)."""
+        self._mesh_gen = None if gen is None else int(gen)
+
+    def mesh_join(self, meta=None):
+        """Join the pod mesh on server 0 (bumps the generation) and
+        adopt the new generation. ``meta`` rides along into the
+        membership table — mesh config, address, device inventory."""
+        header = {'cmd': 'mesh_join'}
+        if meta:
+            header['meta'] = dict(meta)
+        reply, _ = self._rpc(header)
+        self.set_mesh_gen(reply['gen'])
+        return {k: v for k, v in reply.items() if k != 'ok'}
+
+    def mesh_leave(self):
+        """Cleanly exit the mesh (planned scale-down; bumps the
+        generation when this rank was actually a member)."""
+        reply, _ = self._rpc({'cmd': 'mesh_leave'})
+        return {k: v for k, v in reply.items() if k != 'ok'}
+
+    def mesh_epoch(self, eject=(), bump=False):
+        """Leader-driven re-formation: eject dead ``ranks`` and bump
+        the generation once (idempotent — re-ejecting an already-gone
+        rank is a no-op unless ``bump`` forces it). Adopts the new
+        generation locally and returns it with the surviving members."""
+        reply, _ = self._rpc({'cmd': 'mesh_epoch',
+                              'eject': [int(r) for r in eject],
+                              'bump': bool(bump)})
+        self.set_mesh_gen(reply['gen'])
+        return {k: v for k, v in reply.items() if k != 'ok'}
+
+    def mesh_table(self):
+        """Current membership as piggybacked on a heartbeat: ``gen`` +
+        ``members`` — the follower's way to learn a re-formation it
+        did not drive."""
+        reply, _ = self._rpc({'cmd': 'ping'})
+        return reply.get('mesh', {'gen': 0, 'members': []})
 
     def get_num_dead_node(self, node_id=0, timeout=60):
         """A real failure-detection answer (reference ps-lite

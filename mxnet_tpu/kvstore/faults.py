@@ -36,6 +36,21 @@ Spec grammar — ``MXNET_KVSTORE_FAULT_SPEC`` or
                             so the retry loop does not absorb it: it
                             propagates to the training loop, which
                             "dies" (elastic chaos tests). Fires once.
+    kill_host[:CMD]:N       the N-th matching request raises
+                            :class:`InjectedHostDeath` — the whole-host
+                            failure case for pod-mesh chaos tests.
+                            Pair with ``rank=R`` to kill exactly one
+                            emulated host; like ``die_after`` it is a
+                            RuntimeError the retry loop must not
+                            absorb, but mesh drivers can tell the two
+                            apart (host death takes all of the host's
+                            devices out of the mesh). Fires once.
+    partition[:CMD]:N:M     requests N .. N+M-1 (counted over matching
+                            sends) raise ConnectionResetError — a
+                            transient network partition of a mesh
+                            member that heals after M failed attempts.
+                            Count-based, so the chaos tests need no
+                            wall-clock sleeps.
 
 ``CMD`` filters on the wire command (``push``, ``pull``, ``init``,
 ``ping``, ``barrier``, ...); ``*`` matches any worker request. Server
@@ -64,7 +79,8 @@ import threading
 import time
 
 __all__ = ['configure', 'clear', 'active', 'injected',
-           'on_send', 'on_recv', 'FaultSpecError', 'InjectedWorkerDeath']
+           'on_send', 'on_recv', 'FaultSpecError', 'InjectedWorkerDeath',
+           'InjectedHostDeath']
 
 
 class FaultSpecError(ValueError):
@@ -76,6 +92,15 @@ class InjectedWorkerDeath(RuntimeError):
     dying at this exact send. A RuntimeError (not ConnectionError /
     OSError) on purpose — the RPC retry loop must NOT catch it, the
     worker's training loop must."""
+
+
+class InjectedHostDeath(InjectedWorkerDeath):
+    """Raised by a ``kill_host`` rule: the whole emulated host (its
+    kvstore rank AND all devices it owns) dies at this exact send.
+    Subclasses :class:`InjectedWorkerDeath` so generic elastic
+    handling still applies, while pod-mesh drivers can distinguish a
+    host loss (mesh must re-form on fewer devices) from a lone worker
+    death."""
 
 
 def _parse_duration(text):
@@ -123,7 +148,8 @@ def _parse_rule(text):
         if len(parts) != 3:
             raise FaultSpecError(f'delay rule {text!r}: want delay:CMD:DUR')
         rule = _Rule('delay', parts[1], duration=_parse_duration(parts[2]))
-    elif action in ('reset_after', 'reset_every', 'die_after'):
+    elif action in ('reset_after', 'reset_every', 'die_after',
+                    'kill_host'):
         if len(parts) == 2:          # reset_after:N — any worker request
             cmd, n = None, parts[1]
         elif len(parts) == 3:        # reset_after:CMD:N
@@ -135,10 +161,24 @@ def _parse_rule(text):
         if n < 1:
             raise FaultSpecError(f'{action} count must be >= 1, got {n}')
         rule = _Rule(action, cmd, n=n)
+    elif action == 'partition':
+        if len(parts) == 3:          # partition:N:M — any worker request
+            cmd, n, m = None, parts[1], parts[2]
+        elif len(parts) == 4:        # partition:CMD:N:M
+            cmd, n, m = parts[1], parts[2], parts[3]
+        else:
+            raise FaultSpecError(
+                f'partition rule {text!r}: want partition[:CMD]:N:M')
+        n, m = int(n), int(m)
+        if n < 1 or m < 1:
+            raise FaultSpecError(
+                f'partition start/width must be >= 1, got {n}:{m}')
+        rule = _Rule('partition', cmd, n=n, m=m)
     else:
         raise FaultSpecError(
             f'unknown fault action {action!r} in rule {text!r} '
-            "(know: drop, delay, reset_after, reset_every, die_after)")
+            "(know: drop, delay, reset_after, reset_every, die_after, "
+            "kill_host, partition)")
     if 'rank' in opts:
         try:
             rule.rank = int(opts['rank'])
@@ -158,7 +198,8 @@ class FaultPlan:
                       if r.strip()]
         if not self.rules:
             raise FaultSpecError(f'empty fault spec {spec!r}')
-        self.counts = {'drop': 0, 'delay': 0, 'reset': 0, 'die': 0}
+        self.counts = {'drop': 0, 'delay': 0, 'reset': 0, 'die': 0,
+                       'kill_host': 0, 'partition': 0}
         self._lock = threading.Lock()
         self._tls = threading.local()
 
@@ -181,6 +222,27 @@ class FaultPlan:
                     raise InjectedWorkerDeath(
                         f'fault-injected worker death on {cmd!r} rpc'
                         + (f' (rank {rank})' if rank is not None else ''))
+            elif rule.action == 'kill_host':
+                with self._lock:
+                    rule.seen += 1
+                    fire = rule.seen == rule.n
+                    if fire:
+                        self.counts['kill_host'] += 1
+                if fire:
+                    raise InjectedHostDeath(
+                        f'fault-injected host death on {cmd!r} rpc'
+                        + (f' (rank {rank})' if rank is not None else ''))
+            elif rule.action == 'partition':
+                with self._lock:
+                    rule.seen += 1
+                    fire = rule.n <= rule.seen < rule.n + rule.m
+                    if fire:
+                        self.counts['partition'] += 1
+                if fire:
+                    raise ConnectionResetError(
+                        f'fault-injected partition of {cmd!r} rpc '
+                        '(member unreachable; heals after '
+                        f'{rule.m} attempts)')
             elif rule.action == 'delay':
                 with self._lock:
                     self.counts['delay'] += 1

@@ -49,6 +49,21 @@ _C_RETRIES = _tmetrics.counter('mx_rpc_retries_total')
 _C_REDIALS = _tmetrics.counter('mx_rpc_redials_total')
 _C_GIVEUPS = _tmetrics.counter('mx_rpc_giveups_total')
 _C_REPLAYS = _tmetrics.counter('mx_rpc_dedup_replays_total')
+# pod-scale mesh membership (docs/fault-tolerance.md "Pod-scale
+# elasticity"): generation gauge follows every join/leave/epoch bump,
+# the reject counter every fenced-off stale-generation request
+_G_MESH_GEN = _tmetrics.gauge('mx_mesh_generation')
+_C_STALE_GEN = _tmetrics.counter('mx_mesh_stale_generation_rejects_total')
+
+
+class StaleGeneration(RuntimeError):
+    """A generation-stamped request (push/pull/put of a mesh member)
+    carried a mesh generation older than the server's: the sender
+    missed a re-formation — typically a host that was ejected but is
+    still running. The request is REJECTED with this typed error, never
+    silently applied: a zombie's gradients must not leak into a mesh
+    that already rolled back past them. The client refreshes its
+    generation via ``mesh_epoch``/``mesh_table`` and rejoins."""
 
 
 def _recv_exact(sock, n):
@@ -119,7 +134,14 @@ class RpcServer(threading.Thread):
         self._dedup_order = collections.deque()
         self._dedup_window = int(os.environ.get(
             'MXNET_KVSTORE_DEDUP_WINDOW', '512'))
-        self._counters = {'dedup_replays': 0}
+        self._counters = {'dedup_replays': 0, 'stale_gen_rejects': 0}
+        # mesh membership table (mesh_join/mesh_leave/mesh_epoch): the
+        # process-topology side of a MeshGroup. Guarded by self._lock
+        # (kvstore.store) — no new lock level. The generation bumps on
+        # every membership change; generation-stamped data-plane
+        # requests older than it are rejected with StaleGeneration.
+        self._mesh_members = {}     # rank -> {'joined': clock, 'meta': {}}
+        self._mesh_gen = 0
         # live handler sockets: crash() force-closes them so an
         # injected replica death severs in-flight requests the way a
         # real process kill would (socketserver itself never tracks
@@ -289,6 +311,7 @@ class RpcServer(threading.Thread):
         cmd = header['cmd']
         rank = header.get('rank')
         client, seq = header.get('client'), header.get('seq')
+        gen = header.get('gen')
         with self._lock:
             if rank is not None:
                 r = int(rank)
@@ -299,6 +322,20 @@ class RpcServer(threading.Thread):
                 elif cmd in self._REVIVING_CMDS:
                     self._tombstones.discard(r)
                     self._last_seen[r] = self._clock()
+            if gen is not None and int(gen) < self._mesh_gen:
+                # generation fence — checked BEFORE the dedup window so
+                # a stale sender always gets the typed rejection, even
+                # for a retry whose pre-reformation apply was cached
+                # (the mesh rolled back past it either way)
+                self._counters['stale_gen_rejects'] += 1
+                _C_STALE_GEN.inc()
+                return ({'ok': False, 'kind': 'StaleGeneration',
+                         'error': f'{cmd!r} rejected: stale mesh '
+                                  f'generation {int(gen)} < '
+                                  f'{self._mesh_gen} — the mesh '
+                                  're-formed; refresh via mesh_epoch '
+                                  'and rejoin',
+                         'mesh_gen': self._mesh_gen}, b'')
             if client is not None and seq is not None:
                 cached = self._dedup.get((client, int(seq)))
                 if cached is not None:
@@ -327,6 +364,13 @@ class RpcServer(threading.Thread):
             # alignment (NTP-midpoint offset off this one round trip)
             reply = {'ok': True, 'sid': self._sid,
                      'ts': _time.time(), 'proc': _trace.proc_name()}
+            with self._lock:
+                if self._mesh_members or self._mesh_gen:
+                    # membership table piggybacked on every heartbeat:
+                    # followers learn re-formations (new generation,
+                    # shrunk member set) without a dedicated poll verb
+                    reply['mesh'] = {'gen': self._mesh_gen,
+                                     'members': sorted(self._mesh_members)}
             extra = self._ping_extra()
             if extra:
                 reply.update(extra)
@@ -360,6 +404,38 @@ class RpcServer(threading.Thread):
             # flight-recorder sweep for the cross-process trace export
             return {'ok': True,
                     'telemetry': _trace.snapshot_buffer()}, b''
+        if cmd == 'mesh_join':
+            with self._lock:
+                self._mesh_members[int(header['rank'])] = {
+                    'joined': self._clock(),
+                    'meta': header.get('meta') or {}}
+                self._mesh_gen += 1
+                _G_MESH_GEN.set(self._mesh_gen)
+                return {'ok': True, 'gen': self._mesh_gen,
+                        'members': sorted(self._mesh_members)}, b''
+        if cmd == 'mesh_leave':
+            with self._lock:
+                if self._mesh_members.pop(int(header['rank']),
+                                          None) is not None:
+                    self._mesh_gen += 1
+                    _G_MESH_GEN.set(self._mesh_gen)
+                return {'ok': True, 'gen': self._mesh_gen,
+                        'members': sorted(self._mesh_members)}, b''
+        if cmd == 'mesh_epoch':
+            # leader-driven re-formation: eject dead members and bump
+            # the generation ONCE. Ejecting an already-gone rank is a
+            # no-op (idempotent — a retried epoch does not double-bump),
+            # so the fence moves exactly one step per real reformation.
+            with self._lock:
+                changed = False
+                for r in header.get('eject') or []:
+                    if self._mesh_members.pop(int(r), None) is not None:
+                        changed = True
+                if changed or header.get('bump'):
+                    self._mesh_gen += 1
+                    _G_MESH_GEN.set(self._mesh_gen)
+                return {'ok': True, 'gen': self._mesh_gen,
+                        'members': sorted(self._mesh_members)}, b''
         return self._handle_app(header, payload, peer)
 
     def _handle_app(self, header, payload, peer):

@@ -328,6 +328,23 @@ class SharedCheckpointManager:
                     return _localize(ck.restore(path, tmpl))
             return _localize(ck.restore(path))
 
+    def step_metadata(self, step):
+        """Shape/dtype metadata tree of the checkpoint at ``step`` (or
+        ``None`` when unreadable) — what a resharding restore needs to
+        build a template for a DIFFERENT mesh than the writer's: each
+        leaf has ``.shape`` and ``.dtype`` but no placement, so the
+        caller decides where the values land (e.g. the shrunk pod mesh
+        after a host loss)."""
+        try:
+            with _ocp.StandardCheckpointer() as ck:
+                meta = ck.metadata(self._step_path(int(step)))
+            if hasattr(meta, 'item_metadata'):
+                return meta.item_metadata.tree
+            # newer orbax returns the metadata tree itself
+            return getattr(meta, 'tree', meta)
+        except Exception:
+            return None
+
     def _replicated_template(self, step):
         """ShapeDtypeStruct tree (from checkpoint metadata) carrying the
         live world's fully-replicated sharding; None if the metadata

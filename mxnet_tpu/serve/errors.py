@@ -10,7 +10,8 @@ catch-all handlers keep working.
 from ..base import MXNetError
 
 __all__ = ['ServeError', 'ServerOverloaded', 'DeadlineExceeded',
-           'ServerClosed', 'PagesExhausted', 'NoHealthyReplicas']
+           'ServerClosed', 'PagesExhausted', 'NoHealthyReplicas',
+           'ReplicaUnhealthy']
 
 
 class ServeError(MXNetError):
@@ -40,6 +41,14 @@ class DeadlineExceeded(ServeError):
 class ServerClosed(ServeError):
     """The server is draining or closed; no new work is accepted and
     still-queued requests are rejected when ``close(drain=False)``."""
+
+
+class ReplicaUnhealthy(ServeError):
+    """The replica latched itself unhealthy — its device-health probe
+    reported host-level device loss, so it refuses new work instead of
+    hanging it on a partial mesh. The router treats this as a failover
+    signal (eject + retry on a peer with the same request identity),
+    never as a client-visible rejection."""
 
 
 class NoHealthyReplicas(ServeError):

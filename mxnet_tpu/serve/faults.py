@@ -28,13 +28,24 @@ semicolon-separated rules::
                           ``ConnectionError``) on hits N..N+M-1, then
                           heal — a transient network partition: the
                           endpoint stays alive but unreachable.
+    kill_host:STAGE[:N]   raise :class:`HostDeathInjected` (a
+                          ``ConnectionError``) on the N-th hit
+                          (default 1) and every one after — a replica
+                          host losing its devices: the condition is
+                          PERSISTENT, not transient, until the plan is
+                          cleared (a multi-chip replica cannot limp
+                          along on a partial mesh). The ``device``
+                          probe latches the replica unhealthy so the
+                          router ejects it instead of timing out a
+                          hung request.
 
 ``STAGE`` is one of the pipeline's hook points — ``dispatch`` (batch
 handed to the model), ``prefill`` (decode-server prompt prefill),
-``step`` (one continuous-batching decode step) — or, at the
-router↔replica RPC boundary, ``submit`` (request received by a
-replica, BEFORE it is applied), ``reply`` (reply about to be sent,
-AFTER the apply — losing it exercises the dedup window), and
+``step`` (one continuous-batching decode step), ``device`` (the
+replica's local device-health probe, checked on every load report) —
+or, at the router↔replica RPC boundary, ``submit`` (request received
+by a replica, BEFORE it is applied), ``reply`` (reply about to be
+sent, AFTER the apply — losing it exercises the dedup window), and
 ``heartbeat`` (replica answering a router ping). ``*`` matches any.
 
 A stage may carry a scope suffix ``STAGE@NAME`` targeting one named
@@ -50,9 +61,10 @@ import time as _time
 
 __all__ = ['configure', 'clear', 'active', 'injected', 'on',
            'FaultSpecError', 'CrashInjected', 'PartitionInjected',
-           'STAGES']
+           'HostDeathInjected', 'STAGES']
 
-STAGES = ('dispatch', 'prefill', 'step', 'submit', 'reply', 'heartbeat')
+STAGES = ('dispatch', 'prefill', 'step', 'submit', 'reply', 'heartbeat',
+          'device')
 
 
 class FaultSpecError(ValueError):
@@ -70,6 +82,12 @@ class CrashInjected(ConnectionError):
 class PartitionInjected(ConnectionError):
     """A fault-plan ``partition`` rule fired: this message is lost as
     if the network were cut, but the endpoint lives and later heals."""
+
+
+class HostDeathInjected(ConnectionError):
+    """A fault-plan ``kill_host`` rule fired: the replica's host lost
+    (some of) its devices. Persistent until the plan is cleared — the
+    replica must latch itself unhealthy, not retry."""
 
 
 def _parse_duration(text):
@@ -111,8 +129,8 @@ def _parse_rule(text):
         stage, scope = _parse_stage(parts[1], text)
         return _Rule('stall', stage, scope,
                      duration=_parse_duration(parts[2]))
-    if action in ('error', 'error_every', 'crash'):
-        if len(parts) == 2 and action in ('error', 'crash'):
+    if action in ('error', 'error_every', 'crash', 'kill_host'):
+        if len(parts) == 2 and action in ('error', 'crash', 'kill_host'):
             token, n = parts[1], 1
         elif len(parts) == 3:
             token, n = parts[1], int(parts[2])
@@ -135,7 +153,7 @@ def _parse_rule(text):
         return _Rule('partition', stage, scope, n=n, m=m)
     raise FaultSpecError(
         f'unknown serve fault action {action!r} in rule {text!r} '
-        "(know: stall, error, error_every, crash, partition)")
+        "(know: stall, error, error_every, crash, partition, kill_host)")
 
 
 class FaultPlan:
@@ -147,7 +165,8 @@ class FaultPlan:
         if not self.rules:
             raise FaultSpecError(f'empty serve fault spec {spec!r}')
         self.sleep = sleep or _time.sleep
-        self.counts = {'stall': 0, 'error': 0, 'crash': 0, 'partition': 0}
+        self.counts = {'stall': 0, 'error': 0, 'crash': 0, 'partition': 0,
+                       'kill_host': 0}
         self._lock = threading.Lock()
 
     def on(self, stage, scope=None):
@@ -168,6 +187,10 @@ class FaultPlan:
                     fire = rule.seen % rule.n == 0
                 elif rule.action == 'crash':
                     fire = rule.seen == rule.n
+                elif rule.action == 'kill_host':
+                    # persistent from the N-th hit on: dead devices
+                    # stay dead until the plan is cleared (healed)
+                    fire = rule.seen >= rule.n
                 else:                      # partition: hits n..n+m-1
                     fire = rule.n <= rule.seen < rule.n + rule.m
                 if fire:
@@ -181,6 +204,9 @@ class FaultPlan:
                 if rule.action == 'crash':
                     raise CrashInjected(
                         f'fault-injected crash at serve stage {at}')
+                if rule.action == 'kill_host':
+                    raise HostDeathInjected(
+                        f'fault-injected host death at serve stage {at}')
                 if rule.action == 'partition':
                     raise PartitionInjected(
                         f'fault-injected partition at serve stage {at}')

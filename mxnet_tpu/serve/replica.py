@@ -38,11 +38,12 @@ from concurrent.futures import TimeoutError as _FutTimeout
 
 from ..analysis import race as _race
 from ..kvstore.rpc import RpcServer
+from ..sharding import context as _shctx
 from ..telemetry import metrics as _tmetrics
 from ..telemetry import trace as _trace
 from . import faults as _faults
 from .decode import DecodeServer
-from .errors import ServeError
+from .errors import ReplicaUnhealthy, ServeError
 
 __all__ = ['Replica']
 
@@ -123,10 +124,19 @@ class Replica:
     ``factory(version)`` builds the network for a version string — the
     replica owns server construction (and therefore prewarming) so
     :meth:`swap` can stage v2 completely before the cutover.
+
+    ``mesh`` makes the replica multi-chip: a
+    :class:`~mxnet_tpu.sharding.context.ShardingContext`, or a dict of
+    axis sizes (``{'dp': 2, 'tp': 2}``, optional ``'devices'`` list
+    picking the replica's device slice). Server construction, prewarm
+    and every decode step then run inside that context — a dp×tp
+    sharded :class:`DecodeServer` with zero model-code changes — and
+    the mesh shape travels in the replica's registration record and on
+    every heartbeat so the router can display/route by it.
     """
 
     def __init__(self, name, factory, version='v1', host='127.0.0.1',
-                 port=0, server_kw=None, start=True):
+                 port=0, server_kw=None, start=True, mesh=None):
         self.name = name
         self._factory = factory
         self._host = host
@@ -136,6 +146,9 @@ class Replica:
             self._lock = _race.tracked(self._lock, 'serve.replica')
         self._version = version
         self._swapping = False
+        self._healthy = True
+        self._health_reason = None
+        self._mesh_ctx, self._mesh_desc = self._resolve_mesh(mesh)
         self._ds = self._make_server(version)
         self._rpc = _ReplicaServer(self, port, bind_host=host)
         self._port = self._rpc.port     # stable across restart()
@@ -155,10 +168,37 @@ class Replica:
         for k, v in counters.items():
             yield ('counter', f'mx_replica_{k}_total', labels, v)
 
+    @staticmethod
+    def _resolve_mesh(mesh):
+        """Normalize the ``mesh=`` argument to ``(context, record)``:
+        the ShardingContext servers are built/run under, and the plain
+        registration record the router stores and heartbeats carry."""
+        if mesh is None:
+            return None, None
+        if isinstance(mesh, _shctx.ShardingContext):
+            ctx = mesh
+        else:
+            kw = {k: int(v) for k, v in dict(mesh).items()
+                  if k != 'devices' and int(v) > 1}
+            devices = dict(mesh).get('devices')
+            from ..parallel.mesh import make_mesh
+            if not kw:
+                import jax
+                kw = {'dp': len(devices if devices is not None
+                                else jax.devices())}
+            ctx = _shctx.ShardingContext(
+                make_mesh(devices=devices, **kw))
+        return ctx, {'axes': dict(ctx.axis_sizes),
+                     'n_devices': ctx.n_devices, 'mode': ctx.mode}
+
     def _make_server(self, version):
-        net = self._factory(version)
-        return DecodeServer(net, name=f'{self.name}:{version}',
-                            **self._server_kw)
+        # mesh-scoped construction: the factory's hybridize and the
+        # server's prewarm compile against the replica's own mesh, so
+        # each replica is an independent dp x tp sharded instance
+        with _shctx.use(self._mesh_ctx):
+            net = self._factory(version)
+            return DecodeServer(net, name=f'{self.name}:{version}',
+                                **self._server_kw)
 
     # -------------------------------------------------------- properties
     @property
@@ -180,6 +220,34 @@ class Replica:
         with self._lock:
             return self._version
 
+    @property
+    def mesh(self):
+        """Registration record of the replica's mesh (None when the
+        replica is single-chip)."""
+        return self._mesh_desc
+
+    @property
+    def healthy(self):
+        with self._lock:
+            return self._healthy
+
+    # ------------------------------------------------------------- health
+    def mark_unhealthy(self, reason):
+        """Latch the replica unhealthy (device loss): new submissions
+        are refused typed (:class:`ReplicaUnhealthy`) and heartbeats
+        carry ``healthy: False`` so the router ejects it immediately —
+        a dead device must cost a failover, never a hung request."""
+        with self._lock:
+            self._healthy = False
+            self._health_reason = str(reason)
+
+    def heal(self):
+        """Clear the unhealthy latch (devices restored / host replaced);
+        the next heartbeat re-admits the replica."""
+        with self._lock:
+            self._healthy = True
+            self._health_reason = None
+
     # ------------------------------------------------------------- serve
     def apply_submit(self, prompt, max_new, deadline_ms, timeout_s):
         """Apply one generate request on the current version; returns
@@ -194,6 +262,10 @@ class Replica:
     def _apply_submit(self, prompt, max_new, deadline_ms, timeout_s):
         from .errors import ServerClosed
         with self._lock:
+            if not self._healthy:
+                raise ReplicaUnhealthy(
+                    f'{self.name}: '
+                    f'{self._health_reason or "replica marked unhealthy"}')
             ds, version = self._ds, self._version
         try:
             fut = ds.submit(list(prompt), max_new_tokens=max_new,
@@ -219,16 +291,31 @@ class Replica:
         return [int(t) for t in tokens], version
 
     def load(self):
-        """Cheap load snapshot piggybacked on every heartbeat reply."""
+        """Cheap load snapshot piggybacked on every heartbeat reply.
+        Doubles as the device-health probe: a ``kill_host`` rule on the
+        ``device`` stage (host lost its devices) latches the replica
+        unhealthy, and the reply's ``healthy`` field tells the router
+        to eject it without waiting out a liveness deadline."""
+        try:
+            _faults.on('device', scope=self.name)
+        except ConnectionError as e:
+            self.mark_unhealthy(e)
         with self._lock:
             ds, version, swapping = self._ds, self._version, self._swapping
+            healthy, reason = self._healthy, self._health_reason
         st = ds.stats()
-        return {'load': st['queued'] + st['active_slots'],
-                'queued': st['queued'],
-                'active_slots': st['active_slots'],
-                'slots': st['slots'],
-                'version': version,
-                'swapping': swapping}
+        out = {'load': st['queued'] + st['active_slots'],
+               'queued': st['queued'],
+               'active_slots': st['active_slots'],
+               'slots': st['slots'],
+               'version': version,
+               'swapping': swapping,
+               'healthy': healthy}
+        if not healthy:
+            out['reason'] = reason
+        if self._mesh_desc is not None:
+            out['mesh'] = self._mesh_desc
+        return out
 
     # ---------------------------------------------------------- hot-swap
     def swap(self, version):
@@ -302,9 +389,12 @@ class Replica:
         srv = self._rpc
         with srv._lock:
             counters = dict(srv._counters)
-        return {'name': self.name, 'version': version,
-                'addr': list(self.addr), 'counters': counters,
-                'server': ds.stats()}
+        out = {'name': self.name, 'version': version,
+               'addr': list(self.addr), 'counters': counters,
+               'healthy': self.healthy, 'server': ds.stats()}
+        if self._mesh_desc is not None:
+            out['mesh'] = self._mesh_desc
+        return out
 
     def close(self, drain=True):
         _tmetrics.unregister_collector(self._collector_key)

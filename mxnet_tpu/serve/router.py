@@ -53,7 +53,8 @@ from ..kvstore.rpc import RpcClient
 from ..telemetry import metrics as _tmetrics
 from ..telemetry import trace as _trace
 from .errors import (DeadlineExceeded, NoHealthyReplicas, PagesExhausted,
-                     ServeError, ServerClosed, ServerOverloaded)
+                     ReplicaUnhealthy, ServeError, ServerClosed,
+                     ServerOverloaded)
 
 __all__ = ['Router']
 
@@ -61,7 +62,7 @@ __all__ = ['Router']
 # survive the wire)
 _KINDS = {c.__name__: c for c in
           (ServeError, ServerOverloaded, PagesExhausted,
-           DeadlineExceeded, ServerClosed)}
+           DeadlineExceeded, ServerClosed, ReplicaUnhealthy)}
 
 _POOL_MAX = 4       # idle channels kept per replica
 
@@ -84,8 +85,8 @@ class _ReplicaState:
     """Router-side view of one replica (guarded by the router lock)."""
 
     __slots__ = ('name', 'host', 'port', 'healthy', 'last_seen', 'load',
-                 'version', 'swapping', 'pool', 'routed', 'ejections',
-                 'readmissions')
+                 'version', 'swapping', 'mesh', 'pool', 'routed',
+                 'ejections', 'readmissions')
 
     def __init__(self, name, host, port, now):
         self.name = name
@@ -95,6 +96,7 @@ class _ReplicaState:
         self.load = 0
         self.version = None
         self.swapping = False
+        self.mesh = None            # registration record (multi-chip)
         self.pool = []              # idle RpcClient channels
         self.routed = 0
         self.ejections = 0
@@ -114,8 +116,13 @@ class Router:
                  clock=time.monotonic, deadline_s=None, hedge_ms=None,
                  rpc_deadline_s=None, ping_timeout_s=0.5,
                  heartbeat_s=None, start=True):
+        meshes = {}
         if not isinstance(replicas, dict):
-            replicas = {r.name: r.addr for r in replicas}
+            objs = list(replicas)
+            # registration records: a multi-chip replica's mesh shape
+            # rides along (and is refreshed by every heartbeat)
+            meshes = {r.name: getattr(r, 'mesh', None) for r in objs}
+            replicas = {r.name: r.addr for r in objs}
         if not replicas:
             raise ValueError('Router needs at least one replica')
         self._clock = clock
@@ -139,6 +146,9 @@ class Router:
         now = clock()
         self._replicas = {name: _ReplicaState(name, host, port, now)
                           for name, (host, port) in replicas.items()}
+        for name, m in meshes.items():
+            if m is not None:
+                self._replicas[name].mesh = dict(m)
         self._seq = 0
         self._counters = {'requests': 0, 'completed': 0, 'rejected': 0,
                           'failovers': 0, 'hedges': 0, 'ejections': 0,
@@ -213,7 +223,17 @@ class Router:
                     st.load = int(reply.get('load', 0))
                     st.version = reply.get('version', st.version)
                     st.swapping = bool(reply.get('swapping', False))
-                    if not st.healthy:
+                    if reply.get('mesh'):
+                        st.mesh = dict(reply['mesh'])
+                    if reply.get('healthy', True) is False:
+                        # reachable but self-reported device-dead:
+                        # eject NOW — no liveness deadline to wait out
+                        if st.healthy:
+                            st.healthy = False
+                            st.ejections += 1
+                            self._counters['ejections'] += 1
+                            events.append(('eject', st.name))
+                    elif not st.healthy:
                         st.healthy = True
                         st.readmissions += 1
                         self._counters['readmissions'] += 1
@@ -332,12 +352,26 @@ class Router:
                             self._counters['ejections'] += 1
                 continue
             except RuntimeError as e:
+                kind = getattr(e, 'reply', {}).get('kind')
+                if kind == 'ReplicaUnhealthy':
+                    # the replica says its devices are gone — a
+                    # failover signal, never a client-visible
+                    # rejection: same identity retries on a peer
+                    self._return(st, chan)
+                    last_exc = e
+                    tried.add(st.name)
+                    with self._lock:
+                        self._counters['failovers'] += 1
+                        if st.healthy:
+                            st.healthy = False
+                            st.ejections += 1
+                            self._counters['ejections'] += 1
+                    continue
                 # typed application rejection — not a replica failure:
                 # no failover (the request itself was refused)
                 self._return(st, chan)
                 with self._lock:
                     self._counters['rejected'] += 1
-                kind = getattr(e, 'reply', {}).get('kind')
                 raise _KINDS.get(kind, ServeError)(str(e)) from None
             self._return(st, chan)
             with self._lock:
@@ -464,6 +498,7 @@ class Router:
                               'load': st.load,
                               'version': st.version,
                               'swapping': st.swapping,
+                              'mesh': st.mesh,
                               'routed': st.routed,
                               'ejections': st.ejections,
                               'readmissions': st.readmissions}

@@ -31,8 +31,8 @@ See docs/sharding.md for rule syntax and TP/FSDP recipes, and
 from .rules import (match_partition_rules, match_spec, resolve_spec,
                     shard_factor, register_rules, rules_for, list_archs,
                     infer_arch, UnmatchedParamError)
-from .context import (ShardingContext, mesh, current, constrain,
-                      batch_spec, use, lift_raws)
+from .context import (ShardingContext, MeshGroup, mesh, current,
+                      constrain, batch_spec, use, lift_raws)
 
 # let the eager dispatch layer see the ambient mesh context (device-set
 # reconciliation in apply_op) without a circular top-level import
@@ -43,4 +43,5 @@ del _registry
 __all__ = ['match_partition_rules', 'match_spec', 'resolve_spec',
            'shard_factor', 'register_rules', 'rules_for', 'list_archs',
            'infer_arch', 'UnmatchedParamError', 'ShardingContext',
-           'mesh', 'current', 'constrain', 'batch_spec', 'use']
+           'MeshGroup', 'mesh', 'current', 'constrain', 'batch_spec',
+           'use']

@@ -33,8 +33,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import rules as _rules
 
-__all__ = ['ShardingContext', 'mesh', 'current', 'constrain',
-           'batch_spec']
+__all__ = ['ShardingContext', 'MeshGroup', 'mesh', 'current',
+           'constrain', 'batch_spec', 'use']
 
 _STACK = threading.local()
 
@@ -155,6 +155,132 @@ class ShardingContext:
     def __repr__(self):
         ax = ', '.join(f'{k}={v}' for k, v in self.axis_sizes.items())
         return f'<ShardingContext {ax} mode={self.mode}>'
+
+
+class MeshGroup:
+    """Mesh topology separated from process topology (the pod layer).
+
+    A :class:`ShardingContext` describes a *device* mesh; a
+    :class:`MeshGroup` describes which *host* (process) owns which
+    slice of it — the ``jax.distributed`` view, emulated over
+    ``n_procs`` local "hosts" on the CPU backend
+    (``--xla_force_host_platform_device_count``) so pod-scale
+    membership logic is tier-1 testable. Each host owns a contiguous
+    block of ``len(devices) / n_procs`` devices; the group tracks the
+    LIVE host set plus a re-formation ``generation``.
+
+    The group is immutable: :meth:`eject` returns a NEW group with the
+    dead hosts removed and the generation bumped — the shape handed to
+    :meth:`context`, which builds a :class:`ShardingContext` over only
+    the live hosts' devices (the re-formed, smaller mesh). The
+    authoritative generation for stale-push rejection lives on the
+    kvstore (``mesh_epoch`` verb); this one mirrors it for display and
+    registration records.
+
+    ``n_procs`` defaults to ``MXNET_MESH_PROCS`` (docs/env_vars.md).
+    """
+
+    def __init__(self, n_procs=None, devices=None, generation=0,
+                 live=None):
+        if n_procs is None:
+            try:
+                n_procs = int(os.environ.get('MXNET_MESH_PROCS', '1'))
+            except ValueError:
+                n_procs = 1
+        n_procs = int(n_procs)
+        devices = list(devices) if devices is not None \
+            else list(jax.devices())
+        if n_procs < 1:
+            raise ValueError(f'n_procs must be >= 1, got {n_procs}')
+        if len(devices) % n_procs:
+            raise ValueError(
+                f'{len(devices)} devices do not split evenly over '
+                f'{n_procs} emulated hosts')
+        self.n_procs = n_procs
+        self._devices = devices
+        per = len(devices) // n_procs
+        self.devices_per_proc = per
+        self._owned = {r: tuple(devices[r * per:(r + 1) * per])
+                       for r in range(n_procs)}
+        self.generation = int(generation)
+        live = sorted(set(range(n_procs)) if live is None else
+                      {int(r) for r in live})
+        for r in live:
+            if not 0 <= r < n_procs:
+                raise ValueError(f'live rank {r} outside 0..{n_procs - 1}')
+        if not live:
+            raise ValueError('a MeshGroup needs at least one live host')
+        self._live = tuple(live)
+
+    # ---------------------------------------------------------- topology
+    @property
+    def live(self):
+        """Live host ranks, ascending."""
+        return self._live
+
+    @property
+    def leader(self):
+        """Lowest live rank — the host that executes the global program
+        and drives re-formation (leadership migrates on its death)."""
+        return self._live[0]
+
+    def devices_for(self, rank):
+        """The contiguous device block host ``rank`` owns (dead or
+        alive — ownership is topology, liveness is membership)."""
+        return self._owned[int(rank)]
+
+    def live_devices(self):
+        """Union of the live hosts' devices, rank order — the device
+        set the re-formed mesh is built over."""
+        return [d for r in self._live for d in self._owned[r]]
+
+    # -------------------------------------------------------- membership
+    def eject(self, *ranks):
+        """New group without ``ranks``, generation bumped — host loss
+        (or planned scale-down) as a value, never in-place mutation."""
+        gone = {int(r) for r in ranks}
+        live = [r for r in self._live if r not in gone]
+        if not live:
+            raise ValueError(
+                f'ejecting {sorted(gone)} would leave no live host')
+        return MeshGroup(self.n_procs, self._devices,
+                         generation=self.generation + 1, live=live)
+
+    # ----------------------------------------------------------- context
+    def context(self, tp=None, rules=None, mode=None, arch=None):
+        """A :class:`ShardingContext` over the LIVE hosts' devices:
+        ``dp`` = live devices / ``tp`` (default tp=1 — pure FSDP).
+        Enter it with :func:`use`; deliberately not a contextmanager so
+        drivers and servers can hold and re-enter one formation."""
+        devs = self.live_devices()
+        tp = int(tp) if tp else 1
+        if tp > 1 and len(devs) % tp:
+            raise ValueError(
+                f'tp={tp} does not divide {len(devs)} live devices')
+        dp = len(devs) // tp
+        sizes = {}
+        if dp > 1:
+            sizes['dp'] = dp
+        if tp > 1:
+            sizes['tp'] = tp
+        if not sizes:
+            sizes = {'dp': len(devs)}
+        from ..parallel.mesh import make_mesh
+        return ShardingContext(make_mesh(devices=devs, **sizes),
+                               rules=rules, mode=mode, arch=arch)
+
+    def describe(self):
+        """Registration-record form (serving: the router stores this
+        per replica; training: the mesh_join meta)."""
+        return {'n_procs': self.n_procs,
+                'devices_per_proc': self.devices_per_proc,
+                'n_devices': len(self._devices),
+                'live': list(self._live),
+                'generation': self.generation}
+
+    def __repr__(self):
+        return (f'<MeshGroup {len(self._live)}/{self.n_procs} hosts x '
+                f'{self.devices_per_proc} dev gen={self.generation}>')
 
 
 def constrain(x, spec=None):

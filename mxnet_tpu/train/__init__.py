@@ -1,7 +1,10 @@
 """``mx.train`` — training supervision: elastic, preemption-tolerant
 loops (async crash-consistent checkpoints, bit-exact resume, worker-loss
-recovery). See ``docs/fault-tolerance.md`` ("Elastic training")."""
+recovery, pod-scale mesh re-formation). See ``docs/fault-tolerance.md``
+("Elastic training", "Pod-scale elasticity")."""
 
-from .elastic import ElasticGroup, ElasticHalted, ElasticTrainer
+from .elastic import (ElasticGroup, ElasticHalted, ElasticTrainer,
+                      MeshElasticTrainer)
 
-__all__ = ['ElasticGroup', 'ElasticHalted', 'ElasticTrainer']
+__all__ = ['ElasticGroup', 'ElasticHalted', 'ElasticTrainer',
+           'MeshElasticTrainer']

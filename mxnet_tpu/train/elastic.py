@@ -217,12 +217,30 @@ class ElasticTrainer:
             yield ('counter', f'mx_ckpt_{k}_total', labels, v)
 
     # ---------------------------------------------------------- snapshot
+    @staticmethod
+    def _snap_param(p):
+        """One parameter's snapshot leaf: host-local params copy to
+        numpy (the original contract), but a param sharded over >1
+        device stays a DEVICE array — gathering a pod-sharded FSDP
+        param to host on-step would serialize the whole model through
+        one host; orbax writes each shard from where it lives instead.
+        Safe to hold across steps: optimizer updates rebind the
+        parameter to NEW buffers (no donation of params), so the
+        snapshot's reference stays valid while the daemon serializes."""
+        nd = p.data()
+        raw = getattr(nd, '_data', None)
+        sh = getattr(raw, 'sharding', None)
+        if sh is not None and len(getattr(sh, 'device_set', ())) > 1:
+            return raw
+        return nd.asnumpy()
+
     def snapshot(self, step):
-        """Build the checkpoint tree: device→host parameter copies plus
-        a pickled ``meta`` blob (trainer counters + optimizer slots,
-        RNG streams, iterator position, the step). This is the ON-step
-        cost of an async save."""
-        tree = {'params': {n: p.data().asnumpy()
+        """Build the checkpoint tree: device→host parameter copies
+        (sharded params stay device-resident — see :meth:`_snap_param`)
+        plus a pickled ``meta`` blob (trainer counters + optimizer
+        slots, RNG streams, iterator position, the step). This is the
+        ON-step cost of an async save."""
+        tree = {'params': {n: self._snap_param(p)
                            for n, p in self._params.items()}}
         meta = {
             'step': int(step),
@@ -298,6 +316,42 @@ class ElasticTrainer:
         return True
 
     # ----------------------------------------------------------- restore
+    def _restore_template(self, step):
+        """Restore template carrying the LIVE params' sharded
+        placements, shapes/dtypes from the checkpoint's METADATA — so a
+        checkpoint written on one mesh restores (resharding on load)
+        onto whatever mesh the live params are compiled under now: the
+        re-shard-on-restore leg of pod re-formation. ``None`` when no
+        live param is sharded (the original host-numpy restore path) or
+        the metadata is unreadable."""
+        shardings = {}
+        for n, p in self._params.items():
+            try:
+                raw = p.data()._data
+            except Exception:
+                continue
+            sh = getattr(raw, 'sharding', None)
+            if sh is not None and len(getattr(sh, 'device_set', ())) > 1:
+                shardings[n] = sh
+        if not shardings:
+            return None
+        meta = getattr(self._manager, 'step_metadata', lambda s: None)(step)
+        if not isinstance(meta, dict) or 'params' not in meta \
+                or 'meta' not in meta:
+            return None
+        import jax
+        tparams = {}
+        for n, m in meta['params'].items():
+            shape, dtype = tuple(m.shape), _np.dtype(m.dtype)
+            if n in shardings:
+                tparams[n] = jax.ShapeDtypeStruct(
+                    shape, dtype, sharding=shardings[n])
+            else:
+                tparams[n] = _np.zeros(shape, dtype)
+        mb = meta['meta']
+        return {'params': tparams,
+                'meta': _np.zeros(tuple(mb.shape), _np.dtype(mb.dtype))}
+
     def restore(self, step=None):
         """Restore the latest (or given) committed checkpoint into the
         live objects — parameters, trainer, RNG streams, iterator
@@ -307,7 +361,9 @@ class ElasticTrainer:
             step = self._manager.latest_step()
         if step is None:
             return -1
-        tree = self._manager.restore(int(step))
+        tree = self._manager.restore(int(step),
+                                     template=self._restore_template(
+                                         int(step)))
         from ..ndarray.ndarray import array
         params = tree['params']
         for n, p in self._params.items():
@@ -436,6 +492,13 @@ class ElasticGroup:
                 f'({phase}, {step}): checkpoint and halt')
         return v
 
+    def barrier(self, phase, step):
+        """Named rendezvous of the live members outside the pre/post
+        step protocol — mesh re-formation drains ('reform') and rejoins
+        ('rejoin') on these. Same ejection/halt semantics as the step
+        barriers."""
+        return self._barrier(str(phase), int(step))
+
     def pre_step(self, step):
         """Entry barrier: fixes the gradient-scaling ``count``."""
         return self._barrier('pre', step)
@@ -454,3 +517,193 @@ class ElasticGroup:
     def leave(self):
         """Clean exit (planned scale-down): no ejection wait for peers."""
         self._store.elastic_leave()
+
+
+class MeshElasticTrainer:
+    """One emulated host of a pod-scale elastic FSDP run.
+
+    Composes the pod layers end to end: a ``dist_async`` store (this
+    host's kvstore rank + mesh membership), a
+    :class:`~mxnet_tpu.sharding.MeshGroup` (which host owns which
+    devices), an :class:`ElasticGroup` (the per-step membership
+    protocol) and an :class:`ElasticTrainer` (crash-consistent sharded
+    checkpoints). Under single-process GSPMD emulation the LEADER
+    (lowest live rank) executes the global sharded program over the
+    union of the live hosts' devices; followers run only the protocol
+    — heartbeats, barriers — and take over (rebuild + restore from the
+    committed checkpoint) when leadership migrates onto them.
+
+    ``build(ctx)`` is the model factory, called under the formation's
+    sharding context whenever this host (re)becomes leader; it returns
+    ``{'params': {name: Parameter}, 'trainer': gluon.Trainer | None,
+    'step': fn(step)}`` with parameters already placed on ``ctx``'s
+    mesh (run a warm-up forward inside). After a host death the mesh
+    re-forms through the span tree ``mesh.reform`` → detect / drain /
+    restore / rejoin: the leader ejects the dead ranks via
+    ``mesh_epoch`` (bumping the generation, so stale-generation pushes
+    of the dead host reject typed), every survivor drains its async
+    checkpoint daemon, rebuilds on the shrunk mesh, the leader restores
+    the last committed step (resharding onto the smaller mesh), and
+    training resumes at ``committed + 1`` — bit-exact w.r.t. a run that
+    never faulted at the reduced world size, because the restored state
+    and programs are identical. A second death during re-formation just
+    re-enters the loop (membership strictly shrinks, each barrier is
+    deadline-bounded — convergence or :class:`ElasticHalted`, never a
+    hang).
+    """
+
+    def __init__(self, store, group, build, ckpt_dir, tp=None,
+                 min_workers=None, name='mesh'):
+        self._store = store
+        self._rank = store.rank
+        self._build = build
+        self._dir = ckpt_dir
+        self._tp = tp
+        self._name = name
+        self._formed = group
+        self._ctx = None
+        self._state = None       # leader-only: build(ctx) result
+        self._et = None          # leader-only: ElasticTrainer
+        from ..parallel.checkpoint import SharedCheckpointManager
+        self._manager = SharedCheckpointManager(ckpt_dir)
+        self._h_reform = _tmetrics.histogram('mx_mesh_reform_duration_ms',
+                                             host=str(self._rank))
+        self._reform_s = float(os.environ.get('MXNET_MESH_REFORM_S',
+                                              '300'))
+        store.mesh_join(meta={
+            'devices': len(group.devices_for(self._rank))})
+        self._elastic = ElasticGroup(store, min_workers=min_workers)
+
+    # ------------------------------------------------------------- state
+    @property
+    def group(self):
+        """The current formation (live hosts + generation mirror)."""
+        return self._formed
+
+    @property
+    def committed(self):
+        return self._elastic.committed
+
+    def _form(self, live):
+        """Formation for ``live`` ranks, generation mirrored from the
+        kvstore's authoritative membership table."""
+        from ..sharding.context import MeshGroup
+        gen = self._store.mesh_table()['gen']
+        return MeshGroup(self._formed.n_procs, self._formed._devices,
+                         generation=gen, live=live)
+
+    def _context(self):
+        if self._ctx is None:
+            self._ctx = self._formed.context(tp=self._tp)
+        return self._ctx
+
+    def _restore_state(self):
+        """(Re)build the model under the current formation's context
+        and restore the last committed checkpoint onto it — the
+        re-shard-on-restore path when the mesh shrank. Leader-only."""
+        from ..sharding.context import use as _use
+        if self._et is not None:
+            self._et.close()
+            self._et = None
+        ctx = self._context()
+        with _use(ctx):
+            st = self._build(ctx)
+        self._state = st
+        # per-formation name: collectors/histograms key on it, and two
+        # formations of one run must not collide in the registry
+        self._et = ElasticTrainer(
+            st['params'], st.get('trainer'), self._manager,
+            name=f'{self._name}-r{self._rank}-g{self._formed.generation}')
+        return self._et.restore()
+
+    # ------------------------------------------------------------ reform
+    def _reform(self, verdict, step):
+        """Leader-driven mesh re-formation after a membership change.
+        Loops until a formation survives both its barriers unchanged
+        (a second death during re-formation re-enters with the smaller
+        verdict). Returns the step training resumes at."""
+        t0 = time.perf_counter()
+        with _trace.span('mesh.reform', rank=self._rank, step=int(step)):
+            while True:
+                # convergence budget: cascading deaths strictly shrink
+                # membership, but a flapping store could loop forever —
+                # bound one re-formation to MXNET_MESH_REFORM_S wall
+                # seconds, then halt typed rather than livelock
+                if time.perf_counter() - t0 > self._reform_s:
+                    raise ElasticHalted(
+                        'mesh re-formation did not converge within '
+                        f'MXNET_MESH_REFORM_S={self._reform_s:g}s')
+                live = sorted(verdict['live'])
+                with _trace.child_span('mesh.reform.detect',
+                                       live=list(live)):
+                    dead = [r for r in self._formed.live
+                            if r not in live]
+                    if self._elastic.is_leader(verdict):
+                        # bump the generation fence: every in-flight
+                        # push of an ejected host now rejects typed
+                        self._store.mesh_epoch(eject=dead)
+                with _trace.child_span('mesh.reform.drain'):
+                    if self._et is not None:
+                        self._et.flush()
+                    v = self._elastic.barrier('reform', step)
+                    if sorted(v['live']) != live:
+                        verdict = v      # double death mid-reformation
+                        continue
+                with _trace.child_span('mesh.reform.restore'):
+                    # followers learn the new generation off the
+                    # heartbeat piggyback; the leader already adopted
+                    # it in mesh_epoch
+                    self._store.set_mesh_gen(
+                        self._store.mesh_table()['gen'])
+                    self._formed = self._form(live)
+                    self._ctx = None
+                    self._state = None
+                    if self._elastic.is_leader(v):
+                        self._restore_state()
+                v2 = self._elastic.barrier('rejoin', step)
+                if sorted(v2['live']) != live:
+                    verdict = v2
+                    continue
+                break
+        self._h_reform.observe((time.perf_counter() - t0) * 1e3)
+        return self._elastic.committed + 1
+
+    # --------------------------------------------------------------- run
+    def run(self, num_steps):
+        """Drive steps ``resume .. num_steps-1`` through the elastic
+        protocol, re-forming the mesh on every membership change.
+        Raises :class:`ElasticHalted` when the live host count falls
+        below ``MXNET_ELASTIC_MIN_WORKERS``. Returns the first
+        not-yet-run step (``num_steps`` on normal completion)."""
+        from ..sharding.context import use as _use
+        # staggered mesh_joins left peers on different cached
+        # generations — adopt the authoritative one before stepping
+        self._store.set_mesh_gen(self._store.mesh_table()['gen'])
+        step = max(self._elastic.resume_step,
+                   self._elastic.committed + 1)
+        num_steps = int(num_steps)
+        while step < num_steps:
+            pre = self._elastic.pre_step(step)
+            if sorted(pre['live']) != list(self._formed.live):
+                step = self._reform(pre, step)
+                continue
+            if self._elastic.is_leader(pre):
+                if self._state is None:
+                    self._restore_state()
+                with _use(self._context()):
+                    self._state['step'](step)
+            post = self._elastic.post_step(step)
+            if post['changed'] \
+                    or sorted(post['live']) != list(self._formed.live):
+                step = self._reform(post, step)
+                continue
+            if self._elastic.is_leader(post):
+                self._et.save(step, block=True)
+                self._elastic.commit(step)
+            step += 1
+        return step
+
+    def close(self):
+        if self._et is not None:
+            self._et.close()
+            self._et = None

@@ -305,7 +305,8 @@ def test_fault_spec_from_environment(monkeypatch):
         assert plan is not None and plan.rules[0].cmd == 'ping'
         faults.on_send({'cmd': 'ping'})
         assert faults.injected() == {'drop': 0, 'delay': 1, 'reset': 0,
-                                     'die': 0, 'total': 1}
+                                     'die': 0, 'kill_host': 0,
+                                     'partition': 0, 'total': 1}
     finally:
         faults.clear()
     assert faults.injected() == {}

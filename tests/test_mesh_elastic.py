@@ -1,0 +1,518 @@
+"""Pod-scale elastic mesh: host-failure-tolerant FSDP training (ISSUE 19).
+
+The pod is emulated in one process: 4 "hosts" are threads, each with
+its own ``dist_async`` store and rank, over the 8-device CPU mesh
+(2 devices per host, ``MeshGroup``). Everything is deterministic —
+host deaths fire on exact count-based fault rules, liveness runs on an
+injectable fake clock (armed only once every survivor is parked at the
+barrier), and assertions are bit-exact:
+
+* mesh topology is separate from process topology (``MeshGroup``:
+  ownership, liveness, eject-as-a-value, re-formed contexts);
+* the kvstore carries mesh membership (join/leave/epoch verbs, the
+  generation-stamped table piggybacked on heartbeats) and fences stale
+  pushes/pulls of ejected hosts with a TYPED rejection;
+* a host killed mid-FSDP-run is detected within the deadline, the mesh
+  re-forms at the last committed step from the crash-consistent sharded
+  checkpoint (resharding onto the smaller mesh), and the result is
+  BIT-EXACT vs a planned scale-down through the same save/restore path;
+* a second death converges the same way; below the
+  ``MXNET_ELASTIC_MIN_WORKERS`` floor the pod raises
+  :class:`ElasticHalted` — never a hang.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from contextlib import closing
+
+import jax
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, kvstore, sharding, telemetry
+from mxnet_tpu.kvstore import dist_async, faults
+from mxnet_tpu.kvstore.rpc import StaleGeneration
+from mxnet_tpu.parallel.checkpoint import SharedCheckpointManager
+from mxnet_tpu.sharding.context import MeshGroup
+from mxnet_tpu.telemetry import metrics as tmetrics
+from mxnet_tpu.train import (ElasticHalted, ElasticTrainer,
+                             MeshElasticTrainer)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason='needs the 8-device CPU mesh')
+
+N_STEPS = 4
+LR = 0.1
+
+
+# --------------------------------------------------------------- model
+def _one_step(net, tr, s):
+    x = mx.np.array(onp.random.RandomState(s).randn(24, 8).astype('f'))
+    y = mx.np.array(
+        onp.random.RandomState(1000 + s).randn(24, 48).astype('f'))
+    with autograd.record():
+        loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    tr.step(24)
+
+
+def _build(ctx):
+    """MeshElasticTrainer build contract: params must come out
+    mesh-placed (placement happens in the optimizer update, so warm up
+    one train step), with PRISTINE init values (rolled back through the
+    sticky sharded set_data) and a fresh stateless trainer."""
+    mx.random.seed(0)
+    net = gluon.nn.Dense(48, in_units=8)
+    net.initialize()
+    net.hybridize()
+    params = dict(net.collect_params())
+    init = {n: p.data().asnumpy().copy() for n, p in params.items()}
+    tr = gluon.Trainer(params, 'sgd', {'learning_rate': LR})
+    _one_step(net, tr, 0)
+    for n, p in params.items():
+        p.set_data(mx.np.array(init[n]))
+    tr = gluon.Trainer(params, 'sgd', {'learning_rate': LR})
+    return {'params': params, 'trainer': tr,
+            'step': lambda s: _one_step(net, tr, s)}
+
+
+# ---------------------------------------------------------- pod harness
+def _free_port():
+    with closing(socket.socket()) as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+class _Pod:
+    """4 emulated hosts: one dist_async store per rank, one shared
+    server, a fake liveness clock armed per-scenario."""
+
+    def __init__(self, monkeypatch):
+        self.port = _free_port()
+        monkeypatch.setenv('MX_COORDINATOR', f'127.0.0.1:{_free_port()}')
+        monkeypatch.setenv('MXNET_KVSTORE_ASYNC_PORT', str(self.port))
+        # background beats off: a dead thread is a silent host, and
+        # liveness is driven purely by the fake clock below
+        monkeypatch.setenv('MXNET_KVSTORE_HEARTBEAT_S', '3600')
+        monkeypatch.setenv('MXNET_KVSTORE_DEADLINE_S', '60')
+        monkeypatch.setenv('MX_NPROC', '4')
+        self.stores = []
+        for r in range(4):
+            monkeypatch.setenv('MX_PROC_ID', str(r))
+            self.stores.append(kvstore.create('dist_async'))
+        self.stores[0]._ensure_connected()   # server is lazily created
+        self.srv = dist_async._SERVERS[self.port]
+        self._clk0 = time.monotonic()
+        self._stale = []        # rank is "silent" while this holds it
+        # once a rank in _stale stops arriving, it looks 100s stale
+        # (> the 60s deadline); everyone else heartbeats at clk0+1, and
+        # ejection auto-reverts the condition (members shrink)
+        self.srv.set_clock(lambda: self._clk0 + (
+            100.0 if any(r in self.srv._elastic_members
+                         for r in self._stale) else 1.0))
+
+    def kick(self, rank):
+        self._stale.append(rank)
+
+    def wait_parked(self, phase, step, ranks, timeout=300):
+        """Poll until exactly ``ranks`` are parked at the (phase, step)
+        barrier (arrivals don't notify the cv), then return True."""
+        deadline = time.monotonic() + timeout
+        want = set(ranks)
+        while time.monotonic() < deadline:
+            with self.srv._elastic_cv:
+                if self.srv._elastic_arrivals.get((phase, step),
+                                                  set()) == want:
+                    return True
+            time.sleep(0.02)
+        return False
+
+    def close(self):
+        faults.clear()
+        for kv in self.stores:
+            try:
+                kv.close()
+            except Exception:
+                pass
+        srv = dist_async._SERVERS.pop(self.port, None)
+        if srv is not None:
+            srv.stop()
+
+
+@pytest.fixture
+def pod(monkeypatch):
+    p = _Pod(monkeypatch)
+    yield p
+    p.close()
+
+
+def _launch(drivers, n_steps):
+    """Run every driver's ``run`` on its own host thread; returns
+    (threads, done, errors, host_died)."""
+    errors, done, host_died = [], [], threading.Event()
+
+    def run(i):
+        try:
+            done.append((i, drivers[i].run(n_steps)))
+        except faults.InjectedHostDeath:
+            host_died.set()
+        except BaseException as e:
+            errors.append((i, e))
+
+    ts = [threading.Thread(target=run, args=(i,), daemon=True)
+          for i in range(len(drivers))]
+    for t in ts:
+        t.start()
+    return ts, done, errors, host_died
+
+
+# ------------------------------------------------------ MeshGroup units
+def test_mesh_group_topology_and_eject():
+    g = MeshGroup(4)
+    assert g.n_procs == 4 and g.devices_per_proc == 2
+    assert g.live == (0, 1, 2, 3) and g.leader == 0
+    assert len(g.live_devices()) == 8
+    assert g.devices_for(2) == tuple(g.live_devices()[4:6])
+
+    g2 = g.eject(3)                      # a value, not a mutation
+    assert g2.live == (0, 1, 2) and g2.generation == 1
+    assert g.live == (0, 1, 2, 3) and g.generation == 0
+    assert len(g2.live_devices()) == 6
+    # ownership survives death: topology != membership
+    assert g2.devices_for(3) == g.devices_for(3)
+    g3 = g2.eject(0)
+    assert g3.leader == 1 and g3.generation == 2
+
+    d = g2.describe()
+    assert d['live'] == [0, 1, 2] and d['generation'] == 1
+    assert d['devices_per_proc'] == 2
+
+    with pytest.raises(ValueError):
+        MeshGroup(3)                     # 8 devices don't split over 3
+    with pytest.raises(ValueError):
+        g2.eject(0, 1, 2)                # nobody left
+
+
+def test_mesh_group_context_over_live_devices():
+    g = MeshGroup(4).eject(3)
+    ctx = g.context()
+    assert ctx.n_devices == 6 and ctx.axis_sizes == {'dp': 6}
+    ctx2 = g.context(tp=2)
+    assert ctx2.axis_sizes == {'dp': 3, 'tp': 2}
+    with pytest.raises(ValueError):
+        g.context(tp=4)                  # 4 does not divide 6
+
+
+def test_mesh_group_env_default(monkeypatch):
+    monkeypatch.setenv('MXNET_MESH_PROCS', '2')
+    g = MeshGroup()
+    assert g.n_procs == 2 and g.devices_per_proc == 4
+
+
+# --------------------------------------------- membership verbs (wire)
+def test_mesh_membership_verbs_and_piggyback(pod):
+    s0, s1 = pod.stores[0], pod.stores[1]
+    r = s0.mesh_join(meta={'devices': 2})
+    assert r['gen'] == 1 and r['members'] == [0]
+    r = s1.mesh_join()
+    assert r['gen'] == 2 and sorted(r['members']) == [0, 1]
+    # the table rides on every ping: followers learn gen for free
+    t = s0.mesh_table()
+    assert t['gen'] == 2 and t['members'] == [0, 1]
+    assert tmetrics.gauge('mx_mesh_generation').value == 2
+
+    # epoch is idempotent: re-ejecting a gone rank must not bump
+    r = s0.mesh_epoch(eject=[7])
+    assert r['gen'] == 2
+    r = s0.mesh_epoch(eject=[1])
+    assert r['gen'] == 3 and r['members'] == [0]
+    r = s0.mesh_epoch(eject=[1])
+    assert r['gen'] == 3                 # already gone: no bump
+    r = s0.mesh_epoch(bump=True)         # forced fence advance
+    assert r['gen'] == 4
+
+    s1.mesh_join()                       # rejoining revives rank 1
+    r = s1.mesh_leave()
+    assert r['members'] == [0]
+
+
+def test_stale_generation_push_pull_rejected_typed(pod):
+    s0, s1 = pod.stores[0], pod.stores[1]
+    g0 = s0.mesh_join()['gen']
+    s0.set_mesh_gen(g0)
+    s1.mesh_join()                       # bumps past g0: s0 is stale
+    c0 = tmetrics.counter('mx_mesh_stale_generation_rejects_total').value
+
+    with pytest.raises(StaleGeneration) as ei:
+        s0.init('w', onp.zeros(4, 'f'))
+    assert ei.value.reply['kind'] == 'StaleGeneration'
+    assert ei.value.reply['mesh_gen'] == g0 + 1
+    with pytest.raises(StaleGeneration):
+        s0.push('w', onp.ones(4, 'f'))
+    with pytest.raises(StaleGeneration):
+        s0.pull('w')
+    assert tmetrics.counter(
+        'mx_mesh_stale_generation_rejects_total').value == c0 + 3
+    # mesh verbs are never stamped: a stale peer can still ask for the
+    # current table (that's how it learns the new generation)...
+    cur = s0.mesh_table()['gen']
+    s0.set_mesh_gen(cur)
+    # ...and a current peer pushes fine
+    s0.init('w', onp.zeros(4, 'f'))
+    s0.push('w', onp.ones(4, 'f'))
+    assert (s0.pull('w') == 1).all()
+
+
+# ------------------------------------- sharded snapshot/restore (sat 1)
+def test_sharded_checkpoint_roundtrip_bit_exact(tmp_path):
+    with sharding.mesh(dp=8):
+        st = _build(None)
+        et = ElasticTrainer(st['params'], st['trainer'],
+                            SharedCheckpointManager(str(tmp_path)),
+                            name='rt8', async_save=False)
+        st['step'](0)
+        saved = {n: p.data().asnumpy().copy()
+                 for n, p in st['params'].items()}
+        et.save(0, block=True)
+        st['step'](1)                    # diverge past the snapshot
+        assert not (st['params']['weight'].data().asnumpy()
+                    == saved['weight']).all()
+        assert et.restore() == 0
+        for n, p in st['params'].items():
+            onp.testing.assert_array_equal(saved[n], p.data().asnumpy())
+            # re-shard-on-restore: params land back ON the mesh
+            assert len(p.data()._data.sharding.device_set) == 8
+        et.close()
+
+
+# ------------------------------------------------- host-death chaos
+def test_single_death_reforms_bit_exact(pod, tmp_path):
+    """THE chaos acceptance test: host 3 dies at the pre-barrier of
+    step 2 (its 5th elastic_barrier send; steps 0-1 committed). The
+    survivors detect it within the deadline, eject it (generation
+    fence), re-form on 6 devices at the last committed step, finish the
+    run, and match a planned scale-down BIT-EXACTLY."""
+    telemetry.configure(enabled=True, sample=1.0)
+    telemetry.clear()
+    try:
+        faults.configure('kill_host:elastic_barrier:5:rank=3')
+        drivers = [MeshElasticTrainer(pod.stores[r], MeshGroup(4),
+                                      _build, str(tmp_path / 'pod'),
+                                      name='pod')
+                   for r in range(4)]
+        ts, done, errors, host_died = _launch(drivers, N_STEPS)
+        assert pod.wait_parked('pre', 2, {0, 1, 2}), \
+            'survivors never reached the pre-2 barrier'
+        pod.kick(3)
+        for t in ts:
+            t.join(300)
+        assert not any(t.is_alive() for t in ts), 'pod hung'
+        assert not errors, errors
+        assert host_died.is_set()
+        assert sorted(done) == [(0, N_STEPS), (1, N_STEPS),
+                                (2, N_STEPS)]
+        assert faults.injected()['kill_host'] == 1
+        faults.clear()
+
+        d0 = drivers[0]
+        desc = d0.group.describe()
+        assert desc['live'] == [0, 1, 2]
+        # 4 joins + 1 ejection = generation 5, mirrored everywhere
+        assert desc['generation'] == 5
+        assert pod.stores[0].mesh_table() == {'gen': 5,
+                                              'members': [0, 1, 2]}
+        assert d0.committed == N_STEPS - 1
+        final = {n: p.data().asnumpy().copy()
+                 for n, p in d0._state['params'].items()}
+        w = d0._state['params']['weight'].data()._data
+        assert len(w.sharding.device_set) == 6   # re-sharded formation
+
+        # the dead host's in-flight push rejects TYPED, not silently
+        with pytest.raises(StaleGeneration):
+            pod.stores[3].init('zombie', onp.zeros(4, 'f'))
+
+        # telemetry: the reform reads as one span tree + metrics
+        evs = telemetry.events()
+        reforms = [e for e in evs if e['name'] == 'mesh.reform']
+        assert reforms, 'no mesh.reform span recorded'
+        ids = {e['span'] for e in reforms}
+        for child in ('mesh.reform.detect', 'mesh.reform.drain',
+                      'mesh.reform.restore'):
+            got = [e for e in evs if e['name'] == child]
+            assert got and all(e['parent'] in ids for e in got), child
+        assert tmetrics.gauge('mx_mesh_generation').value == 5
+        assert tmetrics.histogram('mx_mesh_reform_duration_ms',
+                                  host='0').count >= 1
+
+        # bit-exact vs the PLANNED scale-down through the same
+        # save/restore/reshard path: full mesh to the committed step,
+        # restore on the 6-device mesh, run to the end
+        ref = str(tmp_path / 'ref')
+        with sharding.mesh(dp=8):
+            st = _build(None)
+            for s in range(2):
+                st['step'](s)
+            et = ElasticTrainer(st['params'], st['trainer'],
+                                SharedCheckpointManager(ref),
+                                name='ref8', async_save=False)
+            et.save(1, block=True)
+            et.close()
+        with sharding.mesh(dp=6, devices=jax.devices()[:6]):
+            st2 = _build(None)
+            et2 = ElasticTrainer(st2['params'], st2['trainer'],
+                                 SharedCheckpointManager(ref),
+                                 name='ref6', async_save=False)
+            assert et2.restore() == 1
+            for s in range(2, N_STEPS):
+                st2['step'](s)
+            et2.close()
+            for n, p in st2['params'].items():
+                onp.testing.assert_array_equal(final[n],
+                                               p.data().asnumpy())
+        for d in drivers:
+            d.close()
+    finally:
+        telemetry.configure(enabled=False)
+        telemetry.clear()
+
+
+def test_double_death_converges(pod, tmp_path):
+    """A second host dies AFTER the first re-formation (rank 3 at
+    pre-2, then rank 2 at its pre-3 send on the re-formed mesh): the
+    pod re-forms again — strictly shrinking membership, two generation
+    bumps past the joins — and still completes every step."""
+    faults.configure('kill_host:elastic_barrier:5:rank=3;'
+                     'kill_host:elastic_barrier:10:rank=2')
+    drivers = [MeshElasticTrainer(pod.stores[r], MeshGroup(4),
+                                  _build, str(tmp_path), name='pod2')
+               for r in range(4)]
+    ts, done, errors, host_died = _launch(drivers, N_STEPS)
+    assert pod.wait_parked('pre', 2, {0, 1, 2})
+    pod.kick(3)
+    # rank 2's 10th send is the pre-3 barrier on the re-formed mesh
+    # (reform + rejoin cost it sends 6-7, step 2 pre/post 8-9)
+    assert pod.wait_parked('pre', 3, {0, 1})
+    pod.kick(2)
+    for t in ts:
+        t.join(300)
+    assert not any(t.is_alive() for t in ts), 'pod hung'
+    assert not errors, errors
+    assert faults.injected()['kill_host'] == 2
+    assert sorted(done) == [(0, N_STEPS), (1, N_STEPS)]
+    d0 = drivers[0]
+    assert list(d0.group.live) == [0, 1]
+    assert d0.committed == N_STEPS - 1
+    w = d0._state['params']['weight'].data()._data
+    assert len(w.sharding.device_set) == 4
+    # joins(4) + two ejections
+    assert pod.stores[0].mesh_table() == {'gen': 6, 'members': [0, 1]}
+    for d in drivers:
+        d.close()
+
+
+def test_below_min_workers_halts_typed(pod, tmp_path):
+    """Under the MXNET_ELASTIC_MIN_WORKERS floor the pod halts with the
+    TYPED ElasticHalted on every survivor — never a hang, never a
+    silent small-mesh run."""
+    faults.configure('kill_host:elastic_barrier:5:rank=3')
+    drivers = [MeshElasticTrainer(pod.stores[r], MeshGroup(4),
+                                  _build, str(tmp_path),
+                                  min_workers=4, name='floor')
+               for r in range(4)]
+    ts, done, errors, host_died = _launch(drivers, N_STEPS)
+    assert pod.wait_parked('pre', 2, {0, 1, 2})
+    pod.kick(3)
+    for t in ts:
+        t.join(300)
+    assert not any(t.is_alive() for t in ts), 'pod hung'
+    assert host_died.is_set() and not done
+    assert len(errors) == 3
+    assert all(isinstance(e, ElasticHalted) for _, e in errors), errors
+    for d in drivers:
+        d.close()
+
+
+# --------------------------------------------- host-level fault rules
+def test_kvstore_host_fault_rules_parse_and_fire():
+    """``kill_host`` (one-shot, rank-scoped: the whole emulated host
+    dies) and ``partition`` (hits N..N+M-1 lost, then heals) are
+    count-based and deterministic."""
+    from mxnet_tpu.kvstore.faults import (FaultPlan, FaultSpecError,
+                                          InjectedHostDeath,
+                                          InjectedWorkerDeath)
+    plan = FaultPlan(
+        'kill_host:elastic_barrier:3:rank=2;partition:push:2:2')
+    hdr = {'cmd': 'elastic_barrier', 'rank': 2}
+    other = {'cmd': 'elastic_barrier', 'rank': 1}
+    plan.on_send(other)                  # other ranks never match
+    plan.on_send(hdr)
+    plan.on_send(hdr)
+    with pytest.raises(InjectedHostDeath) as ei:
+        plan.on_send(hdr)                # rank 2's 3rd matching send
+    # a subclass of InjectedWorkerDeath: every existing worker-death
+    # handler (test harnesses, drivers) treats it correctly for free
+    assert isinstance(ei.value, InjectedWorkerDeath)
+    plan.on_send(hdr)                    # fires ONCE — rule is spent
+    assert plan.counts['kill_host'] == 1
+
+    p = {'cmd': 'push', 'rank': 0}
+    plan.on_send(p)                      # hit 1: before the window
+    for _ in range(2):                   # hits 2..3: link is cut
+        with pytest.raises(ConnectionResetError):
+            plan.on_send(p)
+    plan.on_send(p)                      # hit 4: healed
+    assert plan.counts['partition'] == 2
+
+    with pytest.raises(FaultSpecError):
+        FaultPlan('kill_host:push:0')
+    with pytest.raises(FaultSpecError):
+        FaultPlan('partition:push:0:2')
+
+
+def test_serve_host_fault_rules_parse_and_fire():
+    """Serve-side ``kill_host`` on the ``device`` probe: PERSISTENT
+    from the N-th hit (dead devices stay dead until the plan is
+    cleared), scoped to one named replica."""
+    from mxnet_tpu.serve.faults import (FaultPlan, FaultSpecError,
+                                        HostDeathInjected)
+    plan = FaultPlan('kill_host:device@r1:2')
+    plan.on('device', scope='r0')        # other replicas unaffected
+    plan.on('device', scope='r1')        # hit 1: below threshold
+    for _ in range(3):
+        with pytest.raises(HostDeathInjected):
+            plan.on('device', scope='r1')
+    plan.on('device', scope='r0')
+    assert plan.counts['kill_host'] == 3
+    # ConnectionError: the RPC layer treats it as a dead endpoint, so
+    # the replica latches unhealthy instead of replying ok: False
+    assert isinstance(HostDeathInjected('x'), ConnectionError)
+    with pytest.raises(FaultSpecError):
+        FaultPlan('kill_host:device:0')
+
+
+# ------------------------------------------- race-checked re-formation
+def test_reformation_clean_under_race_check():
+    """The whole kill/eject/re-form path once under MXNET_RACE_CHECK=1
+    in a child pytest: the instrumented store/barrier locks must show
+    no lockset violation or lock-order cycle while four host threads
+    re-form the mesh."""
+    env = dict(os.environ)
+    env['MXNET_RACE_CHECK'] = '1'
+    env['JAX_PLATFORMS'] = 'cpu'
+    r = subprocess.run(
+        [sys.executable, '-m', 'pytest', '-q', '-x',
+         '-p', 'no:cacheprovider',
+         os.path.join(REPO, 'tests',
+                      'test_mesh_elastic.py::'
+                      'test_single_death_reforms_bit_exact')],
+        capture_output=True, text=True, timeout=480, cwd=REPO, env=env)
+    assert r.returncode == 0, (
+        f'mesh re-formation fails under MXNET_RACE_CHECK=1:\n'
+        f'{r.stdout[-6000:]}\n{r.stderr[-2000:]}')

@@ -1,11 +1,13 @@
 """tools/multichip_bench.py --smoke, in process (tier-1).
 
 The bench is the executable form of the multi-chip acceptance
-criteria: an unmodified resnet18 trains FSDP- and TP-sharded and an
-unmodified llama_tiny decodes under a dp x tp mesh, with zero
-recompiles after warmup and the donation audit clean on the sharded
-program. Running it here keeps ``MULTICHIP_r06.json`` reproducible
-from a plain checkout.
+criteria: an unmodified resnet18 trains FSDP- and TP-sharded, an
+unmodified llama_tiny decodes under a dp x tp mesh, and TWO dp x tp
+sharded replicas serve behind the router — zero recompiles after
+warmup, donation audit clean on every sharded program, zero failed
+requests. Running it here keeps ``MULTICHIP_r07.json`` reproducible
+from a plain checkout (the committed artifact is the ``--chaos`` run;
+the smoke skips the chaos rounds for time).
 """
 
 import json
@@ -36,7 +38,7 @@ def test_smoke_emits_artifact(tmp_path):
 
     # the artifact round-trips and carries every promised field
     saved = json.loads(out.read_text())
-    assert saved['round'] == 'r06'
+    assert saved['round'] == 'r07'
 
     train = saved['train']
     assert train['mode'] == 'fsdp' and train['mesh'] == {'dp': 8}
@@ -58,9 +60,20 @@ def test_smoke_emits_artifact(tmp_path):
     assert decode['pool_spec'].startswith("PartitionSpec('dp'")
     assert decode['predicted_step_seconds'] > 0
 
-    # the r05 baseline rides along for side-by-side reading
+    # the pod serving shape: 2 sharded replicas behind the router,
+    # zero failed requests, zero recompiles, donation clean fleet-wide
+    router = saved['router']
+    assert router['replicas'] == 2
+    assert router['mesh_each'] == {'dp': 2, 'tp': 2}
+    assert router['failed_requests'] == 0
+    assert router['recompiles_after_warmup'] == 0
+    for d in router['donation']:
+        assert d['aliased_args'] == d['donated_args'], d
+    assert sum(router['routed'].values()) == router['requests']
+
+    # the r06 baseline rides along for side-by-side reading
     base = saved['baseline']
-    assert base['file'] == 'MULTICHIP_r05.json'
+    assert base['file'] == 'MULTICHIP_r06.json'
     if base['found']:
         assert base['n_devices'] == saved['n_devices'] == 8
         assert base['ok'] is True

@@ -181,7 +181,7 @@ def test_fault_stall_expires_queued_deadline():
             fb.result(1)
         assert serve.faults.injected() == {'stall': 1, 'error': 0,
                                            'crash': 0, 'partition': 0,
-                                           'total': 1}
+                                           'kill_host': 0, 'total': 1}
     finally:
         serve.faults.clear()
         b.close()

@@ -1,0 +1,125 @@
+"""Sharded replicas behind the router (ISSUE 19, serving half).
+
+Each ``Replica`` hosts a dp x tp sharded ``DecodeServer`` over its own
+half of the 8-device CPU mesh (the pod-emulation analogue of one
+multi-chip host). The router treats the mesh as a registration-record
+detail: health carries it, routing ignores it, and replica-internal
+device loss surfaces as an unhealthy replica — failover + eject, never
+a hung or failed client request.
+"""
+
+import jax
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo.llama import llama_tiny
+from mxnet_tpu.serve import Replica, Router
+from mxnet_tpu.serve import faults as sfaults
+from mxnet_tpu.serve.errors import ReplicaUnhealthy
+from mxnet_tpu.sharding.context import MeshGroup
+
+SERVER_KW = dict(slots=2, max_length=32, page_size=4, prefill_chunk=8)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason='needs the 8-device CPU mesh')
+
+
+def _factory(version):
+    # same seed on both replicas: identical weights, so failover token
+    # parity is a hard assertion, not a statistical one
+    mx.random.seed(7)
+    net = llama_tiny()
+    net.initialize()
+    net(mx.np.zeros((1, 2)))
+    return net
+
+
+@pytest.fixture(scope='module')
+def replicas():
+    group = MeshGroup(2)        # 2 emulated hosts x 4 devices each
+    reps = [Replica(f'r{i}', _factory, server_kw=SERVER_KW,
+                    mesh={'dp': 2, 'tp': 2,
+                          'devices': list(group.devices_for(i))})
+            for i in range(2)]
+    yield reps
+    sfaults.clear()
+    for rep in reps:
+        try:
+            rep.close(drain=False)
+        except Exception:
+            pass
+
+
+@pytest.fixture(autouse=True)
+def _clean(replicas):
+    yield
+    sfaults.clear()
+    for rep in replicas:
+        rep.heal()
+
+
+def _router(replicas, **kw):
+    kw.setdefault('start', False)
+    kw.setdefault('rpc_deadline_s', 60.0)
+    return Router(replicas, **kw)
+
+
+def test_sharded_replica_mesh_record(replicas):
+    """The mesh config is part of the registration record: the replica
+    reports it, heartbeats refresh it, and router health exposes it."""
+    for rep in replicas:
+        assert rep.mesh == {'axes': {'dp': 2, 'tp': 2},
+                            'n_devices': 4, 'mode': 'tp'}
+        assert rep.healthy
+    with _router(replicas) as r:
+        assert r.heartbeat_once() == []
+        h = r.health()
+        for name in ('r0', 'r1'):
+            assert h[name]['mesh']['axes'] == {'dp': 2, 'tp': 2}
+            assert h[name]['healthy']
+        toks = r.generate([1, 2, 3], max_new_tokens=4)
+        assert len(toks) == 4
+    # decoding across both sharded replicas never recompiled
+    assert all(rep.server.stats()['recompiles'] == 0 for rep in replicas)
+
+
+def test_device_loss_ejects_replica_not_request(replicas):
+    """Host-level device loss inside one replica: the heartbeat's
+    device probe latches it unhealthy -> immediate eject (no deadline
+    wait), traffic fails over with zero client-visible failures, and
+    the replica is re-admitted once healed."""
+    ref = replicas[0].server.generate_sync([5, 6, 7], max_new_tokens=4)
+    sfaults.configure('kill_host:device@r1')
+    with _router(replicas) as r:
+        events = r.heartbeat_once()
+        assert ('eject', 'r1') in events
+        assert not r.health()['r1']['healthy']
+        got = [r.generate([5, 6, 7], max_new_tokens=4) for _ in range(3)]
+        assert got == [ref] * 3                # zero failed requests
+        assert r.health()['r0']['routed'] == 3
+        # heal: clear the fault, replica recovers, next sweep readmits
+        sfaults.clear()
+        replicas[1].heal()
+        events = r.heartbeat_once()
+        assert ('readmit', 'r1') in events
+        assert r.health()['r1']['healthy']
+    assert r.stats()['rejected'] == 0
+
+
+def test_unhealthy_latched_between_sweeps_fails_over(replicas):
+    """A replica that latched unhealthy BETWEEN heartbeat sweeps (the
+    router still believes it healthy) refuses with a typed
+    ``ReplicaUnhealthy`` — the router treats that as a failover signal,
+    not a client-visible rejection."""
+    # ties in the load table break by name -> r0 is tried first
+    replicas[0].mark_unhealthy('injected device loss')
+    with _router(replicas) as r:
+        before = r.stats()
+        toks = r.generate([1, 2, 3], max_new_tokens=4)
+        assert len(toks) == 4                  # served by r1
+        st = r.stats()
+        assert st['failovers'] == before['failovers'] + 1
+        assert st['rejected'] == before['rejected']
+    # and the refusal itself is typed for direct callers
+    with pytest.raises(ReplicaUnhealthy):
+        replicas[0].apply_submit([1, 2, 3], 4, None, 30.0)

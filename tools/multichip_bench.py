@@ -19,22 +19,36 @@ runs, but timed and written down as a regression artifact:
   and that the donation audit proves every page buffer aliases on the
   SHARDED program, and records the per-device predicted costs of the
   sharded forward.
+* **router**: the pod serving shape — TWO dp=2 x tp=2 sharded replicas,
+  each on its own 4-device half of the mesh (``MeshGroup(2)``), behind
+  a :class:`Router`. A request round must complete with zero failures
+  and zero post-prewarm recompiles, every page buffer aliasing on both
+  replicas' sharded programs. With ``--chaos``, a ``kill_host`` rule on
+  one replica's device probe then ejects it on the next heartbeat and
+  the round repeats on the survivor — still zero failed requests — and
+  the healed replica is re-admitted.
+* **chaos_train** (``--chaos``): the pod training shape — a 4-host
+  ``MeshElasticTrainer`` run where host 3 is killed mid-run by a
+  count-based fault rule; the mesh re-forms at the last committed step
+  on the 3 survivors (6 devices) and the final params must be
+  BIT-EXACT vs an inline planned scale-down through the same
+  save/restore path.
 
 The mesh is real: the module forces
 ``--xla_force_host_platform_device_count=8`` BEFORE jax is imported
 (the ``tools/launch.py`` trick), so the CLI works on a plain CPU box.
 Under pytest the conftest has already done it.
 
-Output: ``MULTICHIP_r06.json`` (``--out``), echoed as one JSON line on
-stdout. The document embeds the ``MULTICHIP_r05.json`` baseline for
-comparison: r05 was a *dry-run* pipeline-config audit (dp=1 pp=2 tp=2
-sp=2, predicted 20% pipeline-bubble waste); r06 is the first round
-where an actual GSPMD-sharded program runs on all 8 devices. Exits
-nonzero if any section's invariant fails, so the bench doubles as an
-end-to-end check.
+Output: ``MULTICHIP_r07.json`` (``--out``), echoed as one JSON line on
+stdout. The document embeds the ``MULTICHIP_r06.json`` baseline for
+comparison: r06 introduced the single-process sharded program; r07 is
+the first round exercising the pod layer — sharded replicas behind the
+router and host-failure-tolerant elastic training. Exits nonzero if
+any section's invariant fails, so the bench doubles as an end-to-end
+check.
 
 Run:
-  python tools/multichip_bench.py             # full (MULTICHIP_r06.json)
+  python tools/multichip_bench.py --chaos     # full (MULTICHIP_r07.json)
   python tools/multichip_bench.py --smoke     # tier-1 smoke (seconds)
 """
 
@@ -258,26 +272,362 @@ def bench_decode(args):
     }, errors
 
 
-def _baseline(path):
-    """Embed the r05 artifact for side-by-side reading.
+def bench_router(args, chaos=False):
+    """Two dp x tp sharded replicas behind the router (+ serve chaos)."""
+    import random
 
-    r05 predates the sharding subsystem: a dry-run config audit
-    (dp=1 pp=2 tp=2 sp=2) that never placed an array. r06 runs the
-    real GSPMD program, so only the invariants (8 devices, ok) carry
-    over as a comparison.
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.llama import llama_tiny
+    from mxnet_tpu.serve import Replica, Router
+    from mxnet_tpu.serve import faults as sfaults
+    from mxnet_tpu.sharding.context import MeshGroup
+
+    errors = []
+
+    def factory(version):
+        # same seed on every replica: identical weights, so failover
+        # token parity is a hard assertion, not a statistical one
+        mx.random.seed(7)
+        net = llama_tiny()
+        net.initialize()
+        net(mx.np.zeros((1, 2)))
+        return net
+
+    group = MeshGroup(2)            # 2 emulated hosts x 4 devices each
+    server_kw = dict(slots=args.slots, max_length=args.max_length,
+                     page_size=args.page_size, num_pages=args.num_pages,
+                     prefill_chunk=args.prefill_chunk)
+    t0 = time.perf_counter()
+    reps = [Replica(f'r{i}', factory, server_kw=server_kw,
+                    mesh={'dp': 2, 'tp': 2,
+                          'devices': list(group.devices_for(i))})
+            for i in range(2)]
+    warm_s = time.perf_counter() - t0
+    router = Router(reps, start=False, rpc_deadline_s=120.0)
+    try:
+        router.heartbeat_once()
+        health = router.health()
+        for name, h in health.items():
+            if not h['mesh'] or h['mesh']['axes'] != {'dp': 2, 'tp': 2}:
+                errors.append(f'router: {name} mesh record wrong: '
+                              f'{h["mesh"]}')
+
+        rnd = random.Random(0)
+
+        def one_round(n, tag):
+            failed = 0
+            toks = 0
+            t0 = time.perf_counter()
+            for i in range(n):
+                plen = rnd.randint(2, args.max_prompt)
+                prompt = [rnd.randrange(256) for _ in range(plen)]
+                try:
+                    toks += len(router.generate(
+                        prompt, max_new_tokens=args.new_tokens))
+                except Exception as e:
+                    failed += 1
+                    errors.append(f'router: {tag} request {i} failed: '
+                                  f'{e!r}')
+            return failed, toks, time.perf_counter() - t0
+
+        failed, toks, wall = one_round(args.router_requests, 'steady')
+        recompiles = sum(rep.server.stats()['recompiles']
+                         for rep in reps)
+        if recompiles:
+            errors.append(f'router: {recompiles} recompile(s) after '
+                          'warmup across the fleet')
+        donation = []
+        for rep in reps:
+            audit = rep.server.audit_donation()
+            st = audit.stats
+            donation.append({'replica': rep.name,
+                             'aliased_args': st['aliased_args'],
+                             'donated_args': st['donated_args']})
+            if st['aliased_args'] != st['donated_args']:
+                errors.append(f'router: {rep.name} donation audit '
+                              'not clean on the sharded program')
+
+        doc = {
+            'replicas': 2, 'mesh_each': {'dp': 2, 'tp': 2},
+            'devices_each': group.devices_per_proc,
+            'warmup_s': round(warm_s, 2),
+            'requests': args.router_requests,
+            'failed_requests': failed,
+            'tok_s': round(toks / wall, 2) if wall else None,
+            'recompiles_after_warmup': recompiles,
+            'donation': donation,
+            'routed': {n: h['routed']
+                       for n, h in router.health().items()},
+        }
+
+        if chaos:
+            # host-level device loss on r1: the heartbeat's device
+            # probe latches it unhealthy -> immediate eject, traffic
+            # fails over with zero client-visible failures
+            sfaults.configure('kill_host:device@r1')
+            events = router.heartbeat_once()
+            if ('eject', 'r1') not in events:
+                errors.append(f'router-chaos: no eject event ({events})')
+            c_failed, c_toks, c_wall = one_round(
+                args.router_requests, 'chaos')
+            sfaults.clear()
+            reps[1].heal()
+            readmit = router.heartbeat_once()
+            if ('readmit', 'r1') not in readmit:
+                errors.append(
+                    f'router-chaos: no readmission ({readmit})')
+            doc['chaos'] = {
+                'rule': 'kill_host:device@r1',
+                'ejected': [n for ev, n in events if ev == 'eject'],
+                'requests': args.router_requests,
+                'failed_requests': c_failed,
+                'tok_s': round(c_toks / c_wall, 2) if c_wall else None,
+                'readmitted': [n for ev, n in readmit
+                               if ev == 'readmit'],
+                'router_counters': router.stats(),
+            }
+    finally:
+        sfaults.clear()
+        router.close()
+        for rep in reps:
+            try:
+                rep.close(drain=False)
+            except Exception:
+                pass
+    return doc, errors
+
+
+def bench_chaos_train(args):
+    """4-host elastic pod run with a mid-run host kill (``--chaos``)."""
+    import socket
+    import tempfile
+    import threading
+    from contextlib import closing
+
+    import jax
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, kvstore, sharding
+    from mxnet_tpu.kvstore import dist_async, faults
+    from mxnet_tpu.parallel.checkpoint import SharedCheckpointManager
+    from mxnet_tpu.sharding.context import MeshGroup
+    from mxnet_tpu.train import ElasticTrainer, MeshElasticTrainer
+
+    def _free_port():
+        with closing(socket.socket()) as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    n_steps, lr, errors = args.chaos_steps, 0.1, []
+
+    def one_step(net, tr, s):
+        x = mx.np.array(
+            onp.random.RandomState(s).randn(24, 8).astype('f'))
+        y = mx.np.array(
+            onp.random.RandomState(1000 + s).randn(24, 48).astype('f'))
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        tr.step(24)
+
+    def build(ctx):
+        # warmup one train step (mesh placement happens in the
+        # optimizer update), then roll the init values back through
+        # the sticky sharded set_data and hand out a fresh stateless
+        # trainer — pristine weights, mesh-placed
+        mx.random.seed(0)
+        net = gluon.nn.Dense(48, in_units=8)
+        net.initialize()
+        net.hybridize()
+        params = dict(net.collect_params())
+        init = {n: p.data().asnumpy().copy() for n, p in params.items()}
+        tr = gluon.Trainer(params, 'sgd', {'learning_rate': lr})
+        one_step(net, tr, 0)
+        for n, p in params.items():
+            p.set_data(mx.np.array(init[n]))
+        tr = gluon.Trainer(params, 'sgd', {'learning_rate': lr})
+        return {'params': params, 'trainer': tr,
+                'step': lambda s: one_step(net, tr, s)}
+
+    env_keys = ('MX_COORDINATOR', 'MXNET_KVSTORE_ASYNC_PORT',
+                'MXNET_KVSTORE_HEARTBEAT_S', 'MXNET_KVSTORE_DEADLINE_S',
+                'MX_NPROC', 'MX_PROC_ID')
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    port = _free_port()
+    os.environ['MX_COORDINATOR'] = f'127.0.0.1:{_free_port()}'
+    os.environ['MXNET_KVSTORE_ASYNC_PORT'] = str(port)
+    os.environ['MXNET_KVSTORE_HEARTBEAT_S'] = '3600'
+    os.environ['MXNET_KVSTORE_DEADLINE_S'] = '60'
+    os.environ['MX_NPROC'] = '4'
+    stores, drivers = [], []
+    try:
+        ckpt = tempfile.mkdtemp(prefix='mesh-bench-')
+        for r in range(4):
+            os.environ['MX_PROC_ID'] = str(r)
+            stores.append(kvstore.create('dist_async'))
+        stores[0]._ensure_connected()
+        srv = dist_async._SERVERS[port]
+        clk0 = time.monotonic()
+        kick = [False]
+        # fake liveness clock: once armed, rank 3 (dead, silent) looks
+        # 100s stale (> the 60s deadline); live ranks keep heartbeating
+        # at clk0+1 via their RPCs, and the condition auto-reverts after
+        # the ejection so laggards never look silent
+        srv.set_clock(lambda: clk0 + (
+            100.0 if kick[0] and 3 in srv._elastic_members else 1.0))
+        # 5th elastic_barrier send of rank 3 = pre-barrier of step 2:
+        # steps 0-1 commit, the host dies mid-run
+        faults.configure('kill_host:elastic_barrier:5:rank=3')
+        group = MeshGroup(4)
+        drivers = [MeshElasticTrainer(stores[r], group, build, ckpt,
+                                      name='bench-pod')
+                   for r in range(4)]
+        run_errors, done, host_died = [], [], threading.Event()
+
+        def run(i):
+            try:
+                done.append((i, drivers[i].run(n_steps)))
+            except faults.InjectedHostDeath:
+                host_died.set()
+            except BaseException as e:
+                run_errors.append((i, repr(e)))
+
+        ts = [threading.Thread(target=run, args=(i,), daemon=True)
+              for i in range(4)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        # arm the kick only once every survivor is parked at the
+        # pre-2 barrier (arrivals do not notify the cv: poll)
+        while time.perf_counter() - t0 < 300:
+            with srv._elastic_cv:
+                if srv._elastic_arrivals.get(('pre', 2),
+                                             set()) == {0, 1, 2}:
+                    kick[0] = True
+                    break
+            time.sleep(0.02)
+        for t in ts:
+            t.join(300)
+        wall = time.perf_counter() - t0
+        faults_hit = faults.injected()
+        faults.clear()
+        if run_errors or not host_died.is_set() or len(done) != 3:
+            errors.append(f'chaos_train: run failed: errors={run_errors} '
+                          f'died={host_died.is_set()} done={done}')
+            return {'ok': False}, errors
+        d0 = drivers[0]
+        desc = d0.group.describe()
+        final = {n: p.data().asnumpy().copy()
+                 for n, p in d0._state['params'].items()}
+        w = d0._state['params']['weight'].data()._data
+        if list(d0.group.live) != [0, 1, 2]:
+            errors.append(f'chaos_train: live {d0.group.live}')
+        if d0.committed != n_steps - 1:
+            errors.append(f'chaos_train: committed {d0.committed}')
+        if len(w.sharding.device_set) != 6:
+            errors.append('chaos_train: weight not resharded onto the '
+                          '6 surviving devices')
+
+        # stale-generation fence: the dead rank's store must be
+        # rejected typed, not silently applied
+        from mxnet_tpu.kvstore.rpc import StaleGeneration
+        stale_ok = False
+        try:
+            stores[3].init('stale-probe', onp.zeros(4, 'f'))
+        except StaleGeneration:
+            stale_ok = True
+        if not stale_ok:
+            errors.append('chaos_train: stale push was not rejected')
+
+        # bit-exact reference: an inline PLANNED scale-down through the
+        # same save/restore/reshard path (full mesh to the committed
+        # step, restore on the 6-device mesh, run to the end)
+        ref_dir = tempfile.mkdtemp(prefix='mesh-ref-')
+        with sharding.mesh(dp=8):
+            st = build(None)
+            for s in range(2):
+                st['step'](s)
+            et = ElasticTrainer(st['params'], st['trainer'],
+                                SharedCheckpointManager(ref_dir),
+                                name='bench-ref8', async_save=False)
+            et.save(1, block=True)
+            et.close()
+        bit_exact = True
+        with sharding.mesh(dp=6, devices=jax.devices()[:6]):
+            st2 = build(None)
+            et2 = ElasticTrainer(st2['params'], st2['trainer'],
+                                 SharedCheckpointManager(ref_dir),
+                                 name='bench-ref6', async_save=False)
+            et2.restore()
+            for s in range(2, n_steps):
+                st2['step'](s)
+            et2.close()
+            for n, p in st2['params'].items():
+                if not (final[n] == p.data().asnumpy()).all():
+                    bit_exact = False
+                    errors.append(f'chaos_train: {n} diverged from the '
+                                  'planned scale-down reference')
+        return {
+            'hosts': 4, 'devices': 8, 'steps': n_steps,
+            'killed': {'rank': 3, 'rule': 'kill_host:elastic_barrier:5'
+                                          ':rank=3'},
+            'survivors': desc['live'],
+            'generation': desc['generation'],
+            'committed_at_kill': 1,
+            'committed_final': d0.committed,
+            'final_weight_devices': len(w.sharding.device_set),
+            'stale_push_rejected': stale_ok,
+            'bit_exact_vs_scale_down': bit_exact,
+            'kill_host_fired': faults_hit.get('kill_host', 0),
+            'wall_s': round(wall, 2),
+        }, errors
+    finally:
+        faults.clear()
+        for d in drivers:
+            try:
+                d.close()
+            except Exception:
+                pass
+        for kv in stores:
+            try:
+                kv.close()
+            except Exception:
+                pass
+        srv = dist_async._SERVERS.pop(port, None)
+        if srv is not None:
+            srv.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _baseline(path):
+    """Embed the r06 artifact for side-by-side reading.
+
+    r06 was the first round running a real GSPMD-sharded program on
+    the 8-device mesh, single process, single failure domain. r07 adds
+    the pod layer on top — the train/decode numbers carry over as the
+    regression comparison.
     """
     if not os.path.exists(path):
         return {'file': os.path.basename(path), 'found': False}
     with open(path) as f:
         doc = json.load(f)
-    return {'file': os.path.basename(path), 'found': True,
-            'n_devices': doc.get('n_devices'), 'ok': doc.get('ok'),
-            'note': 'dry-run pipeline-config audit (no arrays placed); '
-                    'r06 is the first round running a real sharded '
-                    'program on the mesh'}
+    out = {'file': os.path.basename(path), 'found': True,
+           'n_devices': doc.get('n_devices'), 'ok': doc.get('ok'),
+           'note': 'single-process sharded train/decode; r07 adds the '
+                   'pod layer (sharded replicas behind the router, '
+                   'host-failure-tolerant elastic training)'}
+    if 'train' in doc:
+        out['train_steps_s'] = doc['train'].get('steps_s')
+        out['decode_tok_s'] = doc['decode'].get('tok_s')
+    return out
 
 
-def run_bench(smoke=False, out=None):
+def run_bench(smoke=False, out=None, chaos=False):
     """Run all sections; returns ``(doc, rc)`` and writes ``out``."""
     import jax
 
@@ -295,6 +645,8 @@ def run_bench(smoke=False, out=None):
         args.max_prompt = 12
         args.prompts = 2
         args.new_tokens = 4
+        args.router_requests = 2
+        args.chaos_steps = 4
     else:
         args.image_size = 32
         args.batch = 16
@@ -308,30 +660,41 @@ def run_bench(smoke=False, out=None):
         args.max_prompt = 32
         args.prompts = 12
         args.new_tokens = 16
+        args.router_requests = 8
+        args.chaos_steps = 4
 
     n = len(jax.devices())
     errors = []
     if n < N_DEVICES:
         errors.append(f'only {n} devices (need {N_DEVICES})')
-        doc = {'round': 'r06', 'ok': False, 'n_devices': n,
+        doc = {'round': 'r07', 'ok': False, 'n_devices': n,
                'errors': errors}
     else:
         train, e1 = bench_train(args)
         train_tp, e2 = bench_train_tp(args)
         decode, e3 = bench_decode(args)
-        errors = e1 + e2 + e3
+        router, e4 = bench_router(args, chaos=chaos)
+        errors = e1 + e2 + e3 + e4
         doc = {
-            'round': 'r06',
+            'round': 'r07',
             'config': 'smoke' if smoke else 'full',
+            'chaos': bool(chaos),
             'n_devices': n,
             'ok': not errors,
             'train': train,
             'train_tp': train_tp,
             'decode': decode,
+            'router': router,
             'baseline': _baseline(
-                os.path.join(ROOT, 'MULTICHIP_r05.json')),
+                os.path.join(ROOT, 'MULTICHIP_r06.json')),
             'errors': errors,
         }
+        if chaos:
+            chaos_train, e5 = bench_chaos_train(args)
+            doc['chaos_train'] = chaos_train
+            errors.extend(e5)
+            doc['errors'] = errors
+            doc['ok'] = not errors
     if out:
         with open(out, 'w') as f:
             json.dump(doc, f, indent=1)
@@ -347,10 +710,14 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     ap.add_argument('--smoke', action='store_true',
                     help='tiny config for the tier-1 CI smoke')
+    ap.add_argument('--chaos', action='store_true',
+                    help='add the fault rounds: device loss behind the '
+                         'router + the 4-host elastic kill/re-form run')
     ap.add_argument('--out', default=os.path.join(ROOT,
-                                                  'MULTICHIP_r06.json'))
+                                                  'MULTICHIP_r07.json'))
     args = ap.parse_args()
-    doc, rc = run_bench(smoke=args.smoke, out=args.out)
+    doc, rc = run_bench(smoke=args.smoke, out=args.out,
+                        chaos=args.chaos)
     line = {'ok': doc['ok'], 'n_devices': doc['n_devices'],
             'out': args.out}
     if 'train' in doc:
@@ -360,7 +727,11 @@ def main():
             'train_recompiles': doc['train']['recompiles_after_warmup'],
             'decode_tok_s': doc['decode']['tok_s'],
             'decode_recompiles': doc['decode']['recompiles'],
+            'router_failed': doc['router']['failed_requests'],
             'predicted_step_s': doc['train']['predicted_step_seconds']})
+    if 'chaos_train' in doc:
+        line['chaos_bit_exact'] = \
+            doc['chaos_train'].get('bit_exact_vs_scale_down')
     print(json.dumps(line))
     for e in doc.get('errors', ()):
         print(f'FAIL: {e}', file=sys.stderr)
