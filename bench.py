@@ -837,11 +837,18 @@ def _predicted_train_costs(args, mx):
     graph = analysis.trace_function(train_step, in_raws[0], params,
                                     tuple(aux), name='resnet50-train-step')
     cost = analysis.cost_of_graph(graph)
+    # fraction of bandwidth-bound-chain bytes owned by registered fused
+    # kernels (analysis.chain_coverage): a fused op silently falling
+    # back to an unattributed elementwise chain drops this number even
+    # when throughput drift hides in host noise (docs/kernels.md)
+    coverage, chain_bytes = analysis.chain_coverage(graph)
     return {
         'predicted_flops': cost.flops,
         'predicted_peak_hbm_bytes': cost.peak_hbm_bytes,
         'predicted_mfu_bound': cost.mfu_bound,
         'predicted_intensity_flop_per_byte': round(cost.intensity, 1),
+        'fused_kernel_coverage': round(coverage, 4),
+        'chain_bytes': int(chain_bytes),
     }
 
 

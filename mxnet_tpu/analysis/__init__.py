@@ -27,6 +27,7 @@ from .report import AnalysisReport, Finding, strict_enabled
 from .walker import GraphView, trace_block, trace_function, iter_eqns
 from . import rules
 from .rules import all_rules, run_rules
+from .rules.perf import chain_coverage
 from . import costs
 from .costs import CostReport, cost_of_graph
 from .device_specs import DEVICE_SPECS, get_device_spec
@@ -36,7 +37,7 @@ from . import race
 __all__ = ['lint', 'cost_report', 'AnalysisReport', 'Finding',
            'GraphView', 'CostReport', 'cost_of_graph', 'costs',
            'DEVICE_SPECS', 'get_device_spec', 'all_rules', 'rules',
-           'strict_enabled', 'locks', 'race']
+           'strict_enabled', 'locks', 'race', 'chain_coverage']
 
 
 def lint(fn_or_block, *example_args, train=False, rules=None,

@@ -147,6 +147,22 @@ def _cheap_body(eqn):
     return True
 
 
+def _fused_epilogue(deq, defs):
+    """True when the found dequantize equation is attributed to a
+    ``fused_kernel=True`` op AND, if its source is an int32 matmul
+    accumulator, that matmul shares the attribution — i.e. the scale
+    multiply already lives in the producing op's epilogue (one kernel
+    on TPU, one fused jaxpr region off-TPU)."""
+    dop = eqn_op(deq)
+    if dop is None or not getattr(dop, 'fused_kernel', False):
+        return False
+    src = deq.invars[0]
+    src_def = defs.get(id(src)) if isinstance(src, _core.Var) else None
+    if src_def is not None and src_def.primitive.name in _MATMULS:
+        return eqn_op(src_def) is dop
+    return True
+
+
 @register_rule('unfused-dequant')
 def unfused_dequant(graph, report, config):
     for jaxpr in iter_jaxprs(graph.jaxpr):
@@ -159,6 +175,15 @@ def unfused_dequant(graph, report, config):
                     continue
                 deq, crossed = _find_dequant(operand, defs)
                 if deq is None:
+                    continue
+                if _fused_epilogue(deq, defs):
+                    # scale-in-epilogue: the dequantize is part of a
+                    # registered fused-kernel op's body (int32 accum ->
+                    # scale -> cast inside quantized_dense & co) — the
+                    # fused form this rule exists to demand. Inline
+                    # unattributed dequants still fire (the planted-
+                    # finding dead-man's-switch in tests/test_perf_lint
+                    # proves it).
                     continue
                 dt = str(operand.aval.dtype)
                 if crossed or dt in ('int8', 'uint8'):
@@ -195,18 +220,15 @@ _FUSABLE = CHEAP_PRIMS | REDUCE_PRIMS | frozenset(
      'squeeze', 'expand_dims'))
 
 
-def _flush_chain(run, graph, report, config, jaxpr_depth, balance,
-                 min_eqns, min_bytes):
+def _chain_stats(run, balance, min_eqns, min_bytes):
+    """(flops, moved, intensity) when ``run`` qualifies as a
+    bandwidth-bound chain on the roofline thresholds — attribution to a
+    fused kernel is judged separately (``_chain_fused``) so coverage
+    accounting can see both sides. None otherwise."""
     compute = [e for e in run if e.primitive.name in CHEAP_PRIMS
                or e.primitive.name in REDUCE_PRIMS]
     if len(compute) < min_eqns:
-        return
-    # an op that already dispatches to a hand-fused kernel on TPU traces
-    # here as its XLA fallback chain — not a fusion target
-    for e in run:
-        op = eqn_op(e)
-        if op is not None and getattr(op, 'fused_kernel', False):
-            return
+        return None
     flops = 0
     moved = 0
     for e in run:
@@ -216,10 +238,71 @@ def _flush_chain(run, graph, report, config, jaxpr_depth, balance,
                      for v in (*e.invars, *e.outvars)
                      if isinstance(v, _core.Var))
     if moved < min_bytes:
-        return
+        return None
     intensity = flops / moved if moved else 0.0
     if intensity >= balance:
+        return None
+    return flops, moved, intensity
+
+
+def _chain_fused(run):
+    """True when any equation of the run is attributed to an op that
+    dispatches to a hand-fused kernel on TPU — the run traces here as
+    that op's XLA fallback chain, not a fusion target."""
+    for e in run:
+        op = eqn_op(e)
+        if op is not None and getattr(op, 'fused_kernel', False):
+            return True
+    return False
+
+
+def chain_coverage(graph, config=None):
+    """Fraction of bandwidth-bound-chain bytes covered by registered
+    fused kernels: chains are found exactly as the
+    ``bandwidth-bound-chain`` rule finds them, but chains attributed to
+    a ``fused_kernel=True`` op count as covered instead of exempt.
+    Returns (covered_bytes / total_chain_bytes, total_chain_bytes) —
+    (1.0, 0) for a graph with no qualifying chains. bench.py reports
+    this as ``fused_kernel_coverage`` so kernel regressions (a fused op
+    silently falling back to an unattributed chain) show up as a
+    coverage drop, not just throughput drift."""
+    config = config or {}
+    cost = cost_of_graph(graph)
+    balance = cost.machine_balance
+    min_eqns = int(config.get('bw_chain_min_eqns', 4) or 4)
+    min_bytes = int(config.get('bw_chain_min_bytes', 1 << 20) or 1 << 20)
+    covered = total = 0
+
+    def tally(run):
+        nonlocal covered, total
+        stats = _chain_stats(run, balance, min_eqns, min_bytes)
+        if stats is None:
+            return
+        _, moved, _ = stats
+        total += moved
+        if _chain_fused(run):
+            covered += moved
+
+    for jaxpr in iter_jaxprs(graph.jaxpr):
+        run = []
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in _FUSABLE:
+                run.append(eqn)
+                continue
+            tally(run)
+            run = []
+        tally(run)
+    return (covered / total if total else 1.0), total
+
+
+def _flush_chain(run, graph, report, config, jaxpr_depth, balance,
+                 min_eqns, min_bytes):
+    stats = _chain_stats(run, balance, min_eqns, min_bytes)
+    if stats is None:
         return
+    if _chain_fused(run):
+        return
+    flops, moved, intensity = stats
     run_ids = {id(v) for e in run for v in e.outvars}
     boundary = 0
     for e in run:

@@ -173,6 +173,17 @@ class LlamaAttention(HybridBlock):
                         k[:, 0].astype(k_cache.dtype))
                     v_cache = v_cache.at[pid, offset % psz].set(
                         v[:, 0].astype(v_cache.dtype))
+                    # decode reads the pool THROUGH the block table:
+                    # Pallas kernel walks pages[b, i] on TPU, the
+                    # original gather math runs off-TPU
+                    # (ops/contrib.py: paged_attention_decode)
+                    from ...ops.contrib import paged_attention_decode
+                    out = paged_attention_decode(
+                        q[:, 0], k_cache, v_cache, pages,
+                        jnp.asarray(offset, jnp.int32),
+                        sm_scale=self._dh ** -0.5)
+                    out = out.reshape(B, S, self._h * self._dh)
+                    return self.o_proj(NDArray(out)), (k_cache, v_cache)
                 else:
                     assert B == 1, 'chunked prefill fills one sequence'
                     pos = jnp.asarray(offset, jnp.int32) + jnp.arange(S)

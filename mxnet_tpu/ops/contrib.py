@@ -104,6 +104,65 @@ def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
                block_q=block_q, block_k=block_k)
 
 
+def _paged_attention_cost(eqn):
+    """Analytical cost for the paged decode kernel: QK^T + PV over every
+    table-mapped position, 4·B·H·L·dh flops with L = pages_per_seq ·
+    page_size (dense upper bound; the per-row <= offset mask isn't
+    visible in the eqn). Operand order of the pallas_call is
+    (pages, offset, q, k_pool, v_pool)."""
+    if eqn.primitive.name != 'pallas_call':
+        return None
+    b, kv, g, dh = eqn.outvars[0].aval.shape
+    np_ = eqn.invars[0].aval.shape[1]
+    psz = eqn.invars[3].aval.shape[1]
+    return 4 * b * kv * g * np_ * psz * dh
+
+
+@register('paged_attention_decode', f32_only=True, fused_kernel=True,
+          cost=_paged_attention_cost)
+def paged_attention_decode(q, k_pool, v_pool, pages, offset,
+                           sm_scale=None):
+    """One decode step of attention over a paged KV pool (vLLM-style).
+
+    q: (B, H, dh) — this step's queries, RoPE applied; k_pool/v_pool:
+    (num_pages, page_size, kv_heads, dh) global pools (already holding
+    this step's K/V, scattered by the caller); pages: (B, pages_per_seq)
+    int32 block table; offset: (B,) int32 absolute position of row b's
+    current token (row b attends logical positions <= offset[b]).
+
+    On TPU the int32 block table is walked INSIDE the kernel
+    (ops/pallas/paged_attention.py) — no gather, no (B, L) KV
+    materialization. Elsewhere this is the original gather math from
+    the llama paged branch, operation-for-operation, so decode tokens
+    are identical on CPU tier-1.
+    """
+    B, H, dh = q.shape
+    kv = k_pool.shape[2]
+    scale = (dh ** -0.5) if sm_scale is None else sm_scale
+    from .pallas import paged_attention as _pa
+    if _pa.use_pallas(q, k_pool):
+        # GQA grouping: q heads [j*G, (j+1)*G) share kv head j
+        qg = q.reshape(B, kv, H // kv, dh)
+        out = _pa.paged_attention_decode_pallas(
+            qg, k_pool, v_pool, pages, offset, scale)
+        return out.reshape(B, H, dh)
+    psz = k_pool.shape[1]
+    L = pages.shape[1] * psz
+    kf = k_pool[pages].reshape(B, L, kv, dh)
+    vf = v_pool[pages].reshape(B, L, kv, dh)
+    rep = H // kv
+    kf = jnp.repeat(kf, rep, 2) if rep > 1 else kf
+    vf = jnp.repeat(vf, rep, 2) if rep > 1 else vf
+    scores = jnp.einsum('bshd,blhd->bhsl', q[:, None].astype(jnp.float32),
+                        kf.astype(jnp.float32)) * scale
+    mask = jnp.arange(L)[None, :] <= offset[:, None]          # (B, L)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bhsl,blhd->bshd', probs,
+                     vf.astype(jnp.float32)).astype(q.dtype)
+    return out[:, 0]
+
+
 @register('multi_head_attention', fused_kernel=True,
           cost=_attention_pallas_cost)
 def multi_head_attention(q, k, v, num_heads, mask=None, dropout_p=0.0,

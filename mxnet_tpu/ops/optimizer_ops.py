@@ -34,6 +34,77 @@ def _prep(grad, weight, rescale_grad, clip_gradient, wd):
     return _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
 
 
+# ----------------------------------------------------- fused Pallas updates
+# (docs/kernels.md) The ops the in-repo Optimizer.step actually calls.
+# On TPU with lane-tileable f32 operands they lower to one pallas_call
+# (ops/pallas/fused_optimizer.py) with param/slot buffers aliased in
+# place; elsewhere they fall back to XLA math kept line-for-line
+# identical to the historical Adam.step / SGD.step, so numerics are
+# unchanged on every platform. Registered ``fused_kernel=True`` so the
+# bandwidth-bound-chain lint treats the update as already fused, and
+# with a closed-form ``cost=`` so the roofline model can price the
+# opaque pallas_call.
+
+def _elementwise_pallas_cost(flops_per_elem):
+    def cost(eqn):
+        if eqn.primitive.name != 'pallas_call':
+            return None
+        return flops_per_elem * eqn.outvars[0].aval.size
+    return cost
+
+
+# flops/element: prep(3: rescale+clip+wd) + moments(7) + bias(2) +
+# denom/update(6) — the closed form BENCH rows divide achieved time by
+_ADAM_FLOPS_PER_ELEM = 18
+_SGD_MOM_FLOPS_PER_ELEM = 7
+
+
+@register('fused_adam_step', n_out=3, fused_kernel=True,
+          cost=_elementwise_pallas_cost(_ADAM_FLOPS_PER_ELEM))
+def fused_adam_step(weight, grad, mean, var, lr=0.001, wd=0.0, t=1,
+                    beta1=0.9, beta2=0.999, epsilon=1e-8,
+                    rescale_grad=1.0, clip_gradient=None,
+                    correct_bias=True):
+    """One Adam step, (w, g, m, v) -> (w', m', v'). ``lr``/``wd``/``t``
+    may be traced scalars (LR schedules never recompile)."""
+    from .pallas import fused_optimizer as _fo
+    if _fo.use_pallas(weight, grad, mean, var):
+        return _fo.adam_step(
+            weight, grad, mean, var, lr, wd, t, beta1=beta1, beta2=beta2,
+            epsilon=epsilon, rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient, correct_bias=correct_bias)
+    g = grad * rescale_grad
+    if clip_gradient is not None:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * g * g
+    if correct_bias:
+        mhat = m / (1 - beta1 ** t)
+        vhat = v / (1 - beta2 ** t)
+    else:
+        mhat, vhat = m, v
+    return weight - lr * mhat / (jnp.sqrt(vhat) + epsilon), m, v
+
+
+@register('fused_sgd_mom_step', n_out=2, fused_kernel=True,
+          cost=_elementwise_pallas_cost(_SGD_MOM_FLOPS_PER_ELEM))
+def fused_sgd_mom_step(weight, grad, mom, lr=0.01, wd=0.0, momentum=0.0,
+                       rescale_grad=1.0, clip_gradient=None):
+    """One SGD-momentum step, (w, g, mom) -> (w', mom')."""
+    from .pallas import fused_optimizer as _fo
+    if _fo.use_pallas(weight, grad, mom):
+        return _fo.sgd_mom_step(
+            weight, grad, mom, lr, wd, momentum=momentum,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    g = grad * rescale_grad
+    if clip_gradient is not None:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
 # ------------------------------------------------------------------ sgd family
 
 @register('sgd_update')

@@ -10,6 +10,16 @@ Kernels fall back to pure-XLA implementations off-TPU (and under
 ``interpret=True`` in CPU CI), so the op surface is identical everywhere.
 """
 
+# submodules first; the kernel entry points that don't collide with
+# their module's name are lifted to the package level. int8_matmul's
+# entry point keeps its module path (ops.pallas.int8_matmul.int8_matmul)
+# — re-exporting the function here would shadow the submodule and break
+# `from .pallas import int8_matmul as _im` consumers
+from . import fused_optimizer, int8_matmul, paged_attention  # noqa: F401
 from .flash_attention import flash_attention
+from .fused_optimizer import adam_step, sgd_mom_step
+from .paged_attention import paged_attention_decode_pallas
 
-__all__ = ['flash_attention']
+__all__ = ['flash_attention', 'adam_step', 'sgd_mom_step',
+           'fused_optimizer', 'int8_matmul', 'paged_attention',
+           'paged_attention_decode_pallas']

@@ -257,12 +257,16 @@ class SGD(Optimizer):
         return None
 
     def step(self, w, g, state, lr, wd, t):
-        g = self._prep(g) + wd * w
         if self.momentum == 0.0:
+            g = self._prep(g) + wd * w
             return _sgd_step(w, g, lr), None
-        mom = state._data
-        new_mom = self.momentum * mom - lr * g
-        return w + new_mom, new_mom
+        # fused update op: one pallas_call on TPU (slots aliased in
+        # place), line-identical XLA math elsewhere
+        from ..ops.optimizer_ops import fused_sgd_mom_step
+        return fused_sgd_mom_step(
+            w, g, state._data, lr=lr, wd=wd, momentum=self.momentum,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient)
 
 
 @jax.jit
@@ -301,16 +305,16 @@ class Adam(Optimizer):
         return (_zeros_like_nd(weight), _zeros_like_nd(weight))
 
     def step(self, w, g, state, lr, wd, t):
-        g = self._prep(g) + wd * w
-        m, v = state[0]._data, state[1]._data
-        m = self.beta1 * m + (1 - self.beta1) * g
-        v = self.beta2 * v + (1 - self.beta2) * g * g
-        if self.correct_bias:
-            mhat = m / (1 - self.beta1 ** t)
-            vhat = v / (1 - self.beta2 ** t)
-        else:
-            mhat, vhat = m, v
-        return w - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+        # fused update op: one pallas_call on TPU (slots aliased in
+        # place), line-identical XLA math elsewhere
+        from ..ops.optimizer_ops import fused_adam_step
+        new_w, m, v = fused_adam_step(
+            w, g, state[0]._data, state[1]._data, lr=lr, wd=wd, t=t,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient,
+            correct_bias=self.correct_bias)
+        return new_w, (m, v)
 
 
 @register

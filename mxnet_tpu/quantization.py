@@ -153,20 +153,14 @@ def calib_table(collectors, mode='entropy'):
 
 # ------------------------------------------------------- quantized layers
 class _QuantizedLayer(HybridBlock):
-    """Shared int8 state: quantized weight + scales + input calib range."""
+    """Shared int8 state: quantized weight + scales + input calib range.
 
-    # mx.analysis justified suppression (docs/static-analysis.md): the
-    # unfused-dequant lint correctly flags the dequantize -> float
-    # (bias/BN/act) -> requantize round trip between int8 layers. It is
-    # inherent to this PTQ design — layer outputs stay float
-    # (enable_float_output, module docstring) because BN/activation run
-    # unquantized — and is accepted until the fused requantize epilogue
-    # lands (ROADMAP item 5, BENCH_r05 int8_speedup 0.63). The finding
-    # downgrades to info with this note; it is not dropped.
-    _analysis_suppressions = {
-        'unfused-dequant': 'PTQ keeps inter-layer activations in float '
-                           '(enable_float_output); fused requantize '
-                           'epilogue tracked as ROADMAP item 5'}
+    The dequantize lives in the matmul epilogue (ops/quantization_ops.py
+    ``quantized_dense`` / ``quantized_conv2d``): int32 accumulator →
+    per-channel scale → bias → activation-dtype downcast inside one
+    fused kernel/region, so the historical ``unfused-dequant``
+    suppression this class carried is gone — the lint passes by
+    construction (docs/kernels.md)."""
 
     def __init__(self, float_layer, in_min, in_max,
                  activation_dtype='bfloat16', **kwargs):
@@ -178,9 +172,17 @@ class _QuantizedLayer(HybridBlock):
         # rescale still happens in f32 before the downcast
         self._act_dtype = jnp.dtype(activation_dtype)
         w = float_layer.weight.data()._data.astype(jnp.float32)
-        amax = float(jnp.max(jnp.abs(w)))
-        self._w_scale = float(range_to_scale(-amax, amax))
-        qw, _, _ = quantize_v2(w, -amax, amax)
+        # per-output-channel symmetric scales (axis 0 is out-channels
+        # for both Dense (O, I) and Conv OIHW): finer than the old
+        # per-tensor scale, and free now that the scale multiply rides
+        # the matmul epilogue as a (O,) vector instead of a scalar
+        red = tuple(range(1, w.ndim))
+        amax = jnp.max(jnp.abs(w), axis=red) if red else jnp.abs(w)
+        self._w_scale = jnp.where(amax > 0, amax / 127.0,
+                                  1.0).astype(jnp.float32)      # (O,)
+        cshape = (-1,) + (1,) * (w.ndim - 1)
+        qw = jnp.clip(jnp.round(w / self._w_scale.reshape(cshape)),
+                      -127, 127).astype(jnp.int8)
         qw = _np.asarray(qw, dtype=_np.int8)
         self.qweight = Parameter('qweight', shape=qw.shape, dtype='int8',
                                  grad_req='null')
@@ -212,16 +214,16 @@ class QuantizedDense(_QuantizedLayer):
         self.act = float_layer.act
 
     def forward(self, x):
+        from .ops.quantization_ops import quantized_dense
         q = self._quantize_input(x)
         if self._flatten and q.ndim > 2:
             q = q.reshape(q.shape[0], -1)
         qw = self.qweight.data()._data
-        acc = lax.dot_general(q, qw, (((q.ndim - 1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.int32)
-        out = acc.astype(jnp.float32) * (self._x_scale * self._w_scale)
-        if self._has_bias:
-            out = out + self.bias.data()._data
-        out = NDArray(out.astype(self._act_dtype))
+        out = quantized_dense(
+            q, qw, self._x_scale * self._w_scale,
+            self.bias.data()._data if self._has_bias else None,
+            out_dtype=self._act_dtype)
+        out = NDArray(out)
         if self.act is not None:
             out = self.act(out)
         return out
@@ -240,26 +242,20 @@ class QuantizedConv2D(_QuantizedLayer):
         self.act = float_layer.act
 
     def forward(self, x):
+        from .ops.quantization_ops import quantized_conv2d
         q = self._quantize_input(x)
         qw = self.qweight.data()._data
-        dn = lax.conv_dimension_numbers(q.shape, qw.shape,
-                                        (self._layout, 'OIHW', self._layout))
         stride = self._stride if isinstance(self._stride, tuple) else \
             (self._stride,) * 2
         pad = self._pad if isinstance(self._pad, tuple) else (self._pad,) * 2
         dil = self._dilate if isinstance(self._dilate, tuple) else \
             (self._dilate,) * 2
-        acc = lax.conv_general_dilated(
-            q, qw, window_strides=stride, padding=[(p, p) for p in pad],
-            rhs_dilation=dil, dimension_numbers=dn,
-            feature_group_count=self._groups,
-            preferred_element_type=jnp.int32)
-        out = acc.astype(jnp.float32) * (self._x_scale * self._w_scale)
-        if self._has_bias:
-            bshape = [1] * out.ndim
-            bshape[self._layout.index('C')] = -1
-            out = out + self.bias.data()._data.reshape(bshape)
-        out = NDArray(out.astype(self._act_dtype))
+        out = quantized_conv2d(
+            q, qw, self._x_scale * self._w_scale,
+            self.bias.data()._data if self._has_bias else None,
+            out_dtype=self._act_dtype, strides=stride, padding=pad,
+            dilation=dil, groups=self._groups, layout=self._layout)
+        out = NDArray(out)
         if self.act is not None:
             out = self.act(out)
         return out

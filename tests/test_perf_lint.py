@@ -55,14 +55,15 @@ def test_unfused_dequant_silent_on_float_weights():
     assert not by_rule(r, 'unfused-dequant')
 
 
-def test_quantized_dense_suppression_contract():
-    # the int8 PTQ path keeps inter-layer activations in float, so the
-    # dequant round-trip is a KNOWN cost: _QuantizedLayer declares an
-    # _analysis_suppressions entry that downgrades the finding to info
-    # (never drops it); ignore_suppressions=True restores the warning
+def test_quantized_net_lints_clean_without_suppression():
+    # the int8 epilogue fusion (quantized_dense: int32 accum -> scale ->
+    # bias -> downcast inside one attributed fused region) replaced
+    # _QuantizedLayer's historical unfused-dequant suppression — the
+    # lint must now pass clean BY CONSTRUCTION, with no suppression
+    # declared and nothing to ignore
     rng = onp.random.RandomState(0)
     # two stacked layers: layer 2's int8 matmul consumes layer 1's
-    # dequantized float output — the round-trip the rule targets
+    # dequantized float output — the round-trip the rule used to flag
     net = nn.HybridSequential()
     net.add(nn.Dense(16, in_units=16), nn.Dense(8, in_units=16))
     net.initialize()
@@ -70,17 +71,33 @@ def test_quantized_dense_suppression_contract():
     qnet = quantization.quantize_net(net, calib_data=[x],
                                      calib_mode='naive')
     g = analysis.trace_block(qnet, x, name='qdense')
-    assert 'unfused-dequant' in g.suppressions
+    assert 'unfused-dequant' not in g.suppressions
 
-    r = analysis.lint_graph(g, rules=['unfused-dequant'])
+    # clean even with suppressions ignored: the rule recognizes
+    # scale-in-epilogue (dequant + its int32 matmul attributed to the
+    # same fused_kernel op), it isn't being muted
+    r = analysis.lint_graph(g, rules=['unfused-dequant'],
+                            ignore_suppressions=True)
+    assert not by_rule(r, 'unfused-dequant')
+
+
+def test_epilogue_recognition_requires_shared_attribution():
+    # the same int32-accum -> scale -> cast shape written INLINE (no
+    # registered fused op owns it) must still fire: recognition keys on
+    # op attribution, not on the graph shape alone
+    def inline_epilogue(xq, wq, s, w2):
+        acc = jax.lax.dot_general(
+            xq, wq, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * s
+        return out @ w2
+
+    r = lint_fn(inline_epilogue,
+                jnp.zeros((4, 8), jnp.int8), jnp.zeros((16, 8), jnp.int8),
+                jnp.ones((16,), jnp.float32), jnp.ones((16, 4)),
+                rules=['unfused-dequant'], ignore_suppressions=True)
     hits = by_rule(r, 'unfused-dequant')
-    assert hits and all(f.severity == 'info' for f in hits)
-    assert any('suppressed' in f.message for f in hits)
-
-    r2 = analysis.lint_graph(g, rules=['unfused-dequant'],
-                             ignore_suppressions=True)
-    assert any(f.severity == 'warning'
-               for f in by_rule(r2, 'unfused-dequant'))
+    assert hits and hits[0].severity == 'warning'
 
 
 # ------------------------------------------------- bandwidth-bound-chain
@@ -200,6 +217,23 @@ def test_cli_single_model_json(capsys):
     assert bert['cost']['flops'] > 0
     assert bert['fixture']['drift'] == {}
     assert doc['failures'] == []
+
+
+def test_cli_strict_train_step_clean(capsys):
+    # the PR-20 contract: the fused train step (fwd+grad+fused_adam_step)
+    # carries ZERO bandwidth-bound-chain findings — the optimizer chain
+    # is attributed to the fused kernel — and survives --strict with
+    # full fused-kernel chain coverage against its checked-in fixture
+    perf_lint = _perf_lint_main()
+    rc = perf_lint.main(['train-step', '--strict', '--json'])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0, doc
+    ts = doc['models']['train-step']
+    assert ts['warnings'] == 0
+    assert not [f for f in ts['findings']
+                if f['rule'] == 'bandwidth-bound-chain']
+    assert ts['fused_kernel_coverage'] == 1.0
+    assert ts['fixture']['drift'] == {}
 
 
 def test_cli_fixture_drift_fails(monkeypatch, tmp_path, capsys):

@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Per-kernel roofline microbench for the fused Pallas kernels
+(docs/kernels.md): fused optimizer update, paged-attention decode, and
+the int8 matmul with dequant-in-epilogue.
+
+Each kernel is timed through its REGISTERED op — the exact dispatch
+production code takes (one pallas_call on TPU, one fused XLA region
+elsewhere) — against an UNFUSED reference built from stage-per-jit
+pieces, where every intermediate materializes to HBM the way the
+pre-fusion graphs did. The row carries the static roofline context
+(mx.analysis.costs over the fused graph):
+
+  achieved_gb_s      hbm_bytes_min / best wall time — the kernel's
+                     effective bandwidth, comparable to the saxpy
+                     number bench.py measures
+  hbm_frac_of_spec   achieved_gb_s vs the device spec's HBM rate
+  predicted_mfu_bound the intensity-implied MFU ceiling: ~0 for the
+                     optimizer (pure bandwidth), higher for int8
+
+Prints one JSON line per kernel plus a summary line. ``--smoke`` runs
+small shapes with few reps and exits nonzero when any fused kernel
+fails to beat its unfused reference — the tier-1 contract
+(tests/test_pallas_kernels.py wires it in): on CPU, where the int8
+vs_bf16 throughput acceptance can't run, this is the check that the
+fused epilogue actually wins.
+
+Usage:
+    python tools/kernel_bench.py            # full shapes
+    python tools/kernel_bench.py --smoke    # CI tier
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+B1, B2, EPS = 0.9, 0.999, 1e-8
+
+
+def _best_time(fn, reps):
+    """Min-of-reps wall time; fn must block on its result."""
+    fn()                                     # compile + warm
+    best = float('inf')
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _roofline(fn, *args, name):
+    """Static cost context for the fused graph (analysis.costs)."""
+    from mxnet_tpu import analysis
+    graph = analysis.trace_function(fn, *args, name=name)
+    cost = analysis.cost_of_graph(graph)
+    return cost
+
+
+def bench_fused_adam(args):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.optimizer_ops import fused_adam_step
+
+    n = 256 if args.smoke else 2048
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (n, n), jnp.float32)
+    g = jax.random.normal(jax.random.fold_in(key, 1), (n, n), jnp.float32)
+    m = jnp.zeros_like(w)
+    v = jnp.zeros_like(w)
+    lr, wd, t = 1e-3, 1e-4, 5
+
+    fused = jax.jit(lambda w, g, m, v: fused_adam_step(
+        w, g, m, v, lr=lr, wd=wd, t=t, beta1=B1, beta2=B2, epsilon=EPS))
+
+    # unfused reference: the pre-PR-20 eager chain — every arithmetic
+    # stage its own jit, every intermediate a full HBM round trip
+    s_prep = jax.jit(lambda g, w: g * 1.0 + wd * w)
+    s_m = jax.jit(lambda m, gp: B1 * m + (1 - B1) * gp)
+    s_v = jax.jit(lambda v, gp: B2 * v + (1 - B2) * gp * gp)
+    s_mh = jax.jit(lambda m: m / (1 - B1 ** t))
+    s_vh = jax.jit(lambda v: v / (1 - B2 ** t))
+    s_w = jax.jit(lambda w, mh, vh: w - lr * mh / (jnp.sqrt(vh) + EPS))
+
+    def unfused():
+        gp = s_prep(g, w)
+        m2, v2 = s_m(m, gp), s_v(v, gp)
+        s_w(w, s_mh(m2), s_vh(v2))[0].block_until_ready()
+
+    tf = _best_time(lambda: fused(w, g, m, v)[0].block_until_ready(),
+                    args.reps)
+    tu = _best_time(unfused, args.reps)
+    cost = _roofline(
+        lambda w, g, m, v: fused_adam_step(w, g, m, v, lr=lr, wd=wd, t=t,
+                                           beta1=B1, beta2=B2,
+                                           epsilon=EPS),
+        w, g, m, v, name='fused-adam')
+    return _row('fused_adam_step', n * n, tf, tu, cost)
+
+
+def bench_paged_attention(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from mxnet_tpu.ops.contrib import paged_attention_decode
+
+    B, H, kv, dh = (4, 4, 2, 32) if args.smoke else (8, 16, 4, 128)
+    psz, NP = 16, 8 if args.smoke else 32
+    P = B * NP + 1
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, H, dh), jnp.float32)
+    kp = jax.random.normal(jax.random.fold_in(key, 1), (P, psz, kv, dh),
+                           jnp.float32)
+    vp = jax.random.normal(jax.random.fold_in(key, 2), (P, psz, kv, dh),
+                           jnp.float32)
+    pages = jnp.asarray(
+        1 + onp.random.RandomState(0).permutation(B * NP).reshape(B, NP),
+        jnp.int32)
+    offset = jnp.full((B,), NP * psz - 1, jnp.int32)
+    scale = dh ** -0.5
+    rep = H // kv
+    L = NP * psz
+
+    fused = jax.jit(lambda q, kp, vp, pg, off: paged_attention_decode(
+        q, kp, vp, pg, off, sm_scale=scale))
+
+    # unfused reference: the pre-PR-20 gather path, stage per jit —
+    # the gathered (B, L, H, dh) K/V copies materialize twice
+    s_gather = jax.jit(lambda pool, pg: pool[pg].reshape(
+        B, L, kv, dh))
+    s_rep = jax.jit(lambda kf: jnp.repeat(kf, rep, 2))
+    s_scores = jax.jit(lambda q, kf: jnp.einsum(
+        'bshd,blhd->bhsl', q[:, None] * scale, kf))
+    s_soft = jax.jit(lambda s, off: jax.nn.softmax(jnp.where(
+        jnp.arange(L)[None, None, None, :] <= off[:, None, None, None],
+        s, -1e30), axis=-1))
+    s_out = jax.jit(lambda p, vf: jnp.einsum('bhsl,blhd->bshd', p, vf))
+
+    def unfused():
+        kf = s_rep(s_gather(kp, pages))
+        vf = s_rep(s_gather(vp, pages))
+        p = s_soft(s_scores(q, kf), offset)
+        s_out(p, vf).block_until_ready()
+
+    tf = _best_time(lambda: fused(q, kp, vp, pages, offset)
+                    .block_until_ready(), args.reps)
+    tu = _best_time(unfused, args.reps)
+    cost = _roofline(
+        lambda q, kp, vp, pg, off: paged_attention_decode(
+            q, kp, vp, pg, off, sm_scale=scale),
+        q, kp, vp, pages, offset, name='paged-decode')
+    return _row('paged_attention_decode', B * H * L * dh, tf, tu, cost)
+
+
+def bench_int8_matmul(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from mxnet_tpu.ops.quantization_ops import quantized_dense
+
+    # decode-shaped: small M, big weights — the serving regime where the
+    # dequantized f32 weight copy is pure overhead. Below N=K=1024 the
+    # reference's f32 GEMM runs out of dequant traffic to pay for and
+    # CPU int8 dot overhead dominates — the win this bench certifies is
+    # the bandwidth one
+    M, N, K = (8, 2048, 2048) if args.smoke else (64, 4096, 4096)
+    rng = onp.random.RandomState(0)
+    xq = jnp.asarray(rng.randint(-127, 128, (M, K)), jnp.int8)
+    wq = jnp.asarray(rng.randint(-127, 128, (N, K)), jnp.int8)
+    s = jnp.asarray(rng.uniform(1e-3, 2e-2, (N,)), jnp.float32)
+    b = jnp.asarray(rng.randn(N), jnp.float32)
+
+    fused = jax.jit(lambda x, w, sc, bi: quantized_dense(
+        x, w, sc, bi, out_dtype=jnp.float32))
+
+    # unfused reference: the unfused-dequant pattern the lint flags —
+    # dequantize the weights to an HBM-resident f32 copy, then matmul
+    s_deq = jax.jit(lambda w, sc: w.astype(jnp.float32) * sc[:, None])
+    s_mm = jax.jit(lambda x, wf, bi: x.astype(jnp.float32) @ wf.T + bi)
+
+    def unfused():
+        s_mm(xq, s_deq(wq, s), b).block_until_ready()
+
+    tf = _best_time(lambda: fused(xq, wq, s, b).block_until_ready(),
+                    args.reps)
+    tu = _best_time(unfused, args.reps)
+    cost = _roofline(
+        lambda x, w, sc, bi: quantized_dense(x, w, sc, bi,
+                                             out_dtype=jnp.float32),
+        xq, wq, s, b, name='int8-matmul')
+    return _row('quantized_dense_int8', M * N, tf, tu, cost)
+
+
+def _row(name, out_elems, t_fused, t_unfused, cost):
+    spec_bw = float(cost.device['hbm_bytes_s'])
+    achieved = cost.hbm_bytes_min / t_fused
+    return {
+        'metric': f'kernel_{name}',
+        'value': round(t_fused * 1e6, 1),
+        'unit': 'us',
+        'unfused_us': round(t_unfused * 1e6, 1),
+        'vs_unfused': round(t_unfused / t_fused, 3),
+        'achieved_gb_s': round(achieved / 1e9, 2),
+        'hbm_frac_of_spec': round(achieved / spec_bw, 4),
+        'predicted_mfu_bound': round(cost.mfu_bound, 4),
+        'hbm_bytes_min': int(cost.hbm_bytes_min),
+        'out_elems': out_elems,
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('--smoke', action='store_true',
+                   help='small shapes, few reps, assert fused beats '
+                        'unfused (CI tier — tests/test_pallas_kernels.py)')
+    p.add_argument('--reps', type=int, default=None,
+                   help='timed repetitions per variant (default 30, '
+                        '10 under --smoke)')
+    p.add_argument('--json', action='store_true',
+                   help='emit one JSON document instead of row lines')
+    args = p.parse_args(argv)
+    if args.reps is None:
+        args.reps = 10 if args.smoke else 30
+
+    rows = []
+    for bench in (bench_fused_adam, bench_paged_attention,
+                  bench_int8_matmul):
+        # one retry before judging: min-of-reps is robust, but a CI
+        # host page-cache hiccup on the very first measurement window
+        # must not fail the tier
+        row = bench(args)
+        if args.smoke and row['vs_unfused'] < 1.0:
+            row = bench(args)
+        rows.append(row)
+        if not args.json:
+            print(json.dumps(row), flush=True)
+
+    losers = [r['metric'] for r in rows if r['vs_unfused'] < 1.0]
+    doc = {'rows': rows, 'losers': losers}
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(f"{len(rows)} kernel(s); "
+              + (f"FUSED SLOWER THAN UNFUSED: {losers}" if losers
+                 else 'all fused paths beat their unfused references'))
+    return 1 if (args.smoke and losers) else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -15,8 +15,10 @@ Exit is nonzero when any model has an error-severity finding, a cost
 total drifts outside the fixture tolerance, or a fixture is missing.
 
 Usage:
-    python tools/perf_lint.py                       # resnet50 bert llama-decode
+    python tools/perf_lint.py               # resnet50 bert llama-decode
+                                            # train-step
     python tools/perf_lint.py resnet50 --json
+    python tools/perf_lint.py --strict      # warnings fail too (CI)
     python tools/perf_lint.py --update-fixtures     # re-baseline after
                                                     # an intended change
 
@@ -31,7 +33,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-DEFAULT_MODELS = ['resnet50', 'bert', 'llama-decode']
+DEFAULT_MODELS = ['resnet50', 'bert', 'llama-decode', 'train-step']
 FIXTURE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), 'tests', 'fixtures', 'costs')
 
@@ -66,8 +68,47 @@ def build_graph(name, mx):
         return analysis.trace_block(net, toks, segs, name=name), []
     if name == 'llama-decode':
         return build_llama_decode(mx), []
+    if name == 'train-step':
+        return build_train_step(mx), []
     raise SystemExit(f'unknown model {name!r}: want one of '
                      f'{DEFAULT_MODELS}')
+
+
+def build_train_step(mx, n=512, batch=8):
+    """fwd + grad + Adam update as ONE traced program — the shape the
+    Trainer's placement-keyed fused update compiles (gluon/trainer.py).
+    Params at 512x512 f32 put the optimizer's ~15-equation elementwise
+    chain well past the bandwidth-bound-chain byte threshold: before
+    the fused optimizer kernel (ops/pallas/fused_optimizer.py) this
+    graph was the audit's loudest finding; with ``fused_adam_step``
+    attributed it must lint CLEAN, and the fixture pins the cost totals
+    so a silent fallback to the unfused chain shows as eqn/byte drift."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import analysis
+    from mxnet_tpu.ops.optimizer_ops import fused_adam_step
+
+    def train_step(w1, w2, x, y, m1, v1, m2, v2):
+        def loss_of(params):
+            w1_, w2_ = params
+            h = jnp.tanh(x @ w1_)
+            # squared-error head: the matmuls dominate and the fwd/bwd
+            # elementwise runs stay short — the graph's ONLY chain past
+            # the lint thresholds is the optimizer update itself
+            return 0.5 * jnp.mean(jnp.square(h @ w2_ - y[:, None]))
+
+        g1, g2 = jax.grad(loss_of)((w1, w2))
+        nw1, nm1, nv1 = fused_adam_step(w1, g1, m1, v1, lr=1e-3,
+                                        wd=1e-4, t=1)
+        nw2, nm2, nv2 = fused_adam_step(w2, g2, m2, v2, lr=1e-3,
+                                        wd=1e-4, t=1)
+        return nw1, nw2, nm1, nv1, nm2, nv2
+
+    z = jnp.zeros((n, n), jnp.float32)
+    x = jnp.zeros((batch, n), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+    return analysis.trace_function(train_step, z, z, x, y, z, z, z, z,
+                                   name='train-step')
 
 
 def build_llama_decode(mx, n_tokens=8, batch=1, prompt_len=4):
@@ -116,6 +157,7 @@ def audit_one(name, args, mx):
     report = analysis.lint_graph(
         graph, rules=['unfused-dequant', 'bandwidth-bound-chain',
                       'small-collective', 'padding-waste'])
+    coverage, chain_bytes = analysis.chain_coverage(graph)
 
     result = {
         'cost': cost.as_dict(),
@@ -124,6 +166,10 @@ def audit_one(name, args, mx):
              'location': f.location}
             for f in report.findings],
         'errors': len(report.errors),
+        'warnings': sum(1 for f in report.findings
+                        if f.severity == 'warning'),
+        'fused_kernel_coverage': round(coverage, 4),
+        'chain_bytes': int(chain_bytes),
         'fixture': None,
     }
 
@@ -136,6 +182,13 @@ def audit_one(name, args, mx):
             'Expected analytical cost totals (tools/perf_lint.py). '
             'Regenerate with --update-fixtures after an INTENDED graph '
             'change; an unexplained diff here is a perf regression.')
+        # hand-written per-key drift notes survive regeneration: they
+        # record WHY the last intended change moved each total
+        if os.path.exists(fixture_path):
+            with open(fixture_path) as f:
+                prev = json.load(f)
+            if '_notes' in prev:
+                fixture['_notes'] = prev['_notes']
         with open(fixture_path, 'w') as f:
             json.dump(fixture, f, indent=2, sort_keys=True)
             f.write('\n')
@@ -172,6 +225,11 @@ def main(argv=None):
                    help=f'models to audit; default: {" ".join(DEFAULT_MODELS)}')
     p.add_argument('--json', action='store_true',
                    help='emit one machine-readable JSON document')
+    p.add_argument('--strict', action='store_true',
+                   help='fail on warning-severity findings too, not just '
+                        'errors — the post-PR-20 contract: every audited '
+                        'graph is warning-clean by construction '
+                        '(docs/kernels.md)')
     p.add_argument('--update-fixtures', action='store_true',
                    help='rewrite tests/fixtures/costs/<model>.json from '
                         'the current graphs (for INTENDED changes)')
@@ -199,6 +257,7 @@ def main(argv=None):
                   f"({c['classification']}, mfu bound "
                   f"{c['predicted_mfu_bound']}), peak HBM "
                   f"{c['peak_hbm_bytes'] / 1e6:.1f} MB, "
+                  f"chain coverage {result['fused_kernel_coverage']:.2f}, "
                   f"{len(result['findings'])} finding(s) "
                   f"[{result['errors']} error(s)]")
             if args.verbose:
@@ -214,6 +273,9 @@ def main(argv=None):
         if result['errors']:
             fail.append(f"{name}: {result['errors']} error-severity "
                         'finding(s)')
+        if args.strict and result['warnings']:
+            fail.append(f"{name}: {result['warnings']} warning(s) "
+                        'under --strict')
         fx = result['fixture']
         if fx and fx.get('missing'):
             fail.append(f"{name}: missing fixture {fx['missing']} "
